@@ -1,0 +1,24 @@
+"""cloudtik_tpu — a TPU-native cluster & AI platform.
+
+A brand-new framework with the capabilities of cloudtik/cloudtik, re-designed
+TPU-first:
+
+- **Workspaces** provision shared cloud infrastructure (VPC, IAM, storage).
+- **Clusters** are a head node plus worker *node groups*; on GCP a node group
+  can be a Cloud TPU pod slice — an atomic multi-host unit that is created,
+  health-checked, and terminated as one.
+- **Runtimes** are pluggable service stacks (AI training, ETL, monitoring,
+  storage, discovery) installed and wired on cluster nodes.
+- **The AI runtime is JAX/XLA-native**: one SPMD program per slice, sharding
+  expressed over a named `jax.sharding.Mesh` (data / fsdp / tensor / seq /
+  expert / pipe axes), collectives lowered by XLA onto ICI/DCN, and Pallas
+  kernels for the hot ops (flash / ring attention).
+
+Layer map mirrors the reference (see SURVEY.md §1): providers → command
+execution → control plane → operators → runtimes → API/CLI → AI workloads.
+"""
+
+__version__ = "0.1.0"
+
+# Public API re-exports (reference parity: core/api.py:22,65,630).
+from cloudtik_tpu.core.api import Cluster, ThisCluster, Workspace  # noqa: F401,E402

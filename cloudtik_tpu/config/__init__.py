@@ -1,0 +1,7 @@
+from cloudtik_tpu.config.loader import (  # noqa: F401
+    deep_merge,
+    fill_with_defaults,
+    load_yaml,
+    prepare_config,
+)
+from cloudtik_tpu.config.schema import validate_cluster_config, validate_workspace_config  # noqa: F401

@@ -1,0 +1,105 @@
+"""Secrets encryption for stored configs and runtime-config transport.
+
+Reference parity: core/_private/crypto.py:6 (AESCipher, AES-CBC via
+pycryptodomex) and utils.py:449 encrypt_config / :3462 encrypt_config_value.
+This build uses AES-256-GCM (authenticated) from `cryptography` instead of
+bare CBC — same role, better primitive.
+"""
+
+from __future__ import annotations
+
+import base64
+import copy
+import hashlib
+import os
+from typing import Any, Dict
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+_NONCE_LEN = 12
+_PREFIX = "tik-enc:"
+
+# Config keys whose string values are encrypted at rest.
+_SECRET_KEY_MARKERS = (
+    "account_key", "secret", "password", "credentials", "private_key", "token",
+)
+
+
+def generate_key() -> bytes:
+    """Fresh 256-bit key (per cluster)."""
+    return AESGCM.generate_key(bit_length=256)
+
+
+def derive_key(passphrase: str, salt: bytes = b"cloudtik-tpu") -> bytes:
+    return hashlib.pbkdf2_hmac("sha256", passphrase.encode(), salt, 100_000)
+
+
+class AESCipher:
+    """AES-256-GCM encrypt/decrypt of strings, base64-armored."""
+
+    def __init__(self, key: bytes):
+        if len(key) not in (16, 24, 32):
+            raise ValueError("AES key must be 16/24/32 bytes")
+        self._aead = AESGCM(key)
+
+    def encrypt(self, plaintext: str) -> str:
+        nonce = os.urandom(_NONCE_LEN)
+        ct = self._aead.encrypt(nonce, plaintext.encode(), None)
+        return base64.b64encode(nonce + ct).decode()
+
+    def decrypt(self, armored: str) -> str:
+        raw = base64.b64decode(armored)
+        nonce, ct = raw[:_NONCE_LEN], raw[_NONCE_LEN:]
+        return self._aead.decrypt(nonce, ct, None).decode()
+
+
+def encrypt_string(value: str, key: bytes) -> str:
+    return _PREFIX + AESCipher(key).encrypt(value)
+
+
+def decrypt_string(value: str, key: bytes) -> str:
+    if not value.startswith(_PREFIX):
+        return value
+    return AESCipher(key).decrypt(value[len(_PREFIX):])
+
+
+def is_encrypted(value: Any) -> bool:
+    return isinstance(value, str) and value.startswith(_PREFIX)
+
+
+def _walk(obj: Any, key_hint: str, fn) -> Any:
+    if isinstance(obj, dict):
+        return {k: _walk(v, k, fn) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_walk(v, key_hint, fn) for v in obj]
+    if isinstance(obj, str):
+        return fn(key_hint, obj)
+    return obj
+
+
+def encrypt_config(config: Dict[str, Any], key: bytes) -> Dict[str, Any]:
+    """Encrypt secret-looking string values in a config tree.
+
+    Reference parity: utils.py:449.
+    """
+
+    cipher = AESCipher(key)
+
+    def enc(key_hint: str, value: str) -> str:
+        hint = key_hint.lower()
+        if any(m in hint for m in _SECRET_KEY_MARKERS) and not is_encrypted(value):
+            return _PREFIX + cipher.encrypt(value)
+        return value
+
+    return _walk(copy.deepcopy(config), "", enc)
+
+
+def decrypt_config(config: Dict[str, Any], key: bytes) -> Dict[str, Any]:
+    cipher = AESCipher(key)
+
+    def dec(_key_hint: str, value: str) -> str:
+        if is_encrypted(value):
+            return cipher.decrypt(value[len(_PREFIX):])
+        return value
+
+    return _walk(copy.deepcopy(config), "", dec)

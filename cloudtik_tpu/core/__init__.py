@@ -1,0 +1,8 @@
+from cloudtik_tpu.core.database_provider import DatabaseProvider  # noqa: F401
+from cloudtik_tpu.core.job_waiter import JobWaiter, JobWaiterChain  # noqa: F401
+from cloudtik_tpu.core.load_balancer_provider import LoadBalancerProvider  # noqa: F401
+from cloudtik_tpu.core.node_provider import NodeLaunchException, NodeProvider  # noqa: F401
+from cloudtik_tpu.core.runtime import NodeConstraint, Runtime  # noqa: F401
+from cloudtik_tpu.core.scaling_policy import ScalingPolicy, ScalingState  # noqa: F401
+from cloudtik_tpu.core.storage_provider import StorageProvider  # noqa: F401
+from cloudtik_tpu.core.workspace_provider import Existence, WorkspaceProvider  # noqa: F401

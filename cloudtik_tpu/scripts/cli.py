@@ -1,0 +1,57 @@
+"""`tik` — the CLI.
+
+Reference parity: python/cloudtik/scripts/scripts.py:69 (cli group).  Commands
+grow with the platform; this module always imports cleanly so the console
+script never breaks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import click
+
+import cloudtik_tpu
+from cloudtik_tpu.config.loader import load_yaml, prepare_config
+from cloudtik_tpu.config.schema import ConfigError, validate_cluster_config
+from cloudtik_tpu.utils.cli_logger import cli_logger
+
+
+@click.group()
+@click.version_option(cloudtik_tpu.__version__, prog_name="tik")
+@click.option("-v", "--verbose", count=True, help="Increase verbosity.")
+def cli(verbose: int):
+    cli_logger.verbosity = verbose
+
+
+@cli.command(name="validate")
+@click.argument("config_file", type=click.Path(exists=True))
+def validate(config_file: str):
+    """Validate a cluster config file."""
+    try:
+        config = prepare_config(
+            load_yaml(config_file),
+            search_dirs=[os.path.dirname(os.path.abspath(config_file))])
+        validate_cluster_config(config)
+    except (ConfigError, FileNotFoundError) as e:
+        cli_logger.abort(str(e))
+    cli_logger.success("Config is valid.")
+
+
+@cli.command(name="show-config")
+@click.argument("config_file", type=click.Path(exists=True))
+def show_config(config_file: str):
+    """Print the fully-resolved cluster config (templates + defaults)."""
+    config = prepare_config(
+        load_yaml(config_file),
+        search_dirs=[os.path.dirname(os.path.abspath(config_file))])
+    click.echo(json.dumps(config, indent=2, default=str))
+
+
+def main():
+    return cli()
+
+
+if __name__ == "__main__":
+    main()

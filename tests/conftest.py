@@ -1,0 +1,17 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip sharding is tested without TPU hardware by asking XLA's host
+platform for 8 virtual devices (SURVEY.md §4: the reference faked multi-node
+with MockProvider threads; the JAX layer can additionally fake a multi-chip
+mesh in one process).
+"""
+
+import os
+
+# Must run before jax is imported anywhere.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TIK_TEST_MODE", "1")

@@ -1,0 +1,160 @@
+"""Config subsystem tests: merge, inheritance, defaults, schema, crypto, hashing."""
+
+import os
+
+import pytest
+
+from cloudtik_tpu.config import crypto, hashing
+from cloudtik_tpu.config.loader import (
+    deep_merge, fill_with_defaults, prepare_config)
+from cloudtik_tpu.config.schema import (
+    ConfigError, validate_cluster_config, validate_workspace_config)
+
+
+def test_deep_merge_nested():
+    base = {"a": {"b": 1, "c": 2}, "x": 1}
+    override = {"a": {"c": 3, "d": 4}, "y": 2}
+    merged = deep_merge(base, override)
+    assert merged == {"a": {"b": 1, "c": 3, "d": 4}, "x": 1, "y": 2}
+    # inputs untouched
+    assert base["a"]["c"] == 2
+
+
+def test_deep_merge_node_types_compose():
+    # A child config adds a node type without wiping the template's.
+    base = {"available_node_types": {"head": {"node_config": {"a": 1}}}}
+    override = {"available_node_types": {"tpu": {"min_workers": 1}}}
+    merged = deep_merge(base, override)
+    assert sorted(merged["available_node_types"]) == ["head", "tpu"]
+
+
+def test_deep_merge_node_config_replaces():
+    # Partial instance specs don't merge: override wins wholesale.
+    base = {"available_node_types": {"w": {"node_config": {"machine": "n2", "zone": "a"}}}}
+    override = {"available_node_types": {"w": {"node_config": {"machine": "v5p"}}}}
+    merged = deep_merge(base, override)
+    assert merged["available_node_types"]["w"]["node_config"] == {"machine": "v5p"}
+
+
+def test_deep_merge_append_commands():
+    base = {"setup_commands": ["a"]}
+    override = {"setup_commands": ["b"]}
+    assert deep_merge(base, override)["setup_commands"] == ["a", "b"]
+
+
+def test_from_inheritance_chain(tmp_path):
+    (tmp_path / "grand.yaml").write_text("max_workers: 3\nprovider: {type: virtual}\n")
+    (tmp_path / "parent.yaml").write_text("from: grand\nidle_timeout_minutes: 5\n")
+    child = {"from": str(tmp_path / "parent.yaml"), "cluster_name": "c1"}
+    merged = fill_with_defaults(child, [str(tmp_path)])
+    assert merged["max_workers"] == 3
+    assert merged["idle_timeout_minutes"] == 5
+    assert merged["cluster_name"] == "c1"
+    assert "from" not in merged
+
+
+def test_from_cycle_detection(tmp_path):
+    (tmp_path / "a.yaml").write_text(f"from: {tmp_path}/b.yaml\n")
+    (tmp_path / "b.yaml").write_text(f"from: {tmp_path}/a.yaml\n")
+    with pytest.raises(ValueError):
+        fill_with_defaults({"from": str(tmp_path / "a.yaml")}, [str(tmp_path)])
+
+
+def test_prepare_config_fills_defaults():
+    config = prepare_config({
+        "cluster_name": "c",
+        "provider": {"type": "virtual"},
+        "available_node_types": {
+            "head": {"node_config": {}},
+            "worker": {"node_config": {}, "min_workers": 2},
+        },
+        "head_node_type": "head",
+        "max_workers": 8,
+    })
+    assert config["available_node_types"]["worker"]["max_workers"] == 8
+    assert config["available_node_types"]["head"]["max_workers"] == 0
+    assert config["runtime"]["types"] == []
+
+
+def test_validate_cluster_config_ok():
+    validate_cluster_config({
+        "cluster_name": "ok-name",
+        "provider": {"type": "gcp", "region": "us-central2"},
+        "available_node_types": {
+            "head": {"node_config": {}},
+            "tpu_worker": {
+                "node_config": {},
+                "min_workers": 1, "max_workers": 2,
+                "node_group": {"atomic": True, "accelerator_type": "v5p-32",
+                               "group_size": 4},
+            },
+        },
+        "head_node_type": "head",
+    })
+
+
+def test_validate_cluster_config_bad_name():
+    with pytest.raises(ConfigError):
+        validate_cluster_config({
+            "cluster_name": "-bad",
+            "provider": {"type": "gcp"},
+        })
+
+
+def test_validate_cluster_config_bad_head_type():
+    with pytest.raises(ConfigError):
+        validate_cluster_config({
+            "cluster_name": "c",
+            "provider": {"type": "gcp"},
+            "available_node_types": {"a": {}},
+            "head_node_type": "missing",
+        })
+
+
+def test_validate_workspace_config():
+    validate_workspace_config({
+        "workspace_name": "w1", "provider": {"type": "gcp"}})
+    with pytest.raises(ConfigError):
+        validate_workspace_config({"provider": {"type": "gcp"}})
+
+
+def test_crypto_roundtrip():
+    key = crypto.generate_key()
+    enc = crypto.encrypt_string("hunter2", key)
+    assert enc != "hunter2" and crypto.is_encrypted(enc)
+    assert crypto.decrypt_string(enc, key) == "hunter2"
+
+
+def test_encrypt_config_only_secret_keys():
+    key = crypto.generate_key()
+    config = {
+        "provider": {"type": "gcp", "credentials": "SECRET",
+                     "nested": {"api_token": "T"}},
+        "cluster_name": "c",
+    }
+    enc = crypto.encrypt_config(config, key)
+    assert crypto.is_encrypted(enc["provider"]["credentials"])
+    assert crypto.is_encrypted(enc["provider"]["nested"]["api_token"])
+    assert enc["cluster_name"] == "c"
+    dec = crypto.decrypt_config(enc, key)
+    assert dec == config
+
+
+def test_launch_hash_stability():
+    h1 = hashing.hash_launch_conf({"machine": "n2", "z": 1}, {"ssh_user": "u"})
+    h2 = hashing.hash_launch_conf({"z": 1, "machine": "n2"}, {"ssh_user": "u"})
+    assert h1 == h2
+    h3 = hashing.hash_launch_conf({"machine": "n3"}, {"ssh_user": "u"})
+    assert h1 != h3
+
+
+def test_runtime_hash_contents(tmp_path):
+    f = tmp_path / "mount.txt"
+    f.write_text("v1")
+    rh1, ch1 = hashing.hash_runtime_conf({"/remote": str(f)}, ["cmd"],
+                                         generate_contents_hash=True)
+    f.write_text("v2")
+    rh2, ch2 = hashing.hash_runtime_conf({"/remote": str(f)}, ["cmd"],
+                                         generate_contents_hash=True)
+    assert rh1 == rh2        # paths/commands unchanged
+    assert ch1 != ch2        # contents changed
