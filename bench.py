@@ -1,0 +1,79 @@
+"""Headline benchmark: flagship transformer training throughput + MFU.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.  The metric
+is training MFU of the ~1B-param flagship transformer (bf16 compute, flash
+attention, remat, adamw) on the attached TPU.  vs_baseline is measured MFU
+over the BASELINE.json north-star target of 45% MFU (the reference publishes
+no numeric baselines — BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def run_bench(model: str = "tpu_1b", seq_len: int = 2048,
+              batch_candidates=(16, 8, 4, 2, 1),
+              warmup_steps: int = 3, measure_steps: int = 20):
+    import jax
+
+    from cloudtik_tpu.models import transformer as T
+    from cloudtik_tpu.train.data import synthetic_lm_batches
+    from cloudtik_tpu.train.trainer import (
+        Trainer, TrainerConfig, device_peak_flops, transformer_spec)
+
+    cfg = T.config(model, max_seq_len=seq_len)
+    spec = transformer_spec(cfg)
+
+    last_err = None
+    for batch in batch_candidates:
+        try:
+            trainer = Trainer(
+                spec,
+                TrainerConfig(global_batch_size=batch, seq_len=seq_len,
+                              log_every=measure_steps))
+            data = synthetic_lm_batches(batch, seq_len, cfg.vocab_size)
+            # Warmup (compile + first steps) outside the measured window.
+            trainer.fit(data, num_steps=warmup_steps)
+            t0 = time.perf_counter()
+            trainer.config.log_every = measure_steps
+            out = trainer.fit(data, num_steps=measure_steps)
+            dt = time.perf_counter() - t0
+            tokens_per_sec = batch * seq_len * measure_steps / dt
+            peak = device_peak_flops()
+            n_dev = trainer.mesh.devices.size
+            mfu = (spec.flops_per_token * tokens_per_sec / (peak * n_dev)
+                   if peak else 0.0)
+            return {
+                "tokens_per_sec": tokens_per_sec,
+                "mfu": mfu,
+                "batch": batch,
+                "seq_len": seq_len,
+                "loss": out["history"][-1]["loss"] if out["history"] else None,
+            }
+        except Exception as e:  # OOM at this batch: halve and retry
+            last_err = e
+            msg = str(e)
+            if "RESOURCE_EXHAUSTED" not in msg and "memory" not in msg.lower():
+                raise
+    raise RuntimeError(f"all batch sizes failed: {last_err}")
+
+
+def main():
+    result = run_bench()
+    mfu_pct = result["mfu"] * 100
+    print(json.dumps({
+        "metric": "llama1b_train_mfu_bf16_seq2048",
+        "value": round(mfu_pct, 2),
+        "unit": "% MFU",
+        "vs_baseline": round(result["mfu"] / 0.45, 3),
+    }))
+    print(f"# tokens/sec={result['tokens_per_sec']:.0f} "
+          f"batch={result['batch']} seq={result['seq_len']} "
+          f"loss={result['loss']:.3f}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

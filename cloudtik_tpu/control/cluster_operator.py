@@ -1,0 +1,592 @@
+"""Cluster operator: the imperative verbs behind the API/CLI.
+
+Reference parity: core/_private/cluster/cluster_operator.py
+(create_or_update_cluster:228, get_or_create_head_node:869,
+teardown_cluster:375, _exec_cluster:1255, _rsync:1404, monitor_cluster:834,
+show_cluster_info:2178, request_resources:167).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shlex
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from cloudtik_tpu.config.hashing import hash_launch_conf, hash_runtime_conf
+from cloudtik_tpu.control.executor.factory import make_command_executor
+from cloudtik_tpu.control.state import (
+    StateClient, TABLE_SCALING, TcpStateBackend)
+from cloudtik_tpu.control.updater import NodeUpdater
+from cloudtik_tpu.core.tags import (
+    NODE_KIND_HEAD, NODE_KIND_WORKER, STATUS_UNINITIALIZED, STATUS_UP_TO_DATE,
+    TAG_CLUSTER_NAME, TAG_LAUNCH_CONFIG, TAG_NODE_KIND, TAG_NODE_STATUS,
+    TAG_USER_NODE_TYPE)
+from cloudtik_tpu.providers.factory import (
+    create_node_provider, get_node_provider_cls)
+from cloudtik_tpu.runtimes.registry import iter_runtimes
+from cloudtik_tpu.utils.call_context import CallContext
+from cloudtik_tpu.utils.cli_logger import cli_logger
+from cloudtik_tpu.utils.constants import (
+    TIK_BOOTSTRAP_CONFIG_FILE, TIK_BOOTSTRAP_CONFIG_REMOTE,
+    TIK_STATE_PORT_DEFAULT)
+
+logger = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------------------
+# Config bootstrap
+# --------------------------------------------------------------------------
+
+def bootstrap_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Run the provider + runtime config pipelines.
+
+    Reference parity: cluster/cluster_config.py _bootstrap_config:37.
+    Idempotent: a config that already went through the pipeline passes
+    through untouched (operators call each other and would otherwise pay the
+    provider/runtime hooks repeatedly).
+    """
+    if config.get("_tik_bootstrapped"):
+        return config
+    provider_cls = get_node_provider_cls(config["provider"])
+    config = provider_cls.prepare_config(config)
+    for runtime in iter_runtimes(config):
+        config = runtime.prepare_config(config)
+    config = provider_cls.post_prepare(config)
+    provider_cls.validate_config(config["provider"])
+    for runtime in iter_runtimes(config):
+        runtime.validate_config(config)
+    config = provider_cls.bootstrap_config(config)
+    for runtime in iter_runtimes(config):
+        config = runtime.bootstrap_config(config)
+    config["_tik_bootstrapped"] = True
+    return config
+
+
+def _head_node_type(config: Dict[str, Any]) -> str:
+    return config["head_node_type"]
+
+
+def _find_head(provider, cluster_name: str) -> Optional[str]:
+    heads = provider.non_terminated_nodes({
+        TAG_CLUSTER_NAME: cluster_name,
+        TAG_NODE_KIND: NODE_KIND_HEAD,
+    })
+    return heads[0] if heads else None
+
+
+# The python used on NODES: config["python_bin"] (set e.g. by the virtual
+# provider to this interpreter) exported as $TIK_PYTHON, falling back to the
+# node's python3 — never the operator workstation's sys.executable.
+_NODE_PYTHON = '"${TIK_PYTHON:-python3}"'
+
+
+def _default_head_start_commands(config: Dict[str, Any]) -> List[str]:
+    """Boot head services if the config declares no start commands."""
+    return [f"{_NODE_PYTHON} -m cloudtik_tpu.scripts.cli "
+            f"node start --head --daemonize"]
+
+
+def _runtime_env(config: Dict[str, Any], provider, node_id: str) -> Dict[str, str]:
+    env: Dict[str, str] = {
+        "TIK_CLUSTER_NAME": config["cluster_name"],
+        "TIK_WORKSPACE_NAME": config.get("workspace_name", ""),
+        "TIK_PYTHON": config.get("python_bin", "python3"),
+    }
+    for runtime in iter_runtimes(config):
+        env.update({k: str(v) for k, v in
+                    runtime.with_environment_variables(
+                        config, provider, node_id).items()})
+    return env
+
+
+# --------------------------------------------------------------------------
+# create / teardown
+# --------------------------------------------------------------------------
+
+def create_or_update_cluster(
+    config: Dict[str, Any],
+    restart_only: bool = False,
+    no_restart: bool = False,
+) -> Dict[str, Any]:
+    config = bootstrap_config(config)
+    cluster_name = config["cluster_name"]
+    provider = create_node_provider(config["provider"], cluster_name)
+    try:
+        head_id = get_or_create_head_node(
+            config, provider, restart_only=restart_only,
+            no_restart=no_restart)
+        cli_logger.success(
+            "Cluster {} is up (head: {}).", cluster_name, head_id)
+        return {"head_node_id": head_id}
+    finally:
+        provider.cleanup()
+
+
+def get_or_create_head_node(
+    config: Dict[str, Any],
+    provider,
+    restart_only: bool = False,
+    no_restart: bool = False,
+) -> str:
+    cluster_name = config["cluster_name"]
+    head_type = _head_node_type(config)
+    node_types = config["available_node_types"]
+    head_config = node_types[head_type].get("node_config", {})
+    launch_hash = hash_launch_conf(head_config, config.get("auth", {}))
+
+    head_id = _find_head(provider, cluster_name)
+    if head_id is not None:
+        tags = provider.node_tags(head_id)
+        if tags.get(TAG_LAUNCH_CONFIG) not in ("", None, launch_hash):
+            cli_logger.warning(
+                "Head launch config changed; recreating head node.")
+            provider.terminate_node(head_id)
+            head_id = None
+
+    if head_id is None:
+        cli_logger.info("Creating new head node...")
+        provider.create_node(head_config, {
+            TAG_CLUSTER_NAME: cluster_name,
+            TAG_NODE_KIND: NODE_KIND_HEAD,
+            TAG_NODE_STATUS: STATUS_UNINITIALIZED,
+            TAG_USER_NODE_TYPE: head_type,
+            TAG_LAUNCH_CONFIG: launch_hash,
+        }, 1)
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            head_id = _find_head(provider, cluster_name)
+            if head_id and provider.internal_ip(head_id):
+                break
+            time.sleep(2)
+        if head_id is None:
+            raise RuntimeError("head node did not appear after create")
+
+    # Config stored on the head for on-head tools + the controller.
+    remote_config = provider.prepare_for_head_node(config, dict(config))
+
+    runtime_hash, contents_hash = hash_runtime_conf(
+        config.get("file_mounts", {}),
+        [config.get("setup_commands", []),
+         config.get("head_setup_commands", []),
+         config.get("head_start_commands", [])],
+        generate_contents_hash=True)
+
+    executor = make_command_executor(
+        CallContext(), "[head] ", head_id, provider,
+        config.get("auth", {}), cluster_name,
+        use_internal_ip=False, docker_config=config.get("docker"))
+
+    import yaml as _yaml
+    bootstrap_dir = os.path.expanduser("~/.tik")
+    os.makedirs(bootstrap_dir, exist_ok=True)
+    staged_config = os.path.join(
+        bootstrap_dir, f"bootstrap-{cluster_name}.yaml")
+    with open(staged_config, "w") as f:
+        _yaml.safe_dump(remote_config, f)
+
+    file_mounts = dict(config.get("file_mounts", {}))
+    # Remote-relative key: the node's own home expands it (the local
+    # TIK_BOOTSTRAP_CONFIG_FILE path would be wrong for a different remote
+    # user).
+    file_mounts[TIK_BOOTSTRAP_CONFIG_REMOTE] = staged_config
+
+    start_commands = config.get("head_start_commands") or \
+        _default_head_start_commands(config)
+    updater = NodeUpdater(
+        head_id, provider, executor,
+        file_mounts=file_mounts,
+        initialization_commands=config.get("initialization_commands", []),
+        setup_commands=(config.get("setup_commands", []) +
+                        config.get("head_setup_commands", [])),
+        start_commands=[] if no_restart else start_commands,
+        runtime_hash=runtime_hash,
+        file_mounts_contents_hash=contents_hash,
+        environment_variables=_runtime_env(config, provider, head_id),
+        is_head_node=True,
+        restart_only=restart_only,
+    )
+    updater.run()
+    return head_id
+
+
+def teardown_cluster(
+    config: Dict[str, Any],
+    workers_only: bool = False,
+    keep_min_workers: bool = False,
+    hard: bool = False,
+) -> None:
+    config = bootstrap_config(config)
+    cluster_name = config["cluster_name"]
+    provider = create_node_provider(config["provider"], cluster_name)
+    try:
+        head_id = _find_head(provider, cluster_name)
+        if head_id and not hard:
+            try:
+                executor = make_command_executor(
+                    CallContext(), "[head] ", head_id, provider,
+                    config.get("auth", {}), cluster_name,
+                    docker_config=config.get("docker"))
+                executor.run(
+                    f"{_NODE_PYTHON} -m cloudtik_tpu.scripts.cli node stop",
+                    environment_variables=_runtime_env(
+                        config, provider, head_id),
+                    timeout=60)
+            except Exception:
+                logger.warning("graceful head stop failed; terminating")
+
+        workers = provider.non_terminated_nodes({
+            TAG_CLUSTER_NAME: cluster_name,
+            TAG_NODE_KIND: NODE_KIND_WORKER,
+        })
+        if keep_min_workers:
+            keep: List[str] = []
+            node_types = config["available_node_types"]
+            count: Dict[str, int] = {}
+            for node_id in workers:
+                node_type = provider.node_tags(node_id).get(
+                    TAG_USER_NODE_TYPE, "")
+                min_of_type = node_types.get(node_type, {}).get(
+                    "min_workers", 0)
+                if count.get(node_type, 0) < min_of_type:
+                    keep.append(node_id)
+                    count[node_type] = count.get(node_type, 0) + 1
+            workers = [w for w in workers if w not in keep]
+        # group-aware teardown
+        seen_groups = set()
+        from cloudtik_tpu.core.tags import TAG_NODE_GROUP_ID
+        for node_id in workers:
+            gid = provider.node_tags(node_id).get(TAG_NODE_GROUP_ID)
+            if gid and provider.supports_node_groups():
+                if gid not in seen_groups:
+                    provider.terminate_node_group(gid)
+                    seen_groups.add(gid)
+            else:
+                provider.terminate_node(node_id)
+        if not workers_only and head_id:
+            provider.terminate_node(head_id)
+        cli_logger.success("Cluster {} torn down.", cluster_name)
+    finally:
+        provider.cleanup()
+
+
+# --------------------------------------------------------------------------
+# exec / submit / rsync
+# --------------------------------------------------------------------------
+
+def head_executor(config: Dict[str, Any], provider):
+    cluster_name = config["cluster_name"]
+    head_id = _find_head(provider, cluster_name)
+    if head_id is None:
+        raise RuntimeError(f"cluster {cluster_name} has no head node")
+    executor = make_command_executor(
+        CallContext(), "[head] ", head_id, provider,
+        config.get("auth", {}), cluster_name,
+        docker_config=config.get("docker"))
+    return head_id, executor
+
+
+def exec_on_cluster(
+    config: Dict[str, Any],
+    cmd: str,
+    node_ip: Optional[str] = None,
+    all_nodes: bool = False,
+    run_env: str = "auto",
+    tmux: bool = False,
+    stop: bool = False,
+    port_forward=None,
+    with_output: bool = False,
+    job_waiter_name: Optional[str] = None,
+    on_head: bool = False,
+) -> Optional[str]:
+    config = bootstrap_config(config)
+    provider = create_node_provider(
+        config["provider"], config["cluster_name"])
+    try:
+        session = None
+        if tmux:
+            session = f"tik-job-{int(time.time())}"
+            cmd = (f"tmux new-session -d -s {session} "
+                   f"{shlex.quote(cmd + '; sleep 3')}")
+        targets: List[str] = []
+        if all_nodes:
+            targets = provider.non_terminated_nodes({
+                TAG_CLUSTER_NAME: config["cluster_name"]})
+        elif node_ip:
+            for node_id in provider.non_terminated_nodes({}):
+                if provider.internal_ip(node_id) == node_ip or \
+                        provider.external_ip(node_id) == node_ip:
+                    targets = [node_id]
+                    break
+            if not targets:
+                raise ValueError(f"no node with ip {node_ip}")
+        if targets:
+            output = None
+            last_executor = None
+            for node_id in targets:
+                last_executor = make_command_executor(
+                    CallContext(), f"[{node_id}] ", node_id, provider,
+                    config.get("auth", {}), config["cluster_name"],
+                    docker_config=config.get("docker"))
+                output = last_executor.run(
+                    cmd, with_output=with_output,
+                    environment_variables=_runtime_env(
+                        config, provider, node_id))
+            if stop:
+                if session and last_executor:
+                    _wait_for_tmux_session(last_executor, session)
+                teardown_cluster(config)
+            return output
+        head_id, executor = head_executor(config, provider)
+        result = executor.run(cmd, with_output=with_output,
+                              environment_variables=_runtime_env(
+                                  config, provider, head_id))
+        if stop:
+            # "stop after the command completes": a detached tmux session
+            # returns immediately, so wait for it to end before teardown.
+            if session:
+                _wait_for_tmux_session(executor, session)
+            teardown_cluster(config)
+        return result
+    finally:
+        provider.cleanup()
+
+
+def _wait_for_tmux_session(executor, session: str,
+                           poll_s: float = 5.0,
+                           timeout_s: float = 7 * 24 * 3600) -> None:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            executor.run(f"tmux has-session -t {shlex.quote(session)}",
+                         with_output=True, timeout=30)
+        except Exception:
+            return  # session gone: job finished
+        time.sleep(poll_s)
+
+
+def submit_to_cluster(
+    config: Dict[str, Any],
+    script: str,
+    script_args: List[str],
+    tmux: bool = False,
+    stop: bool = False,
+    job_waiter_name: Optional[str] = None,
+) -> Optional[str]:
+    """Rsync the job file to the head, pick the runtime that can run it.
+
+    Reference parity: scripts.py submit:451 -> _exec_cluster.
+    """
+    config = bootstrap_config(config)
+    provider = create_node_provider(
+        config["provider"], config["cluster_name"])
+    try:
+        _head_id, executor = head_executor(config, provider)
+        remote_dir = "~/.tik/jobs"
+        remote_path = f"{remote_dir}/{os.path.basename(script)}"
+        executor.run(f"mkdir -p {remote_dir}")
+        executor.run_rsync_up(os.path.expanduser(script),
+                              os.path.expanduser(remote_path))
+        runnable: Optional[List[str]] = None
+        for runtime in iter_runtimes(config):
+            runnable = runtime.get_runnable_command(remote_path, None)
+            if runnable:
+                break
+        if runnable is None:
+            runnable = [_NODE_PYTHON, remote_path]
+        cmd = " ".join(runnable + [shlex.quote(a) for a in script_args])
+        return exec_on_cluster(config, cmd, tmux=tmux, stop=stop,
+                               job_waiter_name=job_waiter_name)
+    finally:
+        provider.cleanup()
+
+
+def rsync_cluster(
+    config: Dict[str, Any], source: str, target: str, down: bool = False,
+    node_ip: Optional[str] = None, all_workers: bool = False,
+) -> None:
+    config = bootstrap_config(config)
+    provider = create_node_provider(
+        config["provider"], config["cluster_name"])
+    try:
+        _head_id, executor = head_executor(config, provider)
+        if down:
+            executor.run_rsync_down(source, target)
+        else:
+            executor.run_rsync_up(source, target)
+    finally:
+        provider.cleanup()
+
+
+# --------------------------------------------------------------------------
+# scale / status / info
+# --------------------------------------------------------------------------
+
+def _head_state_client(config: Dict[str, Any], provider) -> StateClient:
+    head_id = _find_head(provider, config["cluster_name"])
+    if head_id is None:
+        raise RuntimeError("no head node")
+    head_ip = provider.internal_ip(head_id)
+    return StateClient(TcpStateBackend(
+        head_ip, config.get("state_port", TIK_STATE_PORT_DEFAULT)))
+
+
+def scale_cluster(
+    config: Dict[str, Any],
+    num_cpus: Optional[int] = None,
+    num_workers: Optional[int] = None,
+    node_type: Optional[str] = None,
+    on_head: bool = False,
+) -> None:
+    """Publish a resource request the controller satisfies next tick.
+
+    Reference parity: cluster_operator.py request_resources:167.
+    """
+    config = bootstrap_config(config)
+    provider = create_node_provider(
+        config["provider"], config["cluster_name"])
+    try:
+        state = _head_state_client(config, provider)
+        demands: List[Dict[str, float]] = []
+        node_types = config["available_node_types"]
+        if num_cpus:
+            demands.append({"CPU": float(num_cpus)})
+        if num_workers:
+            chosen = node_type or next(
+                (t for t in node_types if t != config["head_node_type"]),
+                None)
+            if chosen is None:
+                raise ValueError("no worker node type to scale")
+            res = node_types[chosen].get("resources", {"CPU": 1})
+            demands.extend([dict(res)] * num_workers)
+        state.table_put(TABLE_SCALING, "user-request", {
+            "time": time.time(),
+            "resource_demands": demands,
+        })
+        cli_logger.success("Scale request published: {} demands.",
+                           len(demands))
+    finally:
+        provider.cleanup()
+
+
+def get_cluster_status(config: Dict[str, Any],
+                       on_head: bool = False) -> Dict[str, Any]:
+    config = bootstrap_config(config)
+    provider = create_node_provider(
+        config["provider"], config["cluster_name"])
+    try:
+        nodes = provider.non_terminated_nodes({
+            TAG_CLUSTER_NAME: config["cluster_name"]})
+        by_status: Dict[str, int] = {}
+        head = None
+        workers = []
+        for node_id in nodes:
+            tags = provider.node_tags(node_id)
+            status = tags.get(TAG_NODE_STATUS, "unknown")
+            info = {
+                "node_id": node_id,
+                "node_type": tags.get(TAG_USER_NODE_TYPE),
+                "status": status,
+                "ip": provider.internal_ip(node_id),
+            }
+            if tags.get(TAG_NODE_KIND) == NODE_KIND_HEAD:
+                head = info
+            else:
+                by_status[status] = by_status.get(status, 0) + 1
+                workers.append(info)
+        return {
+            "cluster_name": config["cluster_name"],
+            "head": head,
+            "workers": workers,
+            "workers_by_status": by_status,
+        }
+    finally:
+        provider.cleanup()
+
+
+def get_cluster_info(config: Dict[str, Any]) -> Dict[str, Any]:
+    config = bootstrap_config(config)
+    status = get_cluster_status(config)
+    head_ip = status["head"]["ip"] if status.get("head") else None
+    endpoints = {}
+    if head_ip:
+        for runtime in iter_runtimes(config):
+            eps = runtime.get_runtime_endpoints(config, head_ip)
+            if eps:
+                endpoints.update(eps)
+    status["endpoints"] = endpoints
+    status["runtimes"] = list(
+        (config.get("runtime") or {}).get("types") or [])
+    return status
+
+
+def get_head_node_ip(config: Dict[str, Any]) -> Optional[str]:
+    config = bootstrap_config(config)
+    provider = create_node_provider(
+        config["provider"], config["cluster_name"])
+    try:
+        head_id = _find_head(provider, config["cluster_name"])
+        return provider.internal_ip(head_id) if head_id else None
+    finally:
+        provider.cleanup()
+
+
+def get_worker_node_ips(config: Dict[str, Any],
+                        on_head: bool = False) -> List[str]:
+    config = bootstrap_config(config)
+    provider = create_node_provider(
+        config["provider"], config["cluster_name"])
+    try:
+        workers = provider.non_terminated_nodes({
+            TAG_CLUSTER_NAME: config["cluster_name"],
+            TAG_NODE_KIND: NODE_KIND_WORKER,
+        })
+        return [ip for ip in (provider.internal_ip(w) for w in workers)
+                if ip]
+    finally:
+        provider.cleanup()
+
+
+def wait_for_ready(config: Dict[str, Any],
+                   min_workers: Optional[int] = None,
+                   timeout: int = 600) -> None:
+    config = bootstrap_config(config)
+    if min_workers is None:
+        min_workers = sum(
+            nt.get("min_workers", 0)
+            for name, nt in config["available_node_types"].items()
+            if name != config["head_node_type"])
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        status = get_cluster_status(config)
+        ready = [w for w in status["workers"]
+                 if w["status"] == STATUS_UP_TO_DATE]
+        if status.get("head") and len(ready) >= min_workers:
+            return
+        time.sleep(5)
+    raise TimeoutError(
+        f"cluster not ready after {timeout}s (want {min_workers} workers)")
+
+
+def load_head_bootstrap_config(
+        path: str = TIK_BOOTSTRAP_CONFIG_FILE) -> Dict[str, Any]:
+    import yaml
+    with open(os.path.expanduser(path)) as f:
+        return yaml.safe_load(f)
+
+
+def monitor_cluster(config: Dict[str, Any], follow: bool = False) -> str:
+    """Tail controller status from the head state store."""
+    config = bootstrap_config(config)
+    provider = create_node_provider(
+        config["provider"], config["cluster_name"])
+    try:
+        state = _head_state_client(config, provider)
+        status = state.table_get("controller", "status") or {}
+        import json
+        return json.dumps(status, indent=2, default=str)
+    finally:
+        provider.cleanup()

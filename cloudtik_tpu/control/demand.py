@@ -1,0 +1,147 @@
+"""Resource demand scheduler: bin-pack demands onto node types to launch.
+
+Reference parity: core/_private/cluster/resource_demand_scheduler.py
+(ResourceDemandScheduler:50, get_nodes_to_launch:116).  TPU twist: a node
+type marked as an atomic node group (pod slice) is packed at *group*
+granularity — a demand for {"TPU": 8} on a 4-host v5p-32 group launches the
+whole group, never a partial slice.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Tuple
+
+NodeTypeName = str
+
+
+def _fits(demand: Dict[str, float], free: Dict[str, float]) -> bool:
+    return all(free.get(k, 0.0) >= v for k, v in demand.items() if v > 0)
+
+
+def _consume(demand: Dict[str, float], free: Dict[str, float]) -> None:
+    for k, v in demand.items():
+        if v > 0:
+            free[k] = free.get(k, 0.0) - v
+
+
+class ResourceDemandScheduler:
+    def __init__(self, node_types: Dict[NodeTypeName, Dict[str, Any]],
+                 max_workers: int, head_node_type: NodeTypeName):
+        self.node_types = node_types
+        self.max_workers = max_workers
+        self.head_node_type = head_node_type
+
+    def _group_size(self, node_type: str) -> int:
+        group = self.node_types[node_type].get("node_group") or {}
+        if group.get("atomic"):
+            return int(group.get("group_size", 1))
+        return 1
+
+    def _node_resources(self, node_type: str) -> Dict[str, float]:
+        return dict(self.node_types[node_type].get("resources", {}))
+
+    def get_nodes_to_launch(
+        self,
+        existing_counts: Dict[NodeTypeName, int],
+        pending_counts: Dict[NodeTypeName, int],
+        resource_demands: List[Dict[str, float]],
+        free_resources: List[Dict[str, float]],
+    ) -> Dict[NodeTypeName, int]:
+        """How many nodes of each worker type to launch.
+
+        existing/pending counts are per node type; free_resources is the
+        current per-node free capacity list; demands are resource dicts.
+        Returns counts in *nodes* (a multiple of group_size for atomic
+        groups).
+        """
+        to_launch: Dict[NodeTypeName, int] = {}
+
+        # 1. Honor min_workers.
+        for name, nt in self.node_types.items():
+            if name == self.head_node_type:
+                continue
+            have = existing_counts.get(name, 0) + pending_counts.get(name, 0)
+            want = nt.get("min_workers", 0)
+            if have < want:
+                need = want - have
+                gsize = self._group_size(name)
+                # round a partial group up to a full one
+                need = ((need + gsize - 1) // gsize) * gsize
+                to_launch[name] = to_launch.get(name, 0) + need
+
+        # 2. Pack unfulfilled demands.
+        free = [copy.deepcopy(f) for f in free_resources]
+        # capacity already being launched (pending + this pass's min-worker
+        # launches, summed per type — a dict merge would drop one side)
+        in_flight: Dict[NodeTypeName, int] = dict(pending_counts)
+        for name, count in to_launch.items():
+            in_flight[name] = in_flight.get(name, 0) + count
+        for name, count in in_flight.items():
+            for _ in range(count):
+                free.append(self._node_resources(name))
+
+        unfulfilled: List[Dict[str, float]] = []
+        for demand in resource_demands:
+            placed = False
+            for f in free:
+                if _fits(demand, f):
+                    _consume(demand, f)
+                    placed = True
+                    break
+            if not placed:
+                unfulfilled.append(demand)
+
+        for demand in unfulfilled:
+            name = self._pick_node_type(demand)
+            if name is None:
+                continue
+            gsize = self._group_size(name)
+            group_res: Dict[str, float] = {}
+            for k, v in self._node_resources(name).items():
+                group_res[k] = v * gsize
+            if not _fits(demand, group_res):
+                # One group can't hold it; skip (demands must be splittable
+                # upstream into per-group chunks).
+                continue
+            to_launch[name] = to_launch.get(name, 0) + gsize
+            _consume(demand, group_res)
+            # leftover group capacity absorbs later demands
+            free.append(group_res)
+
+        # 3. Cap by max_workers (global and per type), group-aligned.
+        total_existing = sum(
+            v for k, v in existing_counts.items()
+            if k != self.head_node_type)
+        total_pending = sum(pending_counts.values())
+        budget = self.max_workers - total_existing - total_pending
+        result: Dict[NodeTypeName, int] = {}
+        for name, count in to_launch.items():
+            nt = self.node_types[name]
+            have = existing_counts.get(name, 0) + pending_counts.get(name, 0)
+            cap = max(nt.get("max_workers", self.max_workers) - have, 0)
+            count = min(count, cap, max(budget, 0))
+            gsize = self._group_size(name)
+            count = (count // gsize) * gsize
+            if count > 0:
+                result[name] = count
+                budget -= count
+        return result
+
+    def _pick_node_type(self, demand: Dict[str, float]) -> NodeTypeName | None:
+        """Cheapest-fit: the worker type whose single node (or group) covers
+        the demand with the least excess."""
+        best: Tuple[float, str] | None = None
+        for name in self.node_types:
+            if name == self.head_node_type:
+                continue
+            if self.node_types[name].get("max_workers", 0) <= 0:
+                continue
+            gsize = self._group_size(name)
+            res = {k: v * gsize for k, v in self._node_resources(name).items()}
+            if not _fits(demand, res):
+                continue
+            excess = sum(res.values()) - sum(demand.values())
+            if best is None or excess < best[0]:
+                best = (excess, name)
+        return best[1] if best else None

@@ -1,0 +1,67 @@
+"""CommandExecutor — transport for running commands / syncing files on nodes.
+
+Reference parity: core/command_executor.py ABC +
+core/_private/command_executor/ (SSHCommandExecutor
+ssh_command_executor.py:70, DockerCommandExecutor :27,
+LocalCommandExecutor :23).
+"""
+
+from __future__ import annotations
+
+import subprocess
+from typing import Any, Dict, List, Optional
+
+
+class CommandError(RuntimeError):
+    def __init__(self, cmd: str, returncode: int, output: Optional[str] = None):
+        super().__init__(
+            f"command failed (exit {returncode}): {cmd}"
+            + (f"\n{output}" if output else ""))
+        self.cmd = cmd
+        self.returncode = returncode
+        self.output = output
+
+
+class CommandExecutor:
+    def __init__(self, call_context=None):
+        self.call_context = call_context
+
+    def run(
+        self,
+        cmd: str,
+        *,
+        environment_variables: Optional[Dict[str, str]] = None,
+        with_output: bool = False,
+        run_env: str = "auto",
+        timeout: Optional[int] = None,
+        shutdown_after_run: bool = False,
+    ) -> Optional[str]:
+        """Run a shell command on the node.  Raises CommandError on failure;
+        returns captured stdout when with_output."""
+        raise NotImplementedError
+
+    def run_rsync_up(self, source: str, target: str,
+                     options: Optional[Dict[str, Any]] = None) -> None:
+        raise NotImplementedError
+
+    def run_rsync_down(self, source: str, target: str,
+                       options: Optional[Dict[str, Any]] = None) -> None:
+        raise NotImplementedError
+
+    def remote_shell_command_str(self) -> str:
+        """A shell command string that opens an interactive shell."""
+        raise NotImplementedError
+
+    def run_init(self, *, as_head: bool, file_mounts: Dict[str, str],
+                 sync_run_yet: bool) -> Optional[bool]:
+        """Pre-setup hook (e.g. start docker container).  Returns True if it
+        changed node state in a way that requires re-running file sync."""
+        return None
+
+
+def _shell_env_prefix(env: Optional[Dict[str, str]]) -> str:
+    if not env:
+        return ""
+    import shlex
+    parts = [f"export {k}={shlex.quote(str(v))};" for k, v in env.items()]
+    return " ".join(parts) + " "

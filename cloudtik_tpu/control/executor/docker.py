@@ -1,0 +1,88 @@
+"""Docker command executor: wraps another executor with `docker exec`.
+
+Reference parity: command_executor/docker_command_executor.py:27 and
+core/_private/docker.py (with_docker_exec:74).
+"""
+
+from __future__ import annotations
+
+import shlex
+from typing import Any, Dict, Optional
+
+from cloudtik_tpu.control.executor.base import CommandExecutor
+
+
+class DockerCommandExecutor(CommandExecutor):
+    def __init__(self, host_executor: CommandExecutor,
+                 container_name: str,
+                 docker_config: Optional[Dict[str, Any]] = None,
+                 call_context=None):
+        super().__init__(call_context)
+        self.host = host_executor
+        self.container_name = container_name
+        self.docker_config = docker_config or {}
+
+    def _wrap(self, cmd: str,
+              env: Optional[Dict[str, str]] = None) -> str:
+        env_args = ""
+        if env:
+            env_args = " ".join(
+                f"-e {k}={shlex.quote(str(v))}" for k, v in env.items())
+        inner = shlex.quote(f"bash -c {shlex.quote(cmd)}")
+        return (f"docker exec {env_args} {self.container_name} "
+                f"/bin/bash -c {inner}")
+
+    def run(self, cmd, *, environment_variables=None, with_output=False,
+            run_env="auto", timeout=None, shutdown_after_run=False):
+        if run_env == "host":
+            return self.host.run(
+                cmd, environment_variables=environment_variables,
+                with_output=with_output, timeout=timeout)
+        return self.host.run(
+            self._wrap(cmd, environment_variables),
+            with_output=with_output, timeout=timeout,
+            shutdown_after_run=shutdown_after_run)
+
+    def run_rsync_up(self, source, target, options=None):
+        # Host rsync to a staging path, then docker cp into the container.
+        staging = f"/tmp/tik-docker-staging{target}"
+        self.host.run_rsync_up(source, staging, options)
+        self.host.run(
+            f"docker cp {shlex.quote(staging)} "
+            f"{self.container_name}:{shlex.quote(target)}")
+
+    def run_rsync_down(self, source, target, options=None):
+        staging = f"/tmp/tik-docker-staging{source}"
+        self.host.run(
+            f"docker cp {self.container_name}:{shlex.quote(source)} "
+            f"{shlex.quote(staging)}")
+        self.host.run_rsync_down(staging, target, options)
+
+    def remote_shell_command_str(self) -> str:
+        return (self.host.remote_shell_command_str()
+                + f" docker exec -it {self.container_name} /bin/bash")
+
+    def run_init(self, *, as_head: bool, file_mounts: Dict[str, str],
+                 sync_run_yet: bool) -> Optional[bool]:
+        """Ensure the container is running (image pull + docker run)."""
+        image = self.docker_config.get(
+            "head_image" if as_head else "worker_image") or \
+            self.docker_config.get("image")
+        if not image:
+            return None
+        run_options = " ".join(
+            self.docker_config.get("run_options", []) +
+            self.docker_config.get(
+                "head_run_options" if as_head else "worker_run_options", []))
+        mounts = " ".join(
+            f"-v {shlex.quote(path)}:{shlex.quote(path)}"
+            for path in file_mounts)
+        check = (f"docker ps -q -f name=^{self.container_name}$")
+        running = (self.host.run(check, with_output=True) or "").strip()
+        if not running:
+            self.host.run(
+                f"docker run --rm --name {self.container_name} -d --network "
+                f"host {mounts} {run_options} {shlex.quote(image)} "
+                f"sleep infinity")
+            return True
+        return False

@@ -1,0 +1,143 @@
+"""SSH command executor with ControlMaster connection reuse + rsync.
+
+Reference parity: command_executor/ssh_command_executor.py:70 (SSHOptions:25,
+SSHCommandExecutor, _run_helper).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+from typing import Any, Dict, List, Optional
+
+from cloudtik_tpu.control.executor.base import (
+    CommandError, CommandExecutor, _shell_env_prefix)
+
+
+class SSHOptions:
+    def __init__(self, private_key: Optional[str] = None,
+                 control_path: Optional[str] = None,
+                 proxy_command: Optional[str] = None,
+                 port: int = 22,
+                 extra: Optional[Dict[str, str]] = None):
+        self.private_key = private_key
+        self.control_path = control_path
+        self.proxy_command = proxy_command
+        self.port = port
+        self.options = {
+            "StrictHostKeyChecking": "no",
+            "UserKnownHostsFile": os.devnull,
+            "ConnectTimeout": "10s",
+            "ServerAliveInterval": "5",
+            "ServerAliveCountMax": "3",
+            "LogLevel": "ERROR",
+            "IdentitiesOnly": "yes",
+            "ExitOnForwardFailure": "yes",
+            **(extra or {}),
+        }
+
+    def to_ssh_args(self) -> List[str]:
+        args = ["-o", "PasswordAuthentication=no"]
+        if self.private_key:
+            args += ["-i", self.private_key]
+        for k, v in self.options.items():
+            args += ["-o", f"{k}={v}"]
+        if self.control_path:
+            args += [
+                "-o", f"ControlPath={self.control_path}/%C",
+                "-o", "ControlMaster=auto",
+                "-o", "ControlPersist=30s",
+            ]
+        if self.proxy_command:
+            args += ["-o", f"ProxyCommand={self.proxy_command}"]
+        if self.port != 22:
+            args += ["-p", str(self.port)]
+        return args
+
+
+class SSHCommandExecutor(CommandExecutor):
+    def __init__(
+        self,
+        call_context=None,
+        log_prefix: str = "",
+        node_id: str = "",
+        provider=None,
+        ssh_user: str = "root",
+        ssh_ip: Optional[str] = None,
+        ssh_options: Optional[SSHOptions] = None,
+        process_runner=None,
+    ):
+        super().__init__(call_context)
+        self.log_prefix = log_prefix
+        self.node_id = node_id
+        self.provider = provider
+        self.ssh_user = ssh_user
+        self._ssh_ip = ssh_ip
+        self.ssh_options = ssh_options or SSHOptions()
+        self.process_runner = process_runner or subprocess
+
+    @property
+    def ssh_ip(self) -> str:
+        if self._ssh_ip is None:
+            self._ssh_ip = self.provider.internal_ip(self.node_id) or \
+                self.provider.external_ip(self.node_id)
+        return self._ssh_ip
+
+    def _ssh_base(self) -> List[str]:
+        return ["ssh", "-tt"] + self.ssh_options.to_ssh_args()
+
+    def run(self, cmd, *, environment_variables=None, with_output=False,
+            run_env="auto", timeout=None, shutdown_after_run=False):
+        remote_cmd = _shell_env_prefix(environment_variables) + cmd
+        if shutdown_after_run:
+            remote_cmd += "; sudo shutdown -h now"
+        final = self._ssh_base() + [
+            f"{self.ssh_user}@{self.ssh_ip}",
+            f"bash --login -c -i {_quote(f'true && source ~/.bashrc && '
+                                         f'export OMP_NUM_THREADS=1 && '
+                                         + remote_cmd)}",
+        ]
+        try:
+            if with_output:
+                out = self.process_runner.check_output(
+                    final, stderr=subprocess.STDOUT, timeout=timeout)
+                return out.decode() if isinstance(out, bytes) else out
+            self.process_runner.check_call(final, timeout=timeout)
+            return None
+        except subprocess.CalledProcessError as e:
+            raise CommandError(cmd, e.returncode,
+                               getattr(e, "output", None) and str(e.output))
+
+    def _rsync_rsh(self) -> str:
+        return " ".join(["ssh"] + self.ssh_options.to_ssh_args())
+
+    def run_rsync_up(self, source, target, options=None):
+        args = ["rsync", "-avz", "--delete", "-e", self._rsync_rsh(),
+                source, f"{self.ssh_user}@{self.ssh_ip}:{target}"]
+        self.process_runner.check_call(args)
+
+    def run_rsync_down(self, source, target, options=None):
+        args = ["rsync", "-avz", "-e", self._rsync_rsh(),
+                f"{self.ssh_user}@{self.ssh_ip}:{source}", target]
+        self.process_runner.check_call(args)
+
+    def remote_shell_command_str(self) -> str:
+        return " ".join(self._ssh_base() +
+                        [f"{self.ssh_user}@{self.ssh_ip}"])
+
+    def wait_ready(self, deadline_s: float, retry_interval: float = 5.0) -> bool:
+        """Poll `uptime` over SSH until the node answers or deadline."""
+        deadline = time.time() + deadline_s
+        while time.time() < deadline:
+            try:
+                self.run("uptime", with_output=True, timeout=15)
+                return True
+            except Exception:
+                time.sleep(retry_interval)
+        return False
+
+
+def _quote(s: str) -> str:
+    import shlex
+    return shlex.quote(s)

@@ -1,0 +1,125 @@
+"""Async node launcher: background threads creating nodes / node groups.
+
+Reference parity: core/_private/cluster/node_launcher.py
+(BaseNodeLauncher, NodeLauncher(threading.Thread):214).  Extended with
+group-granular launches for atomic TPU pod slices.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from cloudtik_tpu.core.node_provider import (
+    NodeLaunchException, NodeProvider)
+from cloudtik_tpu.core.tags import (
+    NODE_KIND_WORKER, STATUS_UNINITIALIZED, TAG_CLUSTER_NAME,
+    TAG_LAUNCH_CONFIG, TAG_NODE_KIND, TAG_NODE_STATUS, TAG_USER_NODE_TYPE)
+
+logger = logging.getLogger(__name__)
+
+
+class PendingLaunches:
+    """Thread-safe account of launches in flight, per node type."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending: Dict[str, int] = {}
+
+    def inc(self, node_type: str, count: int) -> None:
+        with self._lock:
+            self._pending[node_type] = self._pending.get(node_type, 0) + count
+
+    def dec(self, node_type: str, count: int) -> None:
+        with self._lock:
+            remaining = self._pending.get(node_type, 0) - count
+            if remaining <= 0:
+                self._pending.pop(node_type, None)
+            else:
+                self._pending[node_type] = remaining
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._pending)
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(self._pending.values())
+
+
+class NodeLauncher(threading.Thread):
+    """Consumes (node_type, count) asks from a queue and calls the provider.
+
+    For atomic node-group types the whole count is launched as group(s); for
+    ordinary types create_node is called with the batch count.
+    """
+
+    def __init__(
+        self,
+        provider: NodeProvider,
+        cluster_name: str,
+        config: Dict[str, Any],
+        launch_queue: "queue.Queue[Tuple[str, int]]",
+        pending: PendingLaunches,
+        launch_hashes: Dict[str, str],
+        failure_callback=None,
+        index: int = 0,
+    ):
+        super().__init__(name=f"tik-node-launcher-{index}", daemon=True)
+        self.provider = provider
+        self.cluster_name = cluster_name
+        self.config = config
+        self.queue = launch_queue
+        self.pending = pending
+        self.launch_hashes = launch_hashes
+        self.failure_callback = failure_callback
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                node_type, count = self.queue.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            try:
+                self.launch(node_type, count)
+            except Exception:
+                logger.exception("launch of %d x %s failed", count, node_type)
+            finally:
+                self.pending.dec(node_type, count)
+
+    def launch(self, node_type: str, count: int) -> None:
+        node_types = self.config["available_node_types"]
+        nt = node_types[node_type]
+        node_config = nt.get("node_config", {})
+        tags = {
+            TAG_CLUSTER_NAME: self.cluster_name,
+            TAG_NODE_KIND: NODE_KIND_WORKER,
+            TAG_NODE_STATUS: STATUS_UNINITIALIZED,
+            TAG_USER_NODE_TYPE: node_type,
+            TAG_LAUNCH_CONFIG: self.launch_hashes.get(node_type, ""),
+        }
+        group = nt.get("node_group") or {}
+        try:
+            if group.get("atomic") and self.provider.supports_node_groups():
+                group_size = int(group.get("group_size", 1))
+                n_groups = max(count // group_size, 1)
+                for _ in range(n_groups):
+                    self.provider.create_node_group(
+                        node_config, dict(tags), group_size)
+            else:
+                self.provider.create_node_with_resources_and_labels(
+                    node_config, tags, count,
+                    nt.get("resources", {}), nt.get("labels", {}))
+        except NodeLaunchException as e:
+            logger.error("node launch failed (%s): %s", e.category,
+                         e.description)
+            if self.failure_callback:
+                self.failure_callback(node_type, count, e)
+            raise

@@ -1,0 +1,92 @@
+"""Log agent: tails runtime log dirs, publishes lines to the state store.
+
+Reference parity: core/_private/service/cloudtik_log_agent.py
+(LogMonitor:127, check_log_files_and_publish_updates:362).
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from cloudtik_tpu.control.state import StateClient
+
+logger = logging.getLogger(__name__)
+
+LOG_NS = "logs"
+MAX_LINES_PER_PUBLISH = 200
+
+
+class LogAgent:
+    def __init__(
+        self,
+        state_client: StateClient,
+        node_id: str,
+        log_dirs: Dict[str, str],
+        poll_period_s: float = 2.0,
+    ):
+        self.state = state_client
+        self.node_id = node_id
+        self.log_dirs = log_dirs              # name -> directory
+        self.poll_period_s = poll_period_s
+        self._offsets: Dict[str, int] = {}    # file path -> read offset
+        self._stop = threading.Event()
+        self._seq = 0
+
+    def discover_files(self) -> List[str]:
+        files = []
+        for _name, log_dir in self.log_dirs.items():
+            files.extend(glob.glob(os.path.join(
+                os.path.expanduser(log_dir), "**", "*.log"), recursive=True))
+            files.extend(glob.glob(os.path.join(
+                os.path.expanduser(log_dir), "**", "*.out"), recursive=True))
+        return sorted(set(files))
+
+    def poll_once(self) -> int:
+        """Read new lines from all files and publish; returns lines read."""
+        published = 0
+        for path in self.discover_files():
+            try:
+                size = os.path.getsize(path)
+                offset = self._offsets.get(path, 0)
+                if size < offset:     # rotated
+                    offset = 0
+                if size == offset:
+                    continue
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    chunk = f.read(512 * 1024)
+                    self._offsets[path] = f.tell()
+                lines = chunk.decode(errors="replace").splitlines()
+                for start in range(0, len(lines), MAX_LINES_PER_PUBLISH):
+                    batch = lines[start:start + MAX_LINES_PER_PUBLISH]
+                    self.state.table_put(LOG_NS, f"{self.node_id}:{self._seq}", {
+                        "node_id": self.node_id,
+                        "file": path,
+                        "time": time.time(),
+                        "lines": batch,
+                    })
+                    self._seq += 1
+                    published += len(batch)
+            except OSError:
+                continue
+        return published
+
+    def run_forever(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:
+                logger.exception("log agent poll failed")
+            self._stop.wait(self.poll_period_s)
+
+    def start(self) -> None:
+        threading.Thread(target=self.run_forever, name="tik-log-agent",
+                         daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()

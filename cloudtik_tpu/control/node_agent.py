@@ -1,0 +1,147 @@
+"""Node agent: per-node heartbeat + process + metrics publisher.
+
+Reference parity: core/_private/service/cloudtik_node_agent.py
+(NodeMonitor:32, _heartbeat:161 at 1s, _update_processes:194 psutil scan vs
+Runtime.get_processes, _update_metrics:240).  Publishes into the head state
+server tables instead of Redis.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import psutil
+
+from cloudtik_tpu.control.state import (
+    StateClient, TABLE_HEARTBEAT, TABLE_METRICS, TABLE_PROCESSES)
+from cloudtik_tpu.utils.constants import TIK_HEARTBEAT_PERIOD_S
+
+logger = logging.getLogger(__name__)
+
+
+def collect_node_metrics() -> Dict[str, Any]:
+    vm = psutil.virtual_memory()
+    disk = psutil.disk_usage("/")
+    load = psutil.getloadavg()
+    return {
+        "time": time.time(),
+        "cpu_percent": psutil.cpu_percent(interval=None),
+        "cpu_count": psutil.cpu_count(),
+        "load_avg": list(load),
+        "memory_percent": vm.percent,
+        "memory_total": vm.total,
+        "memory_available": vm.available,
+        "disk_percent": disk.percent,
+        "disk_total": disk.total,
+        "disk_free": disk.free,
+    }
+
+
+def scan_processes(
+    process_specs: List[Tuple[str, bool, str, str]]
+) -> Dict[str, Dict[str, Any]]:
+    """Match running processes against runtime specs
+    (keyword, match_cmdline, friendly_name, node_kind)."""
+    found: Dict[str, Dict[str, Any]] = {}
+    for proc in psutil.process_iter(["pid", "name", "cmdline", "status"]):
+        try:
+            info = proc.info
+            cmdline = " ".join(info.get("cmdline") or [])
+            for keyword, match_cmdline, friendly, _kind in process_specs:
+                haystack = cmdline if match_cmdline else (info["name"] or "")
+                if keyword in haystack:
+                    found[friendly] = {
+                        "pid": info["pid"],
+                        "status": info["status"],
+                    }
+        except (psutil.NoSuchProcess, psutil.AccessDenied):
+            continue
+    return found
+
+
+class NodeAgent:
+    """Runs on every node; heartbeats + metrics into the state store."""
+
+    def __init__(
+        self,
+        state_client: StateClient,
+        node_id: str,
+        node_ip: Optional[str] = None,
+        process_specs: Optional[List[Tuple[str, bool, str, str]]] = None,
+        heartbeat_period_s: float = TIK_HEARTBEAT_PERIOD_S,
+        metrics_period_s: float = 5.0,
+        total_resources: Optional[Dict[str, float]] = None,
+    ):
+        self.state = state_client
+        self.node_id = node_id
+        self.node_ip = node_ip or _local_ip()
+        self.process_specs = process_specs or []
+        self.heartbeat_period_s = heartbeat_period_s
+        self.metrics_period_s = metrics_period_s
+        self.total_resources = total_resources or {
+            "CPU": float(psutil.cpu_count() or 1),
+            "memory": float(psutil.virtual_memory().total),
+        }
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def heartbeat_once(self) -> None:
+        self.state.table_put(TABLE_HEARTBEAT, self.node_id, {
+            "node_id": self.node_id,
+            "node_ip": self.node_ip,
+            "time": time.time(),
+        })
+
+    def publish_metrics_once(self) -> None:
+        metrics = collect_node_metrics()
+        metrics["node_id"] = self.node_id
+        metrics["node_ip"] = self.node_ip
+        cpu_free = self.total_resources.get("CPU", 0) * \
+            (1.0 - metrics["cpu_percent"] / 100.0)
+        metrics["total_resources"] = self.total_resources
+        metrics["available_resources"] = {
+            "CPU": round(cpu_free, 2),
+            "memory": float(metrics["memory_available"]),
+        }
+        self.state.table_put(TABLE_METRICS, self.node_id, metrics)
+        if self.process_specs:
+            self.state.table_put(
+                TABLE_PROCESSES, self.node_id,
+                {"time": time.time(),
+                 "processes": scan_processes(self.process_specs)})
+
+    def run_forever(self) -> None:
+        last_metrics = 0.0
+        while not self._stop.is_set():
+            try:
+                self.heartbeat_once()
+                now = time.time()
+                if now - last_metrics >= self.metrics_period_s:
+                    self.publish_metrics_once()
+                    last_metrics = now
+            except Exception:
+                logger.exception("node agent publish failed")
+            self._stop.wait(self.heartbeat_period_s)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run_forever, name="tik-node-agent", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def _local_ip() -> str:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"

@@ -1,0 +1,185 @@
+"""Node services: boot/stop the head & worker daemons.
+
+Reference parity: core/_private/node/node_services.py
+(NodeServicesStarter:41, start_head_processes:616 reaper→redis→controller,
+start_node_processes:631) + core/_private/services.py (process spawn/track).
+
+Head boots: state server (replaces Redis) → controller (scaler loop) →
+node agent → log agent.  Workers boot: node agent → log agent.  All daemons
+run as threads of one `tik node start` process (simpler than the
+reference's process zoo; the process reaper's fate-sharing is inherited
+from the single-process design).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from cloudtik_tpu.control.controller import ClusterController
+from cloudtik_tpu.control.log_agent import LogAgent
+from cloudtik_tpu.control.node_agent import NodeAgent
+from cloudtik_tpu.control.state import (
+    FileStateBackend, StateClient, StateServer, TcpStateBackend)
+from cloudtik_tpu.providers.factory import create_node_provider
+from cloudtik_tpu.runtimes.registry import iter_runtimes
+from cloudtik_tpu.utils.constants import (
+    TIK_BOOTSTRAP_CONFIG_FILE, TIK_LOGS_DIR, TIK_RUN_DIR,
+    TIK_STATE_PORT_DEFAULT)
+
+logger = logging.getLogger(__name__)
+
+
+def write_bootstrap_config(config: Dict[str, Any],
+                           path: str = TIK_BOOTSTRAP_CONFIG_FILE) -> str:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        yaml.safe_dump(config, f)
+    return path
+
+
+def load_bootstrap_config(
+        path: str = TIK_BOOTSTRAP_CONFIG_FILE) -> Dict[str, Any]:
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+class NodeServicesStarter:
+    def __init__(
+        self,
+        config: Dict[str, Any],
+        node_id: str,
+        *,
+        is_head: bool,
+        head_ip: str = "127.0.0.1",
+        state_port: int = TIK_STATE_PORT_DEFAULT,
+    ):
+        self.config = config
+        self.node_id = node_id
+        self.is_head = is_head
+        self.head_ip = head_ip
+        self.state_port = state_port
+        self.state_server: Optional[StateServer] = None
+        self.controller: Optional[ClusterController] = None
+        self.node_agent: Optional[NodeAgent] = None
+        self.log_agent: Optional[LogAgent] = None
+        self.state_client: Optional[StateClient] = None
+
+    # ------------------------------------------------------------------
+    def start_head_processes(self) -> None:
+        os.makedirs(os.path.expanduser(TIK_RUN_DIR), exist_ok=True)
+        backend = FileStateBackend(
+            os.path.join(os.path.expanduser(TIK_RUN_DIR), "state"))
+        self.state_server = StateServer(
+            port=self.state_port, backend=backend)
+        self.state_server.start()
+        self.state_client = StateClient(backend)
+
+        # cluster info into KV (reference node_services.py:641)
+        self.state_client.table_put("cluster", "info", {
+            "cluster_name": self.config["cluster_name"],
+            "workspace_name": self.config.get("workspace_name", ""),
+            "head_node_id": self.node_id,
+            "head_ip": self.head_ip,
+            "started_at": time.time(),
+        })
+
+        provider = create_node_provider(
+            self.config["provider"], self.config["cluster_name"])
+        runtimes = iter_runtimes(self.config)
+        node_constraints = {}
+        scaling_policies = []
+        for runtime in runtimes:
+            for node_type in self.config.get("available_node_types", {}):
+                constraint = runtime.get_node_constraints(
+                    self.config, node_type)
+                if constraint:
+                    node_constraints[node_type] = constraint
+            policy = runtime.get_scaling_policy(self.config, self.head_ip)
+            if policy:
+                scaling_policies.append(policy)
+
+        self.controller = ClusterController(
+            self.config, provider, self.state_client,
+            scaling_policies=scaling_policies,
+            node_constraints=node_constraints,
+            metrics_port=self.config.get("controller_metrics_port"))
+        self.controller.start()
+        self._start_common_agents()
+
+    def start_node_processes(self) -> None:
+        self.state_client = StateClient(
+            TcpStateBackend(self.head_ip, self.state_port))
+        self._start_common_agents()
+
+    def _start_common_agents(self) -> None:
+        runtimes = iter_runtimes(self.config)
+        process_specs = []
+        log_dirs: Dict[str, str] = {"tik": TIK_LOGS_DIR}
+        for runtime in runtimes:
+            specs = runtime.get_processes()
+            if specs:
+                process_specs.extend(specs)
+            log_dirs.update(runtime.get_logs())
+            node_context = {
+                "is_head": self.is_head,
+                "head_ip": self.head_ip,
+                "config": self.config,
+            }
+            try:
+                runtime.node_configure(node_context)
+                runtime.node_services(node_context, "start")
+            except Exception:
+                logger.exception("runtime %s start failed",
+                                 type(runtime).__name__)
+        self.node_agent = NodeAgent(
+            self.state_client, self.node_id, node_ip=self.head_ip
+            if self.is_head else None, process_specs=process_specs)
+        self.node_agent.start()
+        self.log_agent = LogAgent(self.state_client, self.node_id, log_dirs)
+        self.log_agent.start()
+
+    # ------------------------------------------------------------------
+    def stop(self) -> None:
+        runtimes = iter_runtimes(self.config)
+        node_context = {"is_head": self.is_head, "head_ip": self.head_ip,
+                        "config": self.config}
+        for runtime in runtimes:
+            try:
+                runtime.node_services(node_context, "stop")
+            except Exception:
+                pass
+        for svc in (self.node_agent, self.log_agent, self.controller):
+            if svc:
+                svc.stop()
+        if self.state_server:
+            self.state_server.stop()
+
+    def run_until_signal(self) -> None:
+        stop_event = threading.Event()
+
+        def _handler(_sig, _frame):
+            stop_event.set()
+
+        signal.signal(signal.SIGTERM, _handler)
+        signal.signal(signal.SIGINT, _handler)
+        pid_file = os.path.join(os.path.expanduser(TIK_RUN_DIR),
+                                "node-services.pid")
+        os.makedirs(os.path.dirname(pid_file), exist_ok=True)
+        with open(pid_file, "w") as f:
+            f.write(str(os.getpid()))
+        try:
+            stop_event.wait()
+        finally:
+            self.stop()
+            try:
+                os.unlink(pid_file)
+            except OSError:
+                pass

@@ -1,0 +1,341 @@
+"""Cluster state store: namespaced KV + tables, served from the head node.
+
+Reference parity: core/_private/state/ (StateClient control_state.py:37,
+ControlState :151, StateTableStore, kv_store.py, file_state_store.py:26).
+The reference ran Redis on the head (services.py:512, port 6789); this build
+ships its own small state server — a msgpack-over-TCP KV with namespaced
+tables — so clusters have zero external-daemon dependencies.  Three
+backends, one client API:
+
+  * InMemoryStateBackend — unit tests / single-process.
+  * FileStateBackend    — local/virtual providers (survives restarts).
+  * TcpStateBackend     — head-node server (StateServer) + client.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import msgpack
+
+from cloudtik_tpu.utils.constants import TIK_STATE_PORT_DEFAULT
+
+# Well-known table names (reference: control_state.py:142-146).
+TABLE_NODES = "nodes"
+TABLE_PROCESSES = "processes"
+TABLE_METRICS = "metrics"
+TABLE_HEARTBEAT = "heartbeat"
+TABLE_SCALING = "scaling"
+TABLE_SERVICES = "services"
+TABLE_USER = "user"
+
+
+class StateBackend:
+    """KV with (namespace, key) addressing; values are bytes."""
+
+    def put(self, ns: str, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, ns: str, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def delete(self, ns: str, key: str) -> bool:
+        raise NotImplementedError
+
+    def keys(self, ns: str, prefix: str = "") -> List[str]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryStateBackend(StateBackend):
+    def __init__(self):
+        self._data: Dict[str, Dict[str, bytes]] = {}
+        self._lock = threading.RLock()
+
+    def put(self, ns, key, value):
+        with self._lock:
+            self._data.setdefault(ns, {})[key] = value
+
+    def get(self, ns, key):
+        with self._lock:
+            return self._data.get(ns, {}).get(key)
+
+    def delete(self, ns, key):
+        with self._lock:
+            return self._data.get(ns, {}).pop(key, None) is not None
+
+    def keys(self, ns, prefix=""):
+        with self._lock:
+            return sorted(k for k in self._data.get(ns, {}) if
+                          k.startswith(prefix))
+
+
+class FileStateBackend(StateBackend):
+    """One JSON file per namespace under a root dir, with a process lock.
+
+    Reference parity: file_state_store.py:26 (TransactionContext file locks).
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.RLock()
+
+    def _path(self, ns: str) -> str:
+        safe = ns.replace("/", "_")
+        return os.path.join(self.root, f"{safe}.json")
+
+    def _load(self, ns: str) -> Dict[str, str]:
+        try:
+            with open(self._path(ns)) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return {}
+
+    def _store(self, ns: str, data: Dict[str, str]) -> None:
+        tmp = self._path(ns) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, self._path(ns))
+
+    def put(self, ns, key, value):
+        with self._lock:
+            data = self._load(ns)
+            data[key] = value.hex()
+            self._store(ns, data)
+
+    def get(self, ns, key):
+        with self._lock:
+            v = self._load(ns).get(key)
+            return bytes.fromhex(v) if v is not None else None
+
+    def delete(self, ns, key):
+        with self._lock:
+            data = self._load(ns)
+            existed = data.pop(key, None) is not None
+            if existed:
+                self._store(ns, data)
+            return existed
+
+    def keys(self, ns, prefix=""):
+        with self._lock:
+            return sorted(k for k in self._load(ns) if k.startswith(prefix))
+
+
+# --------------------------------------------------------------------------
+# TCP server + client backend
+# --------------------------------------------------------------------------
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = msgpack.packb(obj, use_bin_type=True)
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    header = _recv_exact(sock, 4)
+    (length,) = struct.unpack(">I", header)
+    if length > 64 * 2 ** 20:
+        raise ValueError(f"message too large: {length}")
+    return msgpack.unpackb(_recv_exact(sock, length), raw=False)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+class _StateRequestHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        backend: StateBackend = self.server.backend  # type: ignore
+        token: Optional[str] = self.server.auth_token  # type: ignore
+        try:
+            while True:
+                req = _recv_msg(self.request)
+                if token and req.get("token") != token:
+                    _send_msg(self.request, {"ok": False,
+                                             "error": "unauthorized"})
+                    continue
+                op = req.get("op")
+                try:
+                    if op == "put":
+                        backend.put(req["ns"], req["key"], req["value"])
+                        resp = {"ok": True}
+                    elif op == "get":
+                        resp = {"ok": True,
+                                "value": backend.get(req["ns"], req["key"])}
+                    elif op == "delete":
+                        resp = {"ok": True,
+                                "deleted": backend.delete(req["ns"],
+                                                          req["key"])}
+                    elif op == "keys":
+                        resp = {"ok": True,
+                                "keys": backend.keys(req["ns"],
+                                                     req.get("prefix", ""))}
+                    elif op == "ping":
+                        resp = {"ok": True, "time": time.time()}
+                    else:
+                        resp = {"ok": False, "error": f"bad op {op!r}"}
+                except Exception as e:  # surface backend errors to client
+                    resp = {"ok": False, "error": str(e)}
+                _send_msg(self.request, resp)
+        except (ConnectionError, OSError):
+            return
+
+
+class StateServer:
+    """Head-node state server (threaded TCP)."""
+
+    def __init__(self, host: str = "0.0.0.0",
+                 port: int = TIK_STATE_PORT_DEFAULT,
+                 backend: Optional[StateBackend] = None,
+                 auth_token: Optional[str] = None):
+        self.backend = backend or InMemoryStateBackend()
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _StateRequestHandler)
+        self._server.backend = self.backend  # type: ignore
+        self._server.auth_token = auth_token  # type: ignore
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="tik-state-server",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class TcpStateBackend(StateBackend):
+    """Client to a StateServer; reconnects on error."""
+
+    def __init__(self, host: str, port: int = TIK_STATE_PORT_DEFAULT,
+                 auth_token: Optional[str] = None, timeout: float = 10.0):
+        self.host, self.port = host, port
+        self.auth_token = auth_token
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            sock = socket.create_connection(
+                (self.host, self.port), timeout=self.timeout)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = sock
+        return self._sock
+
+    def _call(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        if self.auth_token:
+            req["token"] = self.auth_token
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    sock = self._connect()
+                    _send_msg(sock, req)
+                    resp = _recv_msg(sock)
+                    break
+                except (ConnectionError, OSError):
+                    self.close_nolock()
+                    if attempt:
+                        raise
+            if not resp.get("ok"):
+                raise RuntimeError(f"state op failed: {resp.get('error')}")
+            return resp
+
+    def put(self, ns, key, value):
+        self._call({"op": "put", "ns": ns, "key": key, "value": value})
+
+    def get(self, ns, key):
+        return self._call({"op": "get", "ns": ns, "key": key}).get("value")
+
+    def delete(self, ns, key):
+        return self._call({"op": "delete", "ns": ns, "key": key})["deleted"]
+
+    def keys(self, ns, prefix=""):
+        return self._call({"op": "keys", "ns": ns, "prefix": prefix})["keys"]
+
+    def ping(self) -> bool:
+        try:
+            return self._call({"op": "ping"})["ok"]
+        except Exception:
+            return False
+
+    def close_nolock(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def close(self):
+        with self._lock:
+            self.close_nolock()
+
+
+# --------------------------------------------------------------------------
+# High-level client
+# --------------------------------------------------------------------------
+
+class StateClient:
+    """Typed access over a backend: JSON object tables + raw KV.
+
+    Reference parity: StateClient control_state.py:37 (kv_get/put/del/keys
+    with namespaces) + StateTableStore.
+    """
+
+    def __init__(self, backend: StateBackend):
+        self.backend = backend
+
+    # raw kv
+    def kv_put(self, key: str, value: bytes, ns: str = TABLE_USER) -> None:
+        self.backend.put(ns, key, value)
+
+    def kv_get(self, key: str, ns: str = TABLE_USER) -> Optional[bytes]:
+        return self.backend.get(ns, key)
+
+    def kv_delete(self, key: str, ns: str = TABLE_USER) -> bool:
+        return self.backend.delete(ns, key)
+
+    def kv_keys(self, prefix: str = "", ns: str = TABLE_USER) -> List[str]:
+        return self.backend.keys(ns, prefix)
+
+    # object tables
+    def table_put(self, table: str, key: str, obj: Dict[str, Any]) -> None:
+        self.backend.put(table, key, msgpack.packb(obj, use_bin_type=True))
+
+    def table_get(self, table: str, key: str) -> Optional[Dict[str, Any]]:
+        raw = self.backend.get(table, key)
+        return None if raw is None else msgpack.unpackb(raw, raw=False)
+
+    def table_delete(self, table: str, key: str) -> bool:
+        return self.backend.delete(table, key)
+
+    def table_list(self, table: str,
+                   prefix: str = "") -> Dict[str, Dict[str, Any]]:
+        out = {}
+        for key in self.backend.keys(table, prefix):
+            raw = self.backend.get(table, key)
+            if raw is not None:
+                out[key] = msgpack.unpackb(raw, raw=False)
+        return out

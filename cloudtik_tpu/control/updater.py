@@ -1,0 +1,154 @@
+"""Node updater: per-node bootstrap over the command executor.
+
+Reference parity: core/_private/node/node_updater.py (NodeUpdater:41,
+run:151, do_update:433, wait_ready:290, sync_file_mounts:217,
+NodeUpdaterThread:791).
+
+Lifecycle (status tag transitions):
+    uninitialized -> waiting-for-ssh -> syncing-files -> setting-up ->
+    up-to-date | update-failed
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from cloudtik_tpu.control.executor.base import CommandError, CommandExecutor
+from cloudtik_tpu.core.node_provider import NodeProvider
+from cloudtik_tpu.core.tags import (
+    STATUS_SETTING_UP, STATUS_SYNCING_FILES, STATUS_UPDATE_FAILED,
+    STATUS_UP_TO_DATE, STATUS_WAITING_FOR_SSH, TAG_FILE_MOUNTS_CONTENTS,
+    TAG_NODE_STATUS, TAG_RUNTIME_CONFIG)
+from cloudtik_tpu.utils.constants import TIK_NODE_START_WAIT_S
+
+logger = logging.getLogger(__name__)
+
+
+class NodeUpdater:
+    def __init__(
+        self,
+        node_id: str,
+        provider: NodeProvider,
+        executor: CommandExecutor,
+        *,
+        file_mounts: Optional[Dict[str, str]] = None,
+        initialization_commands: Optional[List[str]] = None,
+        setup_commands: Optional[List[str]] = None,
+        start_commands: Optional[List[str]] = None,
+        runtime_hash: str = "",
+        file_mounts_contents_hash: Optional[str] = None,
+        environment_variables: Optional[Dict[str, str]] = None,
+        is_head_node: bool = False,
+        wait_ready_timeout_s: int = TIK_NODE_START_WAIT_S,
+        restart_only: bool = False,
+        no_restart: bool = False,
+    ):
+        self.node_id = node_id
+        self.provider = provider
+        self.executor = executor
+        self.file_mounts = file_mounts or {}
+        self.initialization_commands = initialization_commands or []
+        self.setup_commands = setup_commands or []
+        self.start_commands = start_commands or []
+        self.runtime_hash = runtime_hash
+        self.file_mounts_contents_hash = file_mounts_contents_hash
+        self.environment_variables = environment_variables or {}
+        self.is_head_node = is_head_node
+        self.wait_ready_timeout_s = wait_ready_timeout_s
+        self.restart_only = restart_only
+        self.no_restart = no_restart
+        self.error: Optional[Exception] = None
+
+    def _set_status(self, status: str) -> None:
+        self.provider.set_node_tags(self.node_id, {TAG_NODE_STATUS: status})
+
+    def run(self) -> None:
+        try:
+            self.do_update()
+        except Exception as e:
+            self.error = e
+            try:
+                self._set_status(STATUS_UPDATE_FAILED)
+            except Exception:
+                pass
+            logger.exception("node %s update failed", self.node_id)
+            raise
+
+    def wait_ready(self) -> None:
+        self._set_status(STATUS_WAITING_FOR_SSH)
+        deadline = time.time() + self.wait_ready_timeout_s
+        last_error: Optional[Exception] = None
+        while time.time() < deadline:
+            if self.provider.is_terminated(self.node_id):
+                raise RuntimeError(
+                    f"node {self.node_id} terminated while waiting for boot")
+            try:
+                self.executor.run("uptime", with_output=True, timeout=20)
+                return
+            except Exception as e:
+                last_error = e
+                time.sleep(5)
+        raise TimeoutError(
+            f"node {self.node_id} not reachable after "
+            f"{self.wait_ready_timeout_s}s: {last_error}")
+
+    def sync_file_mounts(self) -> None:
+        self._set_status(STATUS_SYNCING_FILES)
+        for remote, local in sorted(self.file_mounts.items()):
+            self.executor.run_rsync_up(local, remote)
+
+    def do_update(self) -> None:
+        self.wait_ready()
+
+        changed = self.executor.run_init(
+            as_head=self.is_head_node, file_mounts=self.file_mounts,
+            sync_run_yet=False)
+        self.sync_file_mounts()
+        if changed:
+            self.sync_file_mounts()
+
+        if not self.restart_only:
+            self._set_status(STATUS_SETTING_UP)
+            for cmd in self.initialization_commands:
+                self.executor.run(
+                    cmd, environment_variables=self.environment_variables,
+                    run_env="host")
+            for cmd in self.setup_commands:
+                self.executor.run(
+                    cmd, environment_variables=self.environment_variables)
+
+        if not self.no_restart:
+            for cmd in self.start_commands:
+                self.executor.run(
+                    cmd, environment_variables=self.environment_variables)
+
+        tags = {
+            TAG_NODE_STATUS: STATUS_UP_TO_DATE,
+            TAG_RUNTIME_CONFIG: self.runtime_hash,
+        }
+        if self.file_mounts_contents_hash is not None:
+            tags[TAG_FILE_MOUNTS_CONTENTS] = self.file_mounts_contents_hash
+        self.provider.set_node_tags(self.node_id, tags)
+
+
+class NodeUpdaterThread(NodeUpdater, threading.Thread):
+    def __init__(self, *args, **kwargs):
+        threading.Thread.__init__(self, daemon=True)
+        NodeUpdater.__init__(self, *args, **kwargs)
+        self.exitcode = -1
+
+    def run(self) -> None:  # type: ignore[override]
+        try:
+            self.do_update()
+            self.exitcode = 0
+        except Exception as e:
+            self.error = e
+            try:
+                self._set_status(STATUS_UPDATE_FAILED)
+            except Exception:
+                pass
+            self.exitcode = 1
+            logger.exception("node %s update failed", self.node_id)

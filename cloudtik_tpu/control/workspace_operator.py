@@ -1,0 +1,70 @@
+"""Workspace operator: create/delete/update/status verbs.
+
+Reference parity: core/_private/workspace/workspace_operator.py.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from cloudtik_tpu.core.workspace_provider import Existence
+from cloudtik_tpu.providers.factory import create_workspace_provider
+from cloudtik_tpu.utils.cli_logger import cli_logger
+
+logger = logging.getLogger(__name__)
+
+
+def create_workspace(config: Dict[str, Any], yes: bool = False) -> None:
+    provider = create_workspace_provider(
+        config["provider"], config["workspace_name"])
+    existence = provider.check_workspace_existence(config)
+    if existence == Existence.COMPLETED:
+        cli_logger.info("Workspace {} already exists.",
+                        config["workspace_name"])
+        return
+    cli_logger.confirm(yes, "Create workspace {}?", config["workspace_name"])
+    provider.create_workspace(config)
+    cli_logger.success("Workspace {} created.", config["workspace_name"])
+
+
+def delete_workspace(
+    config: Dict[str, Any], yes: bool = False,
+    delete_managed_storage: bool = False,
+    delete_managed_database: bool = False,
+) -> None:
+    provider = create_workspace_provider(
+        config["provider"], config["workspace_name"])
+    existence = provider.check_workspace_existence(config)
+    if existence == Existence.NOT_EXIST:
+        cli_logger.info("Workspace {} does not exist.",
+                        config["workspace_name"])
+        return
+    cli_logger.confirm(yes, "Delete workspace {}?", config["workspace_name"])
+    provider.delete_workspace(
+        config, delete_managed_storage=delete_managed_storage,
+        delete_managed_database=delete_managed_database)
+    cli_logger.success("Workspace {} deleted.", config["workspace_name"])
+
+
+def update_workspace(config: Dict[str, Any], yes: bool = False) -> None:
+    provider = create_workspace_provider(
+        config["provider"], config["workspace_name"])
+    cli_logger.confirm(yes, "Update workspace {}?", config["workspace_name"])
+    provider.update_workspace(config)
+    cli_logger.success("Workspace {} updated.", config["workspace_name"])
+
+
+def get_workspace_status(config: Dict[str, Any]) -> Dict[str, Any]:
+    provider = create_workspace_provider(
+        config["provider"], config["workspace_name"])
+    existence = provider.check_workspace_existence(config)
+    info = provider.get_workspace_info(config)
+    return {"existence": existence.name, **info}
+
+
+def list_workspace_clusters(
+        config: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    provider = create_workspace_provider(
+        config["provider"], config["workspace_name"])
+    return provider.list_clusters(config)

@@ -1,0 +1,78 @@
+"""Distributor: normalize host/process topology for a distributed launch.
+
+Reference parity: runner/util/distributor.py:141 (num_proc / nnodes /
+nproc_per_node / hosts / hostfile normalization, "host:slots" syntax).
+TPU semantics differ: ONE process per host (the SPMD program owns all local
+chips), so nproc_per_node is about *hosts in a slice*, not CPU ranks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional, Sequence
+
+
+@dataclasses.dataclass
+class HostSpec:
+    address: str
+    slots: int = 1          # informational; one launch per host on TPU
+
+    @staticmethod
+    def parse(text: str) -> "HostSpec":
+        # accepted: "host", "host:slots"
+        if ":" in text:
+            host, slots = text.rsplit(":", 1)
+            return HostSpec(host.strip(), int(slots))
+        return HostSpec(text.strip())
+
+
+class Distributor:
+    def __init__(
+        self,
+        hosts: Optional[Sequence[str]] = None,
+        hostfile: Optional[str] = None,
+        num_nodes: Optional[int] = None,
+        coordinator_port: int = 8476,
+    ):
+        specs: List[HostSpec] = []
+        if hostfile:
+            with open(os.path.expanduser(hostfile)) as f:
+                for line in f:
+                    line = line.strip()
+                    if line and not line.startswith("#"):
+                        specs.append(HostSpec.parse(line))
+        if hosts:
+            for h in hosts:
+                for part in str(h).split(","):
+                    if part.strip():
+                        specs.append(HostSpec.parse(part))
+        if not specs:
+            specs = [HostSpec("127.0.0.1")]
+        if num_nodes is not None:
+            if num_nodes > len(specs):
+                raise ValueError(
+                    f"num_nodes={num_nodes} > available hosts {len(specs)}")
+            specs = specs[:num_nodes]
+        self.hosts = specs
+        self.coordinator_port = coordinator_port
+
+    @property
+    def num_processes(self) -> int:
+        return len(self.hosts)
+
+    @property
+    def coordinator_address(self) -> str:
+        return f"{self.hosts[0].address}:{self.coordinator_port}"
+
+    def distributed(self) -> bool:
+        return self.num_processes > 1
+
+    def env_for(self, process_index: int) -> dict:
+        """Env exported to the program on host `process_index` — consumed by
+        cloudtik_tpu.parallel.distributed.auto_initialize."""
+        return {
+            "TIK_COORDINATOR_ADDRESS": self.coordinator_address,
+            "TIK_NUM_PROCESSES": str(self.num_processes),
+            "TIK_PROCESS_ID": str(process_index),
+        }

@@ -1,0 +1,119 @@
+"""`tik-run` — the distributed-training launcher.
+
+Reference parity: runtime/ai/runner/launch.py:261 (`cloudtik-run`), with the
+launcher-zoo (local/mpi/rsh/horovod, launcher_factory.py:23) collapsed to
+ONE model: start the same SPMD program on every slice host over SSH (or
+locally), exporting TIK_COORDINATOR_* env that
+cloudtik_tpu.parallel.distributed.auto_initialize consumes.  The mpirun /
+gloo / oneCCL data plane does not exist here — in-program XLA collectives
+replace it (SURVEY.md §3.4 TPU mapping).
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import sys
+import threading
+from typing import List, Optional
+
+import click
+
+from cloudtik_tpu.launch.distributor import Distributor
+from cloudtik_tpu.utils.cli_logger import cli_logger
+
+
+def _local_launch(program: List[str], env: dict) -> int:
+    full_env = {**os.environ, **env}
+    proc = subprocess.Popen(program, env=full_env)
+    return proc.wait()
+
+
+def _ssh_launch(host: str, program: List[str], env: dict,
+                ssh_user: Optional[str], ssh_key: Optional[str],
+                output_prefix: str) -> subprocess.Popen:
+    env_prefix = " ".join(
+        f"{k}={shlex.quote(str(v))}" for k, v in env.items())
+    remote_cmd = f"{env_prefix} {' '.join(shlex.quote(a) for a in program)}"
+    ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no",
+               "-o", "UserKnownHostsFile=/dev/null", "-o", "LogLevel=ERROR"]
+    if ssh_key:
+        ssh_cmd += ["-i", ssh_key]
+    target = f"{ssh_user}@{host}" if ssh_user else host
+    ssh_cmd += [target, remote_cmd]
+    proc = subprocess.Popen(
+        ssh_cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+    def _pump():
+        for line in proc.stdout:  # type: ignore[union-attr]
+            sys.stdout.write(f"{output_prefix}{line}")
+
+    threading.Thread(target=_pump, daemon=True).start()
+    return proc
+
+
+def resolve_cluster_hosts() -> List[str]:
+    """Hosts of this node's slice, from tik-exported env (AI runtime) or the
+    TPU VM metadata hostnames."""
+    hosts = os.environ.get("TIK_SLICE_HOSTS")
+    if hosts:
+        return [h for h in hosts.split(",") if h]
+    tpu_hosts = os.environ.get("TPU_WORKER_HOSTNAMES")
+    if tpu_hosts:
+        return [h for h in tpu_hosts.split(",") if h]
+    return []
+
+
+@click.command(context_settings={"ignore_unknown_options": True})
+@click.option("--hosts", default=None,
+              help="Comma-separated hosts (default: this slice's hosts).")
+@click.option("--hostfile", default=None, type=click.Path(exists=True))
+@click.option("--num-nodes", "-n", default=None, type=int,
+              help="Limit to the first N hosts.")
+@click.option("--coordinator-port", default=8476, type=int)
+@click.option("--ssh-user", default=None)
+@click.option("--ssh-key", default=None)
+@click.option("--python", "python_bin", default=sys.executable)
+@click.argument("program", nargs=-1, required=True,
+                type=click.UNPROCESSED)
+def main(hosts, hostfile, num_nodes, coordinator_port, ssh_user, ssh_key,
+         python_bin, program):
+    """Launch PROGRAM (a python script + args) across the slice."""
+    host_list = [h for h in (hosts or "").split(",") if h] or \
+        resolve_cluster_hosts()
+    dist = Distributor(
+        hosts=host_list or None, hostfile=hostfile, num_nodes=num_nodes,
+        coordinator_port=coordinator_port)
+
+    program = list(program)
+    if program and program[0].endswith(".py"):
+        program = [python_bin] + program
+
+    if not dist.distributed():
+        cli_logger.info("tik-run: single host")
+        raise SystemExit(_local_launch(program, dist.env_for(0)))
+
+    cli_logger.info(
+        "tik-run: launching on {} hosts (coordinator {})",
+        dist.num_processes, dist.coordinator_address)
+    procs = []
+    for idx, spec in enumerate(dist.hosts):
+        env = dist.env_for(idx)
+        prefix = f"[{idx}:{spec.address}] "
+        procs.append(_ssh_launch(
+            spec.address, program, env, ssh_user, ssh_key, prefix))
+    exit_code = 0
+    try:
+        for proc in procs:
+            code = proc.wait()
+            exit_code = exit_code or code
+    except KeyboardInterrupt:
+        for proc in procs:
+            proc.terminate()
+        exit_code = 130
+    raise SystemExit(exit_code)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,260 @@
+"""Decoder-only transformer (Llama-family) — the flagship training model.
+
+TPU-first design decisions:
+  * Functional params-as-pytree (no framework Module state): every array is
+    annotated with *logical axes* consumed by parallel/sharding.py, so the
+    same model runs FSDP / TP / SP / DP by swapping rules, not code.
+  * Layers are a single stacked pytree scanned with `lax.scan` — one traced
+    layer body, O(1) compile time in depth, and `jax.checkpoint` applied to
+    the scanned body for rematerialization.
+  * Attention dispatches to the Pallas flash kernel on TPU (ops/attention.py).
+  * All matmuls run in bf16 with f32 accumulation; loss/softmax in f32.
+
+Replaces the reference's vendored torch model zoo path (SURVEY.md §2.8
+applications/ai/quickstart — BERT/Llama recipes driven by torch-DDP); here
+the model is a native JAX program sharded by GSPMD.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cloudtik_tpu.ops.attention import attention
+from cloudtik_tpu.parallel.sharding import with_sharding_constraint
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    d_ff: int = 11_008
+    max_seq_len: int = 4096
+    rope_theta: float = 10_000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16          # activation/compute dtype
+    param_dtype: Any = jnp.float32     # master param dtype
+    tie_embeddings: bool = False
+    remat: bool = True                 # rematerialize each layer in backward
+    attention_impl: Optional[str] = None  # None=auto, "flash", "reference"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def flops_per_token(self) -> float:
+        """Approximate training FLOPs per token (fwd+bwd), 6N + attention."""
+        n_params = self.num_params(include_embed=False)
+        attn = 12 * self.n_layers * self.d_model * self.max_seq_len
+        return 6 * n_params + attn
+
+    def num_params(self, include_embed: bool = True) -> int:
+        d, f, L = self.d_model, self.d_ff, self.n_layers
+        per_layer = (
+            d * self.n_heads * self.head_dim            # wq
+            + 2 * d * self.n_kv_heads * self.head_dim   # wk, wv
+            + self.n_heads * self.head_dim * d          # wo
+            + 3 * d * f                                  # gate, up, down
+            + 2 * d)                                     # norms
+        total = L * per_layer + d                        # final norm
+        if include_embed:
+            total += self.vocab_size * d
+            if not self.tie_embeddings:
+                total += d * self.vocab_size
+        return total
+
+
+# Preset configs.  llama2_7b matches the reference recipe target
+# (BASELINE.md: Llama-2-7B LoRA fine-tune); tpu_1b is the single-chip
+# flagship used by bench.py; tiny is for tests.
+PRESETS: Dict[str, TransformerConfig] = {
+    "llama2_7b": TransformerConfig(),
+    "tpu_1b": TransformerConfig(
+        vocab_size=32_000, d_model=2048, n_layers=16, n_heads=16,
+        n_kv_heads=16, d_ff=5504, max_seq_len=2048),
+    "tpu_120m": TransformerConfig(
+        vocab_size=32_000, d_model=768, n_layers=12, n_heads=12,
+        n_kv_heads=12, d_ff=2048, max_seq_len=1024),
+    "tiny": TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, remat=False),
+}
+
+
+def config(name: str, **overrides) -> TransformerConfig:
+    return dataclasses.replace(PRESETS[name], **overrides)
+
+
+# --------------------------------------------------------------------------
+# Parameters
+# --------------------------------------------------------------------------
+
+def param_logical_axes(cfg: TransformerConfig) -> Params:
+    """Pytree (same structure as params) of logical-axis tuples."""
+    layers = {
+        "wq": ("layers", "embed", "heads", "kv"),
+        "wk": ("layers", "embed", "heads", "kv"),
+        "wv": ("layers", "embed", "heads", "kv"),
+        "wo": ("layers", "heads", "kv", "embed"),
+        "w_gate": ("layers", "embed", "mlp"),
+        "w_up": ("layers", "embed", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
+        "ln_attn": ("layers", "norm"),
+        "ln_mlp": ("layers", "norm"),
+    }
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": layers,
+        "final_norm": ("norm",),
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
+    d, f = cfg.d_model, cfg.d_ff
+    H, Hkv, Dh, L = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.n_layers
+    k_embed, k_layers, k_head = jax.random.split(rng, 3)
+
+    def dense_init(key, shape, fan_in):
+        return (jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(cfg.param_dtype)
+
+    ks = jax.random.split(k_layers, 7)
+    layers = {
+        "wq": dense_init(ks[0], (L, d, H, Dh), d),
+        "wk": dense_init(ks[1], (L, d, Hkv, Dh), d),
+        "wv": dense_init(ks[2], (L, d, Hkv, Dh), d),
+        "wo": dense_init(ks[3], (L, H, Dh, d), H * Dh),
+        "w_gate": dense_init(ks[4], (L, d, f), d),
+        "w_up": dense_init(ks[5], (L, d, f), d),
+        "w_down": dense_init(ks[6], (L, f, d), f),
+        "ln_attn": jnp.ones((L, d), cfg.param_dtype),
+        "ln_mlp": jnp.ones((L, d), cfg.param_dtype),
+    }
+    params = {
+        "embed": dense_init(k_embed, (cfg.vocab_size, d), 1),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), cfg.param_dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense_init(k_head, (d, cfg.vocab_size), d)
+    return params
+
+
+# --------------------------------------------------------------------------
+# Forward
+# --------------------------------------------------------------------------
+
+def _rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * jax.lax.rsqrt(var + eps)
+    return (normed * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: [B, S, H, Dh]; positions: [B, S]."""
+    Dh = x.shape[-1]
+    half = Dh // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+def _layer(cfg: TransformerConfig, x: jax.Array, layer: Params,
+           positions: jax.Array) -> jax.Array:
+    B, S, d = x.shape
+    # Attention block.
+    h = _rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(cfg.dtype))
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    q = with_sharding_constraint(q, "batch", "seq", "heads", None)
+    # BHSD for the kernel.
+    o = attention(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), causal=True,
+        implementation=cfg.attention_impl)
+    o = o.transpose(0, 2, 1, 3)  # back to [B, S, H, Dh]
+    attn_out = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(cfg.dtype))
+    x = x + attn_out
+    # MLP block (SwiGLU).
+    h = _rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
+    gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(cfg.dtype))
+    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
+    act = jax.nn.silu(gate) * up
+    act = with_sharding_constraint(act, "batch", "seq", "mlp")
+    down = jnp.einsum("bsf,fd->bsd", act, layer["w_down"].astype(cfg.dtype))
+    x = x + down
+    return with_sharding_constraint(x, "batch", "seq", None)
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, vocab] (f32)."""
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    x = with_sharding_constraint(x, "batch", "seq", None)
+
+    layer_fn = functools.partial(_layer, cfg)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(
+            layer_fn,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+
+    def scan_body(carry, layer_params):
+        return layer_fn(carry, layer_params, positions), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x.astype(jnp.float32), head.astype(jnp.float32))
+    return logits
+
+
+def loss_fn(
+    params: Params,
+    batch: Dict[str, jax.Array],
+    cfg: TransformerConfig,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Causal LM loss.  batch: tokens [B,S], labels [B,S] (-100 = ignore)."""
+    logits = forward(params, batch["tokens"], cfg)
+    labels = batch["labels"]
+    valid = labels != -100
+    safe_labels = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    token_logp = jnp.take_along_axis(
+        logp, safe_labels[..., None], axis=-1)[..., 0]
+    n_valid = jnp.maximum(valid.sum(), 1)
+    loss = -(token_logp * valid).sum() / n_valid
+    metrics = {
+        "loss": loss,
+        "n_tokens": n_valid,
+        "accuracy": ((logits.argmax(-1) == labels) & valid).sum() / n_valid,
+    }
+    return loss, metrics

@@ -1,0 +1,112 @@
+"""Attention entry point: one call, best available implementation.
+
+Dispatch order on TPU: Pallas flash-attention kernel (ops/flash_attention.py)
+→ XLA fused attention.  On CPU (tests) and for tiny shapes the jnp reference
+path is used.  The reference framework had no attention kernels at all (its
+custom-op set was detection-era NMS/ROIAlign, SURVEY.md §2.5); attention is
+the TPU build's hot op.
+
+Shapes follow [batch, num_heads, seq, head_dim] ("BHSD").  Grouped-query
+attention: kv tensors may have fewer heads (num_kv_heads divides num_heads).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def reference_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Plain XLA attention (materializes scores; fine below ~4k seq).
+
+    q: [B, H, S, D]; k, v: [B, Hkv, Skv, D] with H % Hkv == 0.
+    segment_ids: [B, S] int array; attention only within equal segments
+    (packing support).
+    """
+    B, H, S, D = q.shape
+    Hkv = k.shape[1]
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+    if Hkv != H:
+        group = H // Hkv
+        qg = q.reshape(B, Hkv, group, S, D)
+        scores = jnp.einsum("bhgsd,bhtd->bhgst", qg, k) * sm_scale
+    else:
+        scores = jnp.einsum("bhsd,bhtd->bhst", q, k) * sm_scale
+
+    Skv = k.shape[2]
+    mask = None
+    if causal:
+        # Align diagonals when q and kv lengths differ (decode).
+        q_pos = jnp.arange(S)[:, None] + (Skv - S)
+        kv_pos = jnp.arange(Skv)[None, :]
+        mask = q_pos >= kv_pos
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        seg_mask = seg_mask[:, None, :, :]  # [B, 1, S, Skv]
+        mask = seg_mask if mask is None else (mask & seg_mask)
+    if mask is not None:
+        if scores.ndim == 5:
+            mask = mask if mask.ndim == 4 else mask[None]
+            scores = jnp.where(
+                mask[:, :, None] if mask.ndim == 4 else mask, scores,
+                jnp.finfo(scores.dtype).min)
+        else:
+            scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if Hkv != H:
+        out = jnp.einsum("bhgst,bhtd->bhgsd", probs, v)
+        return out.reshape(B, H, S, D)
+    return jnp.einsum("bhst,bhtd->bhsd", probs, v)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "sm_scale", "implementation"))
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    implementation: Optional[str] = None,
+) -> jax.Array:
+    """Multi-head / grouped-query attention.
+
+    implementation: None (auto), "flash" (Pallas), "reference" (XLA).
+    """
+    impl = implementation
+    if impl is None:
+        impl = "flash" if _use_flash(q, k) else "reference"
+    if impl == "flash":
+        from cloudtik_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    return reference_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+
+
+def _use_flash(q: jax.Array, k: jax.Array) -> bool:
+    if not _on_tpu():
+        return False
+    S, D = q.shape[-2], q.shape[-1]
+    # Flash kernel needs lane/sublane-aligned shapes; small/odd shapes go XLA.
+    return S >= 256 and S % 128 == 0 and D % 128 == 0 and k.shape[-2] % 128 == 0

@@ -1,0 +1,343 @@
+"""Flash attention for TPU in Pallas (forward + backward).
+
+FlashAttention-2-style online-softmax tiling mapped onto the TPU memory
+hierarchy: Q/K/V stream HBM→VMEM block by block, running max / normalizer /
+output accumulator live in VMEM scratch across the innermost grid dimension,
+and every matmul hits the MXU with fp32 accumulation
+(preferred_element_type).  Nothing like this exists in the reference — its
+only custom kernels were detection ops (SURVEY.md §2.5); attention is the
+TPU build's hot op and the basis of the long-context (ring attention) path.
+
+Layout: q [B, H, S, D], k/v [B, Hkv, Skv, D], GQA via H % Hkv == 0 handled
+with index-map head arithmetic (no materialized kv repeat).
+
+Backward follows the standard two-kernel split:
+  * dq kernel: grid over q blocks, streams kv blocks, accumulates dq.
+  * dkv kernel: grid over kv blocks, streams (group, q-block) pairs,
+    accumulates dk/dv — GQA groups fold into the streamed axis so dk/dv are
+    produced directly at kv-head granularity.
+Both recompute the score block from saved (q, k, lse) instead of storing
+probabilities (memory O(S) not O(S²)).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK = 512
+_NEG_INF = -1e30
+
+
+def _block_sizes(S: int, Skv: int, block_q: int, block_k: int) -> Tuple[int, int]:
+    bq = min(block_q, S)
+    bk = min(block_k, Skv)
+    if S % bq or Skv % bk:
+        raise ValueError(f"seq lens ({S},{Skv}) must divide blocks ({bq},{bk})")
+    return bq, bk
+
+
+# --------------------------------------------------------------------------
+# Forward
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, sm_scale: float, causal: bool,
+                bq: int, bk: int):
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+    i = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Causal block skip: with q-block rows [i*bq, i*bq+bq) and kv-block cols
+    # [j*bk, j*bk+bk), the block is live iff j*bk <= i*bq + bq - 1.
+    live = (j * bk <= i * bq + (bq - 1)) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        if causal:
+            q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kv_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+        m_prev = m_ref[...]                        # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                     # [bq, bk] f32
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[...] = m_new
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha + pv
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_ref[...]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0, :, :] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        lse = (m_ref[...] + jnp.log(l_safe))       # [bq, 1]
+        lse_ref[0, 0, :, :] = lse
+
+
+def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    B, H, S, D = q.shape
+    _, Hkv, Skv, _ = k.shape
+    group = H // Hkv
+    bq, bk = _block_sizes(S, Skv, block_q, block_k)
+    nq, nk = S // bq, Skv // bk
+    grid = (B, H, nq, nk)
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, bq=bq, bk=bk)
+    out_shapes = (
+        jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        jax.ShapeDtypeStruct((B, H, S, 1), jnp.float32),
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D),
+                         lambda b, h, i, j, g=group: (b, h // g, j, 0)),
+            pl.BlockSpec((1, 1, bk, D),
+                         lambda b, h, i, j, g=group: (b, h // g, j, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
+        ),
+        out_shape=out_shapes,
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+    )(q, k, v)
+    return o, lse
+
+
+# --------------------------------------------------------------------------
+# Backward
+# --------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, sm_scale: float, causal: bool, bq: int, bk: int):
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+    i = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    live = (j * bk <= i * bq + (bq - 1)) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, :, :]                  # [bq, 1] f32
+        delta = delta_ref[0, 0, :, :]              # [bq, 1] f32
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kv_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                       # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale           # [bq, bk]
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0, 0, :, :] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *,
+                sm_scale: float, causal: bool, bq: int, bk: int, nq: int):
+    t = pl.program_id(3)
+    nt = pl.num_programs(3)
+    jk = pl.program_id(2)
+    qi = jax.lax.rem(t, nq)
+
+    @pl.when(t == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    live = (qi * bq + (bq - 1) >= jk * bk) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, :, :]
+        delta = delta_ref[0, 0, :, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kv_pos = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        ds = p * (dp - delta) * sm_scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, D]
+
+    @pl.when(t == nt - 1)
+    def _finalize():
+        dk_ref[0, 0, :, :] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd(causal, sm_scale, block_q, block_k, residuals, g):
+    q, k, v, o, lse = residuals
+    do = g
+    B, H, S, D = q.shape
+    _, Hkv, Skv, _ = k.shape
+    group = H // Hkv
+    bq, bk = _block_sizes(S, Skv, block_q, block_k)
+    nq, nk = S // bq, Skv // bk
+
+    # delta_i = rowsum(do * o): one cheap fused elementwise reduce in XLA.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)               # [B, H, S, 1]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          bq=bq, bk=bk),
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D),
+                         lambda b, h, i, j, g_=group: (b, h // g_, j, 0)),
+            pl.BlockSpec((1, 1, bk, D),
+                         lambda b, h, i, j, g_=group: (b, h // g_, j, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq),
+        grid=(B, Hkv, nk, group * nq),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, bq, D),
+                lambda b, hk, jk, t, g_=group, nq_=nq:
+                    (b, hk * g_ + t // nq_, t % nq_, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, hk, jk, t: (b, hk, jk, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, hk, jk, t: (b, hk, jk, 0)),
+            pl.BlockSpec(
+                (1, 1, bq, D),
+                lambda b, hk, jk, t, g_=group, nq_=nq:
+                    (b, hk * g_ + t // nq_, t % nq_, 0)),
+            pl.BlockSpec(
+                (1, 1, bq, 1),
+                lambda b, hk, jk, t, g_=group, nq_=nq:
+                    (b, hk * g_ + t // nq_, t % nq_, 0)),
+            pl.BlockSpec(
+                (1, 1, bq, 1),
+                lambda b, hk, jk, t, g_=group, nq_=nq:
+                    (b, hk * g_ + t // nq_, t % nq_, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, bk, D), lambda b, hk, jk, t: (b, hk, jk, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, hk, jk, t: (b, hk, jk, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, Hkv, Skv, D), k.dtype),
+            jax.ShapeDtypeStruct((B, Hkv, Skv, D), v.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+    )(q, k, v, do, lse, delta)
+
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k):
+    o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK,
+    block_k: int = DEFAULT_BLOCK,
+) -> jax.Array:
+    """Differentiable flash attention.  q [B,H,S,D], k/v [B,Hkv,Skv,D]."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(
+            f"num_heads {q.shape[1]} must be divisible by num_kv_heads "
+            f"{k.shape[1]}")
+    return _flash(q, k, v, causal, float(sm_scale), block_q, block_k)

@@ -1,0 +1,78 @@
+"""Multi-host SPMD bring-up: jax.distributed + deterministic host ordering.
+
+Replaces the reference's rendezvous machinery (SURVEY.md §2.4: MASTER_ADDR
+resolution in runner/distributed_launcher.py:63-81, mpirun/horovod process
+spawn, oneCCL env plumbing).  Here every slice host runs the SAME program;
+`auto_initialize()` reads the env exported by tik-run (or TPU metadata) and
+calls jax.distributed.initialize exactly once; XLA then owns all ICI/DCN
+collectives.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def auto_initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize jax.distributed from args > tik-run env > TPU metadata.
+
+    Returns True if distributed mode was initialized, False for single-host.
+    Idempotent; safe to call from any entry point.
+    """
+    global _initialized
+    if _initialized:
+        return True
+
+    coordinator_address = coordinator_address or \
+        os.environ.get("TIK_COORDINATOR_ADDRESS")
+    if num_processes is None and "TIK_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["TIK_NUM_PROCESSES"])
+    if process_id is None and "TIK_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["TIK_PROCESS_ID"])
+
+    if coordinator_address is None and num_processes is None:
+        # On a Cloud TPU VM jax.distributed can self-configure from the
+        # metadata server; off-TPU single host needs nothing.
+        if os.environ.get("TPU_WORKER_HOSTNAMES") and \
+                len(os.environ["TPU_WORKER_HOSTNAMES"].split(",")) > 1:
+            jax.distributed.initialize()
+            _initialized = True
+            return True
+        return False
+
+    if num_processes in (None, 1):
+        return False
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    logger.info("jax.distributed initialized: %d/%d @ %s",
+                process_id, num_processes, coordinator_address)
+    return True
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0

@@ -1,0 +1,138 @@
+"""Device mesh construction with named parallelism axes.
+
+This replaces the reference's launcher-level parallelism plumbing
+(runtime/ai/runner/cpu/distributed_launcher.py:55-113 computed MPI pin
+domains and oneCCL worker affinities; here parallelism is a compile-time
+property of one SPMD program).  The mesh axes are the framework's vocabulary
+for every parallelism the reference lacked (SURVEY.md §2.4: TP/PP/SP/EP/CP
+absent upstream — first-class here):
+
+    data    — pure data parallelism (gradient all-reduce)
+    fsdp    — data parallelism with parameter/optimizer sharding (ZeRO-3)
+    seq     — sequence/context parallelism (ring attention over this axis)
+    tensor  — tensor (megatron-style) parallelism within a layer
+    expert  — expert parallelism for MoE dispatch
+    pipe    — pipeline stages
+
+Axis order is chosen so the innermost, most bandwidth-hungry axes (tensor)
+map to the fastest ICI neighborhoods, and `data` (pure gradient sync) is
+outermost so it can span DCN across slices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order, outermost (DCN-friendly) to innermost (ICI-hungry).
+MESH_AXES: Tuple[str, ...] = ("data", "fsdp", "pipe", "expert", "seq", "tensor")
+
+# Axes over which data batches are split (batch sharding).
+DATA_AXES: Tuple[str, ...] = ("data", "fsdp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes for each named axis; -1 means "fill with remaining devices"."""
+
+    data: int = 1
+    fsdp: int = -1
+    pipe: int = 1
+    expert: int = 1
+    seq: int = 1
+    tensor: int = 1
+    # Multi-slice: number of slices connected over DCN; the `data` axis is
+    # laid out across slices when > 1.
+    num_slices: int = 1
+
+    def axis_sizes(self, num_devices: int) -> Dict[str, int]:
+        sizes = {a: getattr(self, a) for a in MESH_AXES}
+        fills = [a for a, s in sizes.items() if s == -1]
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if num_devices % fixed != 0:
+            raise ValueError(
+                f"{num_devices} devices not divisible by fixed axis product "
+                f"{fixed} ({sizes})")
+        remaining = num_devices // fixed
+        if not fills:
+            if fixed != num_devices:
+                raise ValueError(
+                    f"Mesh axes {sizes} use {fixed} devices but "
+                    f"{num_devices} are available; set one axis to -1 to fill")
+            return sizes
+        if len(fills) > 1:
+            raise ValueError(f"Only one axis may be -1, got {fills}")
+        sizes[fills[0]] = remaining
+        return sizes
+
+    @staticmethod
+    def fsdp_only() -> "MeshConfig":
+        return MeshConfig()
+
+    @staticmethod
+    def dp(n: int = -1) -> "MeshConfig":
+        return MeshConfig(data=n, fsdp=1)
+
+
+def build_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Construct a Mesh with the canonical named axes.
+
+    Devices are arranged so that the tensor axis lands on physically adjacent
+    devices (jax device order already follows the torus for TPU backends via
+    `jax.experimental.mesh_utils`); across slices, the data axis spans DCN.
+    """
+    config = config or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = config.axis_sizes(len(devices))
+    shape = tuple(sizes[a] for a in MESH_AXES)
+    try:
+        from jax.experimental import mesh_utils
+        if config.num_slices > 1:
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                mesh_shape=_per_slice_shape(shape, config.num_slices),
+                dcn_mesh_shape=_dcn_shape(shape, config.num_slices),
+                devices=devices)
+        else:
+            dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except (ValueError, AssertionError):
+        # Host-platform CPU devices or shapes mesh_utils rejects: plain reshape.
+        dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def _per_slice_shape(shape: Tuple[int, ...], num_slices: int) -> Tuple[int, ...]:
+    # `data` is the outermost axis (index 0): divide it across slices.
+    if shape[0] % num_slices != 0:
+        raise ValueError(
+            f"data axis size {shape[0]} not divisible by num_slices {num_slices}")
+    return (shape[0] // num_slices,) + shape[1:]
+
+
+def _dcn_shape(shape: Tuple[int, ...], num_slices: int) -> Tuple[int, ...]:
+    return (num_slices,) + (1,) * (len(shape) - 1)
+
+
+def mesh_summary(mesh: Mesh) -> Dict[str, int]:
+    return {a: int(s) for a, s in zip(mesh.axis_names, mesh.devices.shape)
+            if s > 1}
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    return math.prod(mesh.shape[a] for a in DATA_AXES if a in mesh.shape)
+
+
+def local_batch_slice(mesh: Mesh, global_batch: int) -> int:
+    """Per-data-shard batch size."""
+    n = data_axis_size(mesh)
+    if global_batch % n != 0:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"data-parallel size {n}")
+    return global_batch // n

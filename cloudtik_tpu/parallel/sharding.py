@@ -1,0 +1,127 @@
+"""Logical-axis sharding rules: model code names axes, rules map them to mesh.
+
+Models annotate arrays with *logical* axis names ("batch", "seq", "embed",
+"mlp", "heads", "kv", "vocab", "expert", "layers").  A rule table maps each
+logical name to zero or more mesh axes.  XLA/GSPMD then inserts the
+collectives (psum / all-gather / reduce-scatter) implied by the placement —
+there is no hand-written allreduce anywhere in this framework (the
+reference's oneCCL/Gloo/Horovod data plane, SURVEY.md §2.4, dissolves into
+compiler-emitted ICI collectives).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AxisRules = Tuple[Tuple[str, Union[None, str, Tuple[str, ...]]], ...]
+
+# Default rules: FSDP shards params on embed/vocab rows, tensor parallelism
+# splits heads/mlp columns, sequence parallelism shards activations on seq,
+# expert parallelism shards the expert dimension.
+DEFAULT_RULES: AxisRules = (
+    ("batch", ("data", "fsdp")),
+    ("seq", "seq"),
+    ("embed", "fsdp"),          # param row sharding (ZeRO-3 style)
+    ("mlp", "tensor"),
+    ("heads", "tensor"),
+    ("kv", None),
+    ("vocab", "tensor"),
+    ("expert", "expert"),
+    ("layers", None),           # scanned layer stack axis stays replicated
+    ("norm", None),
+)
+
+
+def make_rules(**overrides) -> AxisRules:
+    """DEFAULT_RULES with per-logical-axis overrides, e.g.
+    make_rules(embed=("fsdp", "tensor"))."""
+    rules = dict(DEFAULT_RULES)
+    for k, v in overrides.items():
+        rules[k] = v
+    return tuple(rules.items())
+
+
+def logical_to_spec(
+    logical_axes: Sequence[Optional[str]], rules: AxisRules = DEFAULT_RULES,
+    mesh: Optional[Mesh] = None,
+) -> P:
+    """Translate a tuple of logical axis names into a PartitionSpec.
+
+    Mesh axes that don't exist in `mesh` (or have size 1) are dropped so the
+    same model code runs on any mesh shape.
+    """
+    table = dict(rules)
+    # Axes absent from the mesh or of size 1 are dropped (sharding over a
+    # trivial axis is replication — keep specs clean).
+    present = None
+    if mesh is not None:
+        # .shape works on both Mesh and AbstractMesh.
+        present = {a for a in mesh.axis_names if mesh.shape[a] > 1}
+    spec: List[Union[None, str, Tuple[str, ...]]] = []
+    used: set = set()
+
+    def _filter(axes):
+        if axes is None:
+            return None
+        if isinstance(axes, str):
+            axes = (axes,)
+        kept = tuple(a for a in axes
+                     if (present is None or a in present) and a not in used)
+        used.update(kept)
+        if not kept:
+            return None
+        return kept[0] if len(kept) == 1 else kept
+
+    for name in logical_axes:
+        if name is None:
+            spec.append(None)
+            continue
+        if name not in table:
+            raise ValueError(f"Unknown logical axis {name!r}")
+        spec.append(_filter(table[name]))
+    return P(*spec)
+
+
+def named_sharding(
+    mesh: Mesh, *logical_axes: Optional[str], rules: AxisRules = DEFAULT_RULES
+) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_spec(logical_axes, rules, mesh))
+
+
+def tree_to_shardings(
+    mesh: Mesh, logical_tree: Any, rules: AxisRules = DEFAULT_RULES
+) -> Any:
+    """Map a pytree of logical-axes tuples to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, logical_to_spec(axes, rules, mesh)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x),
+    )
+
+
+def batch_sharding(mesh: Mesh, rules: AxisRules = DEFAULT_RULES) -> NamedSharding:
+    """Sharding for a [batch, ...] host array (inputs/labels)."""
+    return named_sharding(mesh, "batch", rules=rules)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def with_sharding_constraint(
+    x: Any, *logical_axes: Optional[str], rules: AxisRules = DEFAULT_RULES
+) -> Any:
+    """Constrain an intermediate inside jit to a logical placement.
+
+    Uses the ambient mesh (jax.set_mesh context); on a mesh-less trace it is
+    a no-op, keeping model code portable.
+    """
+    env_mesh = jax.sharding.get_abstract_mesh()
+    if env_mesh is None or env_mesh.empty:
+        return x
+    spec = logical_to_spec(logical_axes, rules, env_mesh)
+    return jax.lax.with_sharding_constraint(x, spec)

@@ -1,0 +1,72 @@
+"""Provider factory: provider type -> classes.
+
+Reference parity: core/_private/provider_factory.py:119 (_NODE_PROVIDERS
+registry, external-class loading _import_external:114).
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict, Optional, Type
+
+from cloudtik_tpu.core.node_provider import NodeProvider
+from cloudtik_tpu.core.workspace_provider import WorkspaceProvider
+
+_NODE_PROVIDERS: Dict[str, str] = {
+    "virtual": "cloudtik_tpu.providers.virtual.node_provider:VirtualNodeProvider",
+    "gcp": "cloudtik_tpu.providers.gcp.node_provider:GCPNodeProvider",
+    "onpremise": "cloudtik_tpu.providers.onpremise.node_provider:OnPremiseNodeProvider",
+    "mock": "tests.mock_infra:MockProvider",
+}
+
+_WORKSPACE_PROVIDERS: Dict[str, str] = {
+    "virtual": "cloudtik_tpu.providers.virtual.workspace_provider:VirtualWorkspaceProvider",
+    "gcp": "cloudtik_tpu.providers.gcp.workspace_provider:GCPWorkspaceProvider",
+}
+
+
+def _load(spec: str):
+    module_name, _, cls_name = spec.partition(":")
+    return getattr(importlib.import_module(module_name), cls_name)
+
+
+def register_node_provider(name: str, spec: str) -> None:
+    _NODE_PROVIDERS[name] = spec
+
+
+def get_node_provider_cls(provider_config: Dict[str, Any]) -> Type[NodeProvider]:
+    # external providers: provider.module = "pkg.mod:Class"
+    if provider_config.get("module"):
+        return _load(provider_config["module"])
+    ptype = provider_config.get("type")
+    spec = _NODE_PROVIDERS.get(ptype)
+    if spec is None:
+        raise ValueError(
+            f"Unknown provider type {ptype!r}; known: "
+            f"{sorted(_NODE_PROVIDERS)}")
+    return _load(spec)
+
+
+def create_node_provider(provider_config: Dict[str, Any],
+                         cluster_name: str) -> NodeProvider:
+    return get_node_provider_cls(provider_config)(
+        provider_config, cluster_name)
+
+
+def get_workspace_provider_cls(
+        provider_config: Dict[str, Any]) -> Type[WorkspaceProvider]:
+    if provider_config.get("workspace_module"):
+        return _load(provider_config["workspace_module"])
+    ptype = provider_config.get("type")
+    spec = _WORKSPACE_PROVIDERS.get(ptype)
+    if spec is None:
+        raise ValueError(
+            f"No workspace provider for type {ptype!r}; known: "
+            f"{sorted(_WORKSPACE_PROVIDERS)}")
+    return _load(spec)
+
+
+def create_workspace_provider(provider_config: Dict[str, Any],
+                              workspace_name: str) -> WorkspaceProvider:
+    return get_workspace_provider_cls(provider_config)(
+        provider_config, workspace_name)

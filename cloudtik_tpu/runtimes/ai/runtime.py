@@ -1,0 +1,119 @@
+"""AI runtime: the JAX/XLA training stack as a cluster service plugin.
+
+Reference parity: runtime/ai (SURVEY.md §2.3 — MLflow server on head,
+framework install, the distributed launcher §2.4).  TPU-first redesign: no
+framework install step (the TPU VM image ships JAX), no MPI/oneCCL plumbing;
+the runtime's job is to
+  * expose the `tik-run` launcher as the runnable-command handler so
+    `tik submit train.py` lowers to one SPMD program per slice,
+  * export slice topology env vars (coordinator address, process ids) on
+    every node,
+  * run the experiment tracker service on the head,
+  * publish a TPU-aware scaling policy (slice-granular asks).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from cloudtik_tpu.core.runtime import Runtime
+from cloudtik_tpu.core.scaling_policy import ScalingPolicy
+from cloudtik_tpu.core.tags import (
+    TAG_NODE_GROUP_ID, TAG_NODE_GROUP_WORKER_INDEX)
+from cloudtik_tpu.utils.constants import TIK_COORDINATOR_PORT_DEFAULT
+
+RUNNABLE_SUFFIXES = (".py",)
+
+
+class AIRuntime(Runtime):
+    def prepare_config(self, cluster_config: Dict[str, Any]) -> Dict[str, Any]:
+        return cluster_config
+
+    def validate_config(self, cluster_config: Dict[str, Any]) -> None:
+        return None
+
+    def with_environment_variables(
+        self, config: Dict[str, Any], provider: Any, node_id: str
+    ) -> Dict[str, Any]:
+        env: Dict[str, Any] = {}
+        try:
+            tags = provider.node_tags(node_id)
+        except Exception:
+            tags = {}
+        group_id = tags.get(TAG_NODE_GROUP_ID)
+        if group_id:
+            env["TIK_SLICE_ID"] = group_id
+            env["TIK_SLICE_WORKER_INDEX"] = tags.get(
+                TAG_NODE_GROUP_WORKER_INDEX, "0")
+        env["TIK_COORDINATOR_PORT"] = str(
+            self.runtime_config.get(
+                "coordinator_port", TIK_COORDINATOR_PORT_DEFAULT))
+        return env
+
+    def get_runnable_command(
+        self, target: str, runtime_options: Optional[List[str]] = None
+    ) -> Optional[List[str]]:
+        """`tik submit train.py` -> `tik-run train.py` on the head, which
+        fans the same SPMD program out to every slice host.
+
+        Reference parity: core/runtime.py:123 + runner/launch.py:261.
+        """
+        if not target.endswith(RUNNABLE_SUFFIXES):
+            return None
+        cmd = ["tik-run"]
+        if runtime_options:
+            cmd.extend(runtime_options)
+        cmd.append(target)
+        return cmd
+
+    def get_runtime_services(
+        self, cluster_config: Dict[str, Any], cluster_head_ip: str
+    ) -> Optional[Dict[str, Dict[str, Any]]]:
+        tracker_port = self.runtime_config.get("tracker_port", 5000)
+        return {
+            "ai-tracker": {
+                "protocol": "http",
+                "port": tracker_port,
+                "node_kind": "head",
+            },
+        }
+
+    def get_runtime_endpoints(
+        self, cluster_config: Dict[str, Any], cluster_head_ip: str
+    ) -> Optional[Dict[str, Dict[str, Any]]]:
+        tracker_port = self.runtime_config.get("tracker_port", 5000)
+        return {
+            "ai-tracker": {
+                "name": "Experiment Tracker",
+                "url": f"http://{cluster_head_ip}:{tracker_port}",
+            },
+        }
+
+    def get_head_service_ports(self) -> Optional[Dict[str, Dict[str, Any]]]:
+        return {"ai-tracker": {
+            "protocol": "TCP",
+            "port": self.runtime_config.get("tracker_port", 5000)}}
+
+    def get_scaling_policy(
+        self, cluster_config: Dict[str, Any], head_host: str
+    ) -> Optional[ScalingPolicy]:
+        from cloudtik_tpu.runtimes.ai.scaling import AISliceScalingPolicy
+
+        if not self.runtime_config.get("scaling", {}).get("enabled", False):
+            return None
+        return AISliceScalingPolicy(
+            cluster_config, head_host, self.runtime_config.get("scaling", {}))
+
+    def get_logs(self) -> Dict[str, str]:
+        return {"ai": "~/.tik/logs/ai"}
+
+    def get_processes(self) -> Optional[List[Tuple[str, bool, str, str]]]:
+        return [
+            ("tik-run", True, "AILauncher", "node"),
+            ("tik_tracker", True, "Tracker", "head"),
+        ]
+
+    @staticmethod
+    def get_dependencies() -> List[str]:
+        return ["mount"]

@@ -1,0 +1,41 @@
+"""Slice-granular autoscaling signal for the AI runtime.
+
+The reference's AI runtime had no scaling policy of its own (Spark's YARN
+policy was the model, SURVEY.md §2.1 scaling_policies).  Here the unit of
+scale-out is a whole pod slice: pending training jobs (published to the
+state store by the launcher) demand `slice_resources` each.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from cloudtik_tpu.core.scaling_policy import (
+    ScalingPolicy, ScalingState, make_autoscaling_instructions)
+
+
+class AISliceScalingPolicy(ScalingPolicy):
+    def __init__(self, config: Dict[str, Any], head_host: str,
+                 scaling_config: Optional[Dict[str, Any]] = None,
+                 state_client=None):
+        super().__init__(config, head_host)
+        sc = scaling_config or {}
+        self.slice_resources = sc.get("slice_resources", {"TPU": 16})
+        self.max_pending_slices = sc.get("max_pending_slices", 4)
+        self.state_client = state_client
+
+    def name(self) -> str:
+        return "ai-slice-scaling"
+
+    def get_scaling_state(self) -> Optional[ScalingState]:
+        pending_jobs = 0
+        if self.state_client is not None:
+            jobs = self.state_client.table_list("ai_jobs")
+            pending_jobs = sum(
+                1 for j in jobs.values() if j.get("status") == "pending")
+        pending_jobs = min(pending_jobs, self.max_pending_slices)
+        state = ScalingState()
+        state.set_autoscaling_instructions(make_autoscaling_instructions(
+            [dict(self.slice_resources)] * pending_jobs))
+        return state

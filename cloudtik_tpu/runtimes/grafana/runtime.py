@@ -1,0 +1,57 @@
+"""Grafana runtime: dashboards with prometheus datasource via discovery.
+
+Reference parity: runtime/grafana (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from cloudtik_tpu.core.runtime import Runtime
+
+DEFAULT_PORT = 3000
+
+
+class GrafanaRuntime(Runtime):
+    def get_runtime_services(self, cluster_config, cluster_head_ip):
+        return {"grafana": {
+            "protocol": "http",
+            "port": self.runtime_config.get("port", DEFAULT_PORT),
+            "node_kind": "head"}}
+
+    def get_runtime_endpoints(self, cluster_config, cluster_head_ip):
+        port = self.runtime_config.get("port", DEFAULT_PORT)
+        return {"grafana": {"name": "Grafana",
+                            "url": f"http://{cluster_head_ip}:{port}"}}
+
+    def get_head_service_ports(self):
+        return {"grafana": {"protocol": "TCP",
+                            "port": self.runtime_config.get(
+                                "port", DEFAULT_PORT)}}
+
+    def node_configure(self, node_context: Dict[str, Any]) -> None:
+        if not node_context.get("is_head"):
+            return
+        conf_dir = os.path.expanduser("~/.tik/grafana/provisioning/datasources")
+        os.makedirs(conf_dir, exist_ok=True)
+        prometheus_url = node_context.get(
+            "prometheus_url", "http://localhost:9090")
+        import yaml
+        with open(os.path.join(conf_dir, "tik.yaml"), "w") as f:
+            yaml.safe_dump({
+                "apiVersion": 1,
+                "datasources": [{
+                    "name": "tik-prometheus",
+                    "type": "prometheus",
+                    "url": prometheus_url,
+                    "isDefault": True,
+                }],
+            }, f)
+
+    def get_processes(self) -> Optional[List[Tuple[str, bool, str, str]]]:
+        return [("grafana", False, "Grafana", "head")]
+
+    @staticmethod
+    def get_dependencies() -> List[str]:
+        return ["prometheus"]

@@ -1,0 +1,54 @@
+"""MLflow runtime: experiment tracking server on the head.
+
+Reference parity: the AI runtime's MLflow 2.3.1 server
+(runtime/ai/scripts/install.sh:48-54, SURVEY.md §5 checkpoint/resume — the
+reference delegated run tracking to MLflow).  Gated: starts only when the
+mlflow package is installed; the trainer's tracking client writes through
+cloudtik_tpu.train.tracking either way.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Any, Dict, List, Optional, Tuple
+
+from cloudtik_tpu.core.runtime import Runtime
+
+DEFAULT_PORT = 5000
+
+
+class MLflowRuntime(Runtime):
+    def get_runtime_services(self, cluster_config, cluster_head_ip):
+        return {"mlflow": {
+            "protocol": "http",
+            "port": self.runtime_config.get("port", DEFAULT_PORT),
+            "node_kind": "head"}}
+
+    def get_runtime_endpoints(self, cluster_config, cluster_head_ip):
+        port = self.runtime_config.get("port", DEFAULT_PORT)
+        return {"mlflow": {"name": "MLflow",
+                           "url": f"http://{cluster_head_ip}:{port}"}}
+
+    def get_head_service_ports(self):
+        return {"mlflow": {
+            "protocol": "TCP",
+            "port": self.runtime_config.get("port", DEFAULT_PORT)}}
+
+    def node_services(self, node_context: Dict[str, Any], command: str) -> None:
+        if not node_context.get("is_head"):
+            return
+        if command == "start" and shutil.which("mlflow"):
+            backend_dir = os.path.expanduser("~/.tik/mlflow")
+            os.makedirs(backend_dir, exist_ok=True)
+            subprocess.Popen([
+                "mlflow", "server",
+                "--host", "0.0.0.0",
+                "--port", str(self.runtime_config.get("port", DEFAULT_PORT)),
+                "--backend-store-uri", f"sqlite:///{backend_dir}/mlflow.db",
+                "--default-artifact-root", f"{backend_dir}/artifacts",
+            ], stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    def get_processes(self) -> Optional[List[Tuple[str, bool, str, str]]]:
+        return [("mlflow", True, "MLflow", "head")]

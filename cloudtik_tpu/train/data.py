@@ -1,0 +1,86 @@
+"""Host-side data pipeline: per-host sharded batches feeding the SPMD step.
+
+The reference streamed training data per DDP rank (each torch process read
+its shard); the TPU equivalent is per-*host* loading with
+`jax.make_array_from_process_local_data` assembling the global array across
+the pod slice.  Synthetic generators are provided for benches/tests; real
+corpora go through the grain-backed loader when available.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+
+
+def synthetic_lm_batches(
+    batch_size: int,
+    seq_len: int,
+    vocab_size: int,
+    seed: int = 0,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Deterministic synthetic next-token-prediction batches."""
+    rng = np.random.default_rng(seed)
+    while True:
+        tokens = rng.integers(
+            0, vocab_size, (batch_size, seq_len), dtype=np.int32)
+        labels = np.roll(tokens, -1, axis=1)
+        labels[:, -1] = -100
+        yield {"tokens": tokens, "labels": labels.astype(np.int32)}
+
+
+def global_batches(
+    local_iter: Iterator[Dict[str, np.ndarray]],
+    sharding: NamedSharding,
+) -> Iterator[Dict[str, jax.Array]]:
+    """Assemble per-process local batches into global sharded arrays.
+
+    In multi-host SPMD each process feeds only its addressable shard; this
+    wrapper turns {name: local ndarray} into {name: global jax.Array}.
+    """
+    n_proc = jax.process_count()
+    for local in local_iter:
+        if n_proc == 1:
+            yield jax.device_put(local, sharding)
+            continue
+        global_batch = {}
+        for name, arr in local.items():
+            global_shape = (arr.shape[0] * n_proc,) + arr.shape[1:]
+            global_batch[name] = jax.make_array_from_process_local_data(
+                sharding, arr, global_shape)
+        yield global_batch
+
+
+def tokenized_file_batches(
+    path: str,
+    batch_size: int,
+    seq_len: int,
+    *,
+    shard_index: Optional[int] = None,
+    shard_count: Optional[int] = None,
+    repeat: bool = True,
+    seed: int = 0,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Stream fixed-length LM examples from a flat token file (.npy/.bin of
+    int32 token ids).  Each host reads a disjoint strided shard."""
+    shard_index = jax.process_index() if shard_index is None else shard_index
+    shard_count = jax.process_count() if shard_count is None else shard_count
+    tokens = np.load(path, mmap_mode="r") if path.endswith(".npy") else \
+        np.memmap(path, dtype=np.int32, mode="r")
+    n_examples = len(tokens) // (seq_len + 1)
+    indices = np.arange(shard_index, n_examples, shard_count)
+    rng = np.random.default_rng(seed)
+    while True:
+        order = rng.permutation(indices)
+        for start in range(0, len(order) - batch_size + 1, batch_size):
+            batch_idx = order[start:start + batch_size]
+            rows = np.stack([
+                tokens[i * (seq_len + 1):(i + 1) * (seq_len + 1)]
+                for i in batch_idx])
+            yield {"tokens": rows[:, :-1].astype(np.int32),
+                   "labels": rows[:, 1:].astype(np.int32)}
+        if not repeat:
+            return

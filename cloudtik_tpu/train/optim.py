@@ -1,0 +1,60 @@
+"""Optimizers and schedules (optax) for the training stack."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import optax
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    name: str = "adamw"
+    learning_rate: float = 3e-4
+    warmup_steps: int = 100
+    total_steps: int = 10_000
+    weight_decay: float = 0.1
+    b1: float = 0.9
+    b2: float = 0.95
+    eps: float = 1e-8
+    grad_clip_norm: Optional[float] = 1.0
+    min_lr_ratio: float = 0.1
+    schedule: str = "cosine"  # "cosine" | "constant" | "linear"
+
+
+def make_schedule(cfg: OptimizerConfig) -> optax.Schedule:
+    peak = cfg.learning_rate
+    if cfg.schedule == "constant":
+        return optax.warmup_constant_schedule(0.0, peak, cfg.warmup_steps)
+    end = peak * cfg.min_lr_ratio
+    decay_steps = max(cfg.total_steps - cfg.warmup_steps, 1)
+    if cfg.schedule == "linear":
+        return optax.warmup_linear_schedule(
+            0.0, peak, cfg.warmup_steps, decay_steps, end_value=end) \
+            if hasattr(optax, "warmup_linear_schedule") else \
+            optax.join_schedules(
+                [optax.linear_schedule(0.0, peak, cfg.warmup_steps),
+                 optax.linear_schedule(peak, end, decay_steps)],
+                [cfg.warmup_steps])
+    return optax.warmup_cosine_decay_schedule(
+        0.0, peak, cfg.warmup_steps, cfg.total_steps, end_value=end)
+
+
+def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
+    schedule = make_schedule(cfg)
+    if cfg.name == "adamw":
+        opt = optax.adamw(
+            schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
+            weight_decay=cfg.weight_decay)
+    elif cfg.name == "sgd":
+        opt = optax.sgd(schedule, momentum=0.9)
+    elif cfg.name == "adafactor":
+        opt = optax.adafactor(schedule)
+    elif cfg.name == "lion":
+        opt = optax.lion(schedule, weight_decay=cfg.weight_decay)
+    else:
+        raise ValueError(f"Unknown optimizer {cfg.name!r}")
+    if cfg.grad_clip_norm:
+        opt = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), opt)
+    return opt

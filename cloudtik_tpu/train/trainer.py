@@ -1,0 +1,226 @@
+"""The sharded training loop: one jitted SPMD step + an MFU meter.
+
+Replaces the reference's `cloudtik-run` data plane (SURVEY.md §3.4): where
+the reference spawned N torch-DDP processes whose gradients met in
+oneCCL/Gloo allreduce, here there is ONE jitted train step whose gradient
+sync is whatever collectives GSPMD derives from the param/batch shardings —
+DP, FSDP, TP, SP compose by mesh configuration.  Donated buffers keep
+params/opt-state in place across steps; MFU is measured in the loop
+(BASELINE.json north star: ≥45% MFU).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cloudtik_tpu.parallel.mesh import MeshConfig, build_mesh
+from cloudtik_tpu.parallel.sharding import (
+    AxisRules, DEFAULT_RULES, batch_sharding, tree_to_shardings)
+from cloudtik_tpu.train.optim import OptimizerConfig, make_optimizer
+
+# Peak bf16 FLOPs/s per chip by TPU generation (public spec sheet numbers),
+# used for MFU.  Unknown platforms fall back to measured-only reporting.
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "cpu": 1e12,
+}
+
+
+def device_peak_flops(device=None) -> Optional[float]:
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, flops in PEAK_FLOPS.items():
+        if key in kind:
+            return flops
+    if device.platform == "tpu":
+        return 197e12
+    if device.platform == "cpu":
+        return PEAK_FLOPS["cpu"]
+    return None
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """What the trainer needs to know about a model family."""
+
+    init: Callable[[jax.Array], Any]                   # rng -> params
+    loss_fn: Callable[[Any, Dict[str, jax.Array]], Tuple[jax.Array, Dict]]
+    logical_axes: Any                                  # pytree of axis tuples
+    flops_per_token: Optional[float] = None            # fwd+bwd estimate
+
+
+def transformer_spec(cfg) -> ModelSpec:
+    from cloudtik_tpu.models import transformer as T
+
+    return ModelSpec(
+        init=lambda rng: T.init_params(rng, cfg),
+        loss_fn=lambda params, batch: T.loss_fn(params, batch, cfg),
+        logical_axes=T.param_logical_axes(cfg),
+        flops_per_token=cfg.flops_per_token(),
+    )
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    global_batch_size: int = 8
+    seq_len: int = 2048
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    optimizer: OptimizerConfig = dataclasses.field(
+        default_factory=OptimizerConfig)
+    rules: AxisRules = DEFAULT_RULES
+    log_every: int = 10
+    checkpoint_every: int = 0          # 0 = disabled
+    checkpoint_dir: Optional[str] = None
+
+
+class Trainer:
+    """Builds the sharded state + step function and runs the loop."""
+
+    def __init__(self, spec: ModelSpec, config: TrainerConfig,
+                 mesh: Optional[Mesh] = None):
+        self.spec = spec
+        self.config = config
+        self.mesh = mesh if mesh is not None else build_mesh(config.mesh)
+        self.optimizer = make_optimizer(config.optimizer)
+        self.param_shardings = tree_to_shardings(
+            self.mesh, spec.logical_axes, config.rules)
+        self.data_sharding = batch_sharding(self.mesh, config.rules)
+        self.step_fn = self._build_step()
+        self.state = None
+        self.step = 0
+        self._jitted_step = None
+
+    # -- state -------------------------------------------------------------
+    def init_state(self, rng: jax.Array) -> None:
+        def _init(rng):
+            params = self.spec.init(rng)
+            opt_state = self.optimizer.init(params)
+            return {"params": params, "opt_state": opt_state}
+
+        opt_shardings = self._opt_state_shardings()
+        with jax.sharding.set_mesh(self.mesh):
+            self.state = jax.jit(
+                _init,
+                out_shardings={"params": self.param_shardings,
+                               "opt_state": opt_shardings},
+            )(rng)
+        self.step = 0
+
+    def _opt_state_shardings(self):
+        """Optimizer slots that mirror param shapes get param shardings;
+        scalars (step counts) are replicated."""
+        params_shape = jax.eval_shape(self.spec.init, jax.random.PRNGKey(0))
+        opt_shape = jax.eval_shape(self.optimizer.init, params_shape)
+        flat_param_shardings = {}
+
+        def record(path, shard):
+            flat_param_shardings[tuple(str(p) for p in path)] = shard
+
+        jax.tree_util.tree_map_with_path(
+            record, self.param_shardings,
+            is_leaf=lambda x: isinstance(x, NamedSharding))
+
+        param_leaves = jax.tree.leaves(params_shape)
+        shapes_to_shard = {}
+        for leaf, shard in zip(param_leaves,
+                               jax.tree.leaves(self.param_shardings)):
+            shapes_to_shard.setdefault(leaf.shape, shard)
+
+        replicated = NamedSharding(self.mesh, P())
+
+        def pick(leaf):
+            return shapes_to_shard.get(leaf.shape, replicated)
+
+        return jax.tree.map(pick, opt_shape)
+
+    # -- step --------------------------------------------------------------
+    def _build_step(self):
+        optimizer = self.optimizer
+        loss_fn = self.spec.loss_fn
+
+        def train_step(state, batch):
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+            (loss, metrics), grads = grad_fn(state["params"], batch)
+            updates, new_opt = optimizer.update(
+                grads, state["opt_state"], state["params"])
+            new_params = jax.tree.map(
+                lambda p, u: (p + u.astype(p.dtype)), state["params"], updates)
+            metrics["grad_norm"] = optax_global_norm(grads)
+            return {"params": new_params, "opt_state": new_opt}, metrics
+
+        return train_step
+
+    def compile_step(self):
+        """Jit the step with explicit shardings + donation (cached)."""
+        if self._jitted_step is None:
+            opt_shardings = self._opt_state_shardings()
+            state_shardings = {"params": self.param_shardings,
+                               "opt_state": opt_shardings}
+            self._jitted_step = jax.jit(
+                self.step_fn,
+                in_shardings=(state_shardings, self.data_sharding),
+                out_shardings=(state_shardings,
+                               NamedSharding(self.mesh, P())),
+                donate_argnums=(0,),
+            )
+        return self._jitted_step
+
+    # -- loop --------------------------------------------------------------
+    def fit(
+        self,
+        data_iter: Iterator[Dict[str, np.ndarray]],
+        num_steps: int,
+        rng: Optional[jax.Array] = None,
+        callbacks: Optional[list] = None,
+    ) -> Dict[str, Any]:
+        if self.state is None:
+            self.init_state(rng if rng is not None else jax.random.PRNGKey(0))
+        jitted = self.compile_step()
+        callbacks = callbacks or []
+        tokens_per_step = self.config.global_batch_size * self.config.seq_len
+        peak = device_peak_flops()
+        n_devices = self.mesh.devices.size
+
+        history = []
+        t_window = time.perf_counter()
+        window_steps = 0
+        with jax.sharding.set_mesh(self.mesh):
+            for _ in range(num_steps):
+                batch = next(data_iter)
+                batch = jax.device_put(batch, self.data_sharding)
+                self.state, metrics = jitted(self.state, batch)
+                self.step += 1
+                window_steps += 1
+                if self.step % self.config.log_every == 0:
+                    jax.block_until_ready(metrics)
+                    dt = time.perf_counter() - t_window
+                    tokens_s = tokens_per_step * window_steps / dt
+                    entry = {k: float(v) for k, v in metrics.items()}
+                    entry.update(step=self.step, tokens_per_sec=tokens_s)
+                    if self.spec.flops_per_token and peak:
+                        mfu = (self.spec.flops_per_token * tokens_s
+                               / (peak * n_devices))
+                        entry["mfu"] = mfu
+                    history.append(entry)
+                    for cb in callbacks:
+                        cb(self, entry)
+                    t_window = time.perf_counter()
+                    window_steps = 0
+        return {"history": history, "final_step": self.step}
+
+
+def optax_global_norm(tree) -> jax.Array:
+    leaves = jax.tree.leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                        for x in leaves))

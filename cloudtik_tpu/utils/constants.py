@@ -53,6 +53,9 @@ TIK_METRICS_PORT_DEFAULT = env_integer("TIK_METRICS_PORT", 44217)
 # --- files on nodes ----------------------------------------------------------
 TIK_HOME = os.path.expanduser(os.environ.get("TIK_HOME", "~/.tik"))
 TIK_BOOTSTRAP_CONFIG_FILE = os.path.join(TIK_HOME, "bootstrap-config.yaml")
+# Remote-relative form: used as rsync target / file-mount key so the REMOTE
+# user's home is expanded on the node, not the operator's local home.
+TIK_BOOTSTRAP_CONFIG_REMOTE = "~/.tik/bootstrap-config.yaml"
 TIK_BOOTSTRAP_KEY_FILE = os.path.join(TIK_HOME, "bootstrap-key.pem")
 TIK_RUNTIME_ENV_FILE = os.path.join(TIK_HOME, "runtime-env.json")
 TIK_LOGS_DIR = os.path.join(TIK_HOME, "logs")

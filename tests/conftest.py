@@ -4,14 +4,24 @@ Multi-chip sharding is tested without TPU hardware by asking XLA's host
 platform for 8 virtual devices (SURVEY.md §4: the reference faked multi-node
 with MockProvider threads; the JAX layer can additionally fake a multi-chip
 mesh in one process).
+
+Note: the environment's TPU plugin may force jax_platforms to the hardware
+backend at interpreter startup (sitecustomize), so the env var alone is not
+enough — we re-assert "cpu" through jax.config after import.  This also
+keeps tests off the single TPU chip so they can run concurrently with
+benchmarks.
 """
 
 import os
 
-# Must run before jax is imported anywhere.
+# Must run before jax initializes any backend.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("TIK_TEST_MODE", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,209 @@
+"""In-memory provider + recorded executor for control-plane tests.
+
+Modeled on the reference test strategy (SURVEY.md §4: MockProvider
+test_cloudtik.py:207 with failure injection, MockProcessRunner :91) but
+re-designed for this framework: node groups are first-class, and the
+executor is a CommandExecutor (no subprocess indirection needed).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from cloudtik_tpu.control.executor.base import CommandError, CommandExecutor
+from cloudtik_tpu.core.node_provider import NodeLaunchException, NodeProvider
+from cloudtik_tpu.core.tags import (
+    TAG_NODE_GROUP_ID, TAG_NODE_GROUP_SIZE, TAG_NODE_GROUP_WORKER_INDEX)
+
+
+class MockNode:
+    def __init__(self, node_id: str, tags: Dict[str, str],
+                 resources: Dict[str, float]):
+        self.node_id = node_id
+        self.tags = dict(tags)
+        self.state = "running"          # pending | running | terminated
+        self.resources = dict(resources)
+        self.internal_ip = f"10.0.0.{int(node_id.split('-')[-1]) + 1}"
+        self.external_ip = f"1.2.3.{int(node_id.split('-')[-1]) + 1}"
+        self.created_at = time.time()
+
+
+class MockProvider(NodeProvider):
+    """Dict-backed provider with injectable failures.
+
+    Failure knobs:
+      * fail_creates: raise NodeLaunchException on create
+      * error_creates: raise a plain RuntimeError on create
+      * fail_to_fetch_ip: internal_ip returns None
+    """
+
+    def __init__(self, provider_config=None, cluster_name="test",
+                 with_groups: bool = False):
+        super().__init__(provider_config or {"type": "mock"}, cluster_name)
+        self.lock = threading.RLock()
+        self.nodes: Dict[str, MockNode] = {}
+        self.next_id = 0
+        self.fail_creates = False
+        self.error_creates = False
+        self.fail_to_fetch_ip = False
+        self.with_groups = with_groups
+        self.next_group = 0
+        self.terminated_groups: List[str] = []
+
+    # -- helpers -----------------------------------------------------------
+    def _new_node(self, tags: Dict[str, str],
+                  resources: Dict[str, float]) -> MockNode:
+        node_id = f"node-{self.next_id}"
+        self.next_id += 1
+        node = MockNode(node_id, tags, resources)
+        self.nodes[node_id] = node
+        return node
+
+    def mock_nodes(self, state: str = "running") -> List[MockNode]:
+        with self.lock:
+            return [n for n in self.nodes.values() if n.state == state]
+
+    # -- NodeProvider ------------------------------------------------------
+    def non_terminated_nodes(self, tag_filters):
+        with self.lock:
+            out = []
+            for node in self.nodes.values():
+                if node.state == "terminated":
+                    continue
+                if all(node.tags.get(k) == v for k, v in tag_filters.items()):
+                    out.append(node.node_id)
+            return sorted(out, key=lambda s: int(s.split("-")[-1]))
+
+    def is_running(self, node_id):
+        with self.lock:
+            return self.nodes[node_id].state == "running"
+
+    def is_terminated(self, node_id):
+        with self.lock:
+            node = self.nodes.get(node_id)
+            return node is None or node.state == "terminated"
+
+    def node_tags(self, node_id):
+        with self.lock:
+            return dict(self.nodes[node_id].tags)
+
+    def internal_ip(self, node_id):
+        if self.fail_to_fetch_ip:
+            return None
+        with self.lock:
+            node = self.nodes.get(node_id)
+            return node.internal_ip if node else None
+
+    def external_ip(self, node_id):
+        with self.lock:
+            node = self.nodes.get(node_id)
+            return node.external_ip if node else None
+
+    def create_node(self, node_config, tags, count):
+        if self.fail_creates:
+            raise NodeLaunchException("quota", "mock create failure")
+        if self.error_creates:
+            raise RuntimeError("mock provider error")
+        with self.lock:
+            created = {}
+            for _ in range(count):
+                node = self._new_node(tags, node_config.get("resources", {}))
+                created[node.node_id] = {}
+            return created
+
+    def create_node_with_resources_and_labels(
+            self, node_config, tags, count, resources, labels):
+        if self.fail_creates:
+            raise NodeLaunchException("quota", "mock create failure")
+        with self.lock:
+            created = {}
+            for _ in range(count):
+                node = self._new_node(tags, resources)
+                created[node.node_id] = {}
+            return created
+
+    def set_node_tags(self, node_id, tags):
+        with self.lock:
+            self.nodes[node_id].tags.update(tags)
+
+    def terminate_node(self, node_id):
+        with self.lock:
+            node = self.nodes.get(node_id)
+            if node:
+                node.state = "terminated"
+        return None
+
+    # -- node groups -------------------------------------------------------
+    def supports_node_groups(self):
+        return self.with_groups
+
+    def create_node_group(self, node_config, tags, group_size):
+        if self.fail_creates:
+            raise NodeLaunchException("stockout", "mock group failure")
+        with self.lock:
+            group_id = f"group-{self.next_group}"
+            self.next_group += 1
+            for idx in range(group_size):
+                member_tags = dict(tags)
+                member_tags[TAG_NODE_GROUP_ID] = group_id
+                member_tags[TAG_NODE_GROUP_WORKER_INDEX] = str(idx)
+                member_tags[TAG_NODE_GROUP_SIZE] = str(group_size)
+                self._new_node(member_tags, node_config.get("resources", {}))
+            return group_id
+
+    def terminate_node_group(self, group_id):
+        with self.lock:
+            self.terminated_groups.append(group_id)
+            for node in self.nodes.values():
+                if node.tags.get(TAG_NODE_GROUP_ID) == group_id:
+                    node.state = "terminated"
+
+    def list_node_groups(self, tag_filters):
+        with self.lock:
+            groups: Dict[str, List[str]] = {}
+            for node_id in self.non_terminated_nodes(tag_filters):
+                gid = self.nodes[node_id].tags.get(TAG_NODE_GROUP_ID)
+                if gid:
+                    groups.setdefault(gid, []).append(node_id)
+            for gid in groups:
+                groups[gid].sort(key=lambda n: int(
+                    self.nodes[n].tags[TAG_NODE_GROUP_WORKER_INDEX]))
+            return groups
+
+
+class MockExecutor(CommandExecutor):
+    """Records every command; optional pattern-based failure injection."""
+
+    def __init__(self, node_id: str = "", fail_patterns: Optional[List[str]] = None,
+                 shared_log: Optional[list] = None):
+        super().__init__()
+        self.node_id = node_id
+        self.commands: List[str] = []
+        self.rsyncs: List[tuple] = []
+        self.fail_patterns = fail_patterns or []
+
+        self.shared_log = shared_log
+
+    def run(self, cmd, *, environment_variables=None, with_output=False,
+            run_env="auto", timeout=None, shutdown_after_run=False):
+        self.commands.append(cmd)
+        if self.shared_log is not None:
+            self.shared_log.append((self.node_id, cmd))
+        for pattern in self.fail_patterns:
+            if pattern in cmd:
+                raise CommandError(cmd, 1, "injected failure")
+        return "" if with_output else None
+
+    def run_rsync_up(self, source, target, options=None):
+        self.rsyncs.append(("up", source, target))
+
+    def run_rsync_down(self, source, target, options=None):
+        self.rsyncs.append(("down", source, target))
+
+    def remote_shell_command_str(self):
+        return "/bin/true"
+
+    def assert_has_call(self, pattern: str) -> bool:
+        return any(pattern in c for c in self.commands)

@@ -1,0 +1,211 @@
+"""State store, metrics, demand scheduler, policies, discovery tests."""
+
+import threading
+import time
+
+import pytest
+
+from cloudtik_tpu.control.demand import ResourceDemandScheduler
+from cloudtik_tpu.control.metrics import ClusterMetrics
+from cloudtik_tpu.control.scaling_policies import (
+    ScalingByNodeType, ScalingWithTime, create_scaling_policy)
+from cloudtik_tpu.control.state import (
+    FileStateBackend, InMemoryStateBackend, StateClient, StateServer,
+    TcpStateBackend)
+from cloudtik_tpu.runtimes.discovery.runtime import (
+    ServiceRegistry, node_fqdn, service_fqdn)
+
+
+# ---------------------------------------------------------------- state ----
+
+def test_inmemory_backend_kv():
+    client = StateClient(InMemoryStateBackend())
+    client.kv_put("a", b"1")
+    assert client.kv_get("a") == b"1"
+    assert client.kv_keys() == ["a"]
+    assert client.kv_delete("a")
+    assert client.kv_get("a") is None
+
+
+def test_file_backend_persistence(tmp_path):
+    backend = FileStateBackend(str(tmp_path))
+    backend.put("ns", "k", b"\x00\xffbin")
+    backend2 = FileStateBackend(str(tmp_path))
+    assert backend2.get("ns", "k") == b"\x00\xffbin"
+    assert backend2.keys("ns") == ["k"]
+
+
+def test_tcp_state_server_roundtrip():
+    server = StateServer(host="127.0.0.1", port=0)
+    server.start()
+    try:
+        client = StateClient(TcpStateBackend("127.0.0.1", server.port))
+        client.table_put("t", "key1", {"x": 1, "nested": {"y": [1, 2]}})
+        assert client.table_get("t", "key1") == {"x": 1,
+                                                 "nested": {"y": [1, 2]}}
+        assert client.table_list("t") == {"key1": {"x": 1,
+                                                   "nested": {"y": [1, 2]}}}
+        assert client.table_delete("t", "key1")
+        assert client.backend.ping()
+    finally:
+        server.stop()
+
+
+def test_tcp_state_auth():
+    server = StateServer(host="127.0.0.1", port=0, auth_token="secret")
+    server.start()
+    try:
+        bad = StateClient(TcpStateBackend("127.0.0.1", server.port,
+                                          auth_token="wrong"))
+        with pytest.raises(RuntimeError):
+            bad.kv_put("k", b"v")
+        good = StateClient(TcpStateBackend("127.0.0.1", server.port,
+                                           auth_token="secret"))
+        good.kv_put("k", b"v")
+        assert good.kv_get("k") == b"v"
+    finally:
+        server.stop()
+
+
+def test_tcp_state_concurrent_clients():
+    server = StateServer(host="127.0.0.1", port=0)
+    server.start()
+    errors = []
+
+    def worker(i):
+        try:
+            client = StateClient(TcpStateBackend("127.0.0.1", server.port))
+            for j in range(20):
+                client.table_put("t", f"{i}:{j}", {"v": j})
+            client.backend.close()
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    try:
+        assert not errors
+        client = StateClient(TcpStateBackend("127.0.0.1", server.port))
+        assert len(client.table_list("t")) == 160
+    finally:
+        server.stop()
+
+
+# -------------------------------------------------------------- metrics ----
+
+def test_heartbeat_liveness():
+    metrics = ClusterMetrics(heartbeat_timeout_s=10)
+    metrics.update_heartbeat("10.0.0.1", "n1", time.time())
+    metrics.update_heartbeat("10.0.0.2", "n2", time.time() - 60)
+    assert metrics.heartbeat_on_time("10.0.0.1")
+    assert not metrics.heartbeat_on_time("10.0.0.2")
+    assert not metrics.heartbeat_on_time("10.0.0.3")  # unknown
+
+
+def test_prune_active_ips():
+    metrics = ClusterMetrics()
+    metrics.update_heartbeat("10.0.0.1", "n1")
+    metrics.update_heartbeat("10.0.0.2", "n2")
+    metrics.prune_active_ips(["10.0.0.1"])
+    assert "10.0.0.2" not in metrics.nodes
+
+
+# --------------------------------------------------------------- demand ----
+
+NODE_TYPES = {
+    "head": {"resources": {"CPU": 4}, "min_workers": 0, "max_workers": 0},
+    "cpu": {"resources": {"CPU": 8}, "min_workers": 0, "max_workers": 10},
+    "tpu": {"resources": {"TPU": 4}, "min_workers": 0, "max_workers": 8,
+            "node_group": {"atomic": True, "group_size": 4}},
+}
+
+
+def scheduler(max_workers=18):
+    return ResourceDemandScheduler(NODE_TYPES, max_workers, "head")
+
+
+def test_min_workers_launch():
+    types = {**NODE_TYPES, "cpu": {**NODE_TYPES["cpu"], "min_workers": 3}}
+    s = ResourceDemandScheduler(types, 18, "head")
+    out = s.get_nodes_to_launch({}, {}, [], [])
+    assert out == {"cpu": 3}
+
+
+def test_demand_packs_on_existing_free():
+    s = scheduler()
+    out = s.get_nodes_to_launch(
+        {"cpu": 1}, {}, [{"CPU": 4}], [{"CPU": 8}])
+    assert out == {}  # fits on the existing node
+
+
+def test_demand_launches_new():
+    s = scheduler()
+    out = s.get_nodes_to_launch({}, {}, [{"CPU": 6}], [])
+    assert out == {"cpu": 1}
+
+
+def test_tpu_demand_launches_whole_group():
+    s = scheduler()
+    out = s.get_nodes_to_launch({}, {}, [{"TPU": 8}], [])
+    assert out == {"tpu": 4}  # group_size 4, atomically
+
+
+def test_group_not_partially_capped():
+    # budget of 3 cannot host a group of 4: launch nothing, not a fragment
+    s = scheduler(max_workers=3)
+    out = s.get_nodes_to_launch({}, {}, [{"TPU": 8}], [])
+    assert out == {}
+
+
+def test_pending_counts_respected():
+    s = scheduler()
+    out = s.get_nodes_to_launch({}, {"cpu": 1}, [{"CPU": 6}], [])
+    assert out == {}  # pending node will satisfy it
+
+
+# ------------------------------------------------------------- policies ----
+
+def test_scaling_with_time():
+    policy = ScalingWithTime({}, "h", {
+        "scaling_periods": [
+            {"start": "00:00", "end": "24:00", "min_workers": 3}],
+        "resource_per_worker": {"CPU": 2},
+    })
+    state = policy.get_scaling_state()
+    demands = state.autoscaling_instructions["resource_demands"]
+    assert demands == [{"CPU": 2}] * 3
+
+
+def test_scaling_by_node_type():
+    policy = ScalingByNodeType(
+        {"available_node_types": NODE_TYPES}, "h", {"tpu": 2})
+    state = policy.get_scaling_state()
+    assert state.autoscaling_instructions["resource_demands"] == [
+        {"TPU": 4}, {"TPU": 4}]
+
+
+def test_policy_factory():
+    assert create_scaling_policy("none", {}, "h") is None
+    assert create_scaling_policy(
+        "scaling-with-time", {}, "h").name() == "scaling-with-time"
+    with pytest.raises(ValueError):
+        create_scaling_policy("bogus", {}, "h")
+
+
+# ------------------------------------------------------------ discovery ----
+
+def test_service_registry_and_naming():
+    client = StateClient(InMemoryStateBackend())
+    registry = ServiceRegistry(client, "c1", "w1")
+    registry.register("mlflow", "node-0", "10.0.0.1", 5000, "http")
+    registry.register("mlflow", "node-1", "10.0.0.2", 5000, "http")
+    services = registry.services_by_name()
+    assert set(services) == {"mlflow"}
+    assert len(services["mlflow"]["nodes"]) == 2
+    assert node_fqdn("c1", "w1", 3) == "c1-3.w1.tik"
+    assert service_fqdn("mlflow", "c1", "w1") == "mlflow.c1.w1.tik"
+    registry.deregister("mlflow", "node-0")
+    assert len(registry.services_by_name()["mlflow"]["nodes"]) == 1

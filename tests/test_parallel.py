@@ -1,0 +1,79 @@
+"""Mesh + sharding rules tests on the virtual 8-device CPU mesh."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from cloudtik_tpu.parallel.mesh import MeshConfig, build_mesh, data_axis_size
+from cloudtik_tpu.parallel.sharding import (
+    DEFAULT_RULES, batch_sharding, logical_to_spec, make_rules,
+    tree_to_shardings)
+
+
+def test_eight_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_mesh_fill_axis():
+    mesh = build_mesh(MeshConfig())  # fsdp = -1 fills
+    assert mesh.shape["fsdp"] == 8
+    assert data_axis_size(mesh) == 8
+
+
+def test_mesh_explicit_axes():
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2, seq=1))
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["tensor"] == 2
+    assert mesh.devices.size == 8
+
+
+def test_mesh_bad_sizes():
+    with pytest.raises(ValueError):
+        build_mesh(MeshConfig(data=3, fsdp=1))  # 3 doesn't divide 8
+    with pytest.raises(ValueError):
+        MeshConfig(data=-1, fsdp=-1).axis_sizes(8)  # two fills
+
+
+def test_logical_to_spec_drops_absent_axes():
+    mesh = build_mesh(MeshConfig(data=2, fsdp=4))  # no tensor axis > 1
+    spec = logical_to_spec(("embed", "mlp"), DEFAULT_RULES, mesh)
+    # embed -> fsdp (present), mlp -> tensor (size-1: kept name but valid)
+    assert spec[0] == "fsdp"
+
+
+def test_logical_to_spec_no_duplicate_axes():
+    mesh = build_mesh(MeshConfig(data=2, fsdp=4))
+    # batch uses (data, fsdp); a second logical axis mapping to data must be
+    # dropped rather than produce an invalid duplicate spec.
+    rules = make_rules(seq=("data",))
+    spec = logical_to_spec(("batch", "seq"), rules, mesh)
+    flat = []
+    for part in spec:
+        if isinstance(part, tuple):
+            flat.extend(part)
+        elif part is not None:
+            flat.append(part)
+    assert len(flat) == len(set(flat))
+
+
+def test_batch_sharding_layout():
+    mesh = build_mesh(MeshConfig(data=2, fsdp=4))
+    sharding = batch_sharding(mesh)
+    x = jax.device_put(np.zeros((16, 4), np.float32), sharding)
+    # 8-way split over batch dim
+    assert x.addressable_shards[0].data.shape == (2, 4)
+
+
+def test_tree_to_shardings():
+    mesh = build_mesh(MeshConfig(data=1, fsdp=8))
+    tree = {"w": ("embed", "mlp"), "b": ("norm",)}
+    shardings = tree_to_shardings(mesh, tree)
+    assert shardings["w"].spec == P("fsdp", None)
+    assert shardings["b"].spec == P(None)
+
+
+def test_unknown_logical_axis():
+    mesh = build_mesh(MeshConfig())
+    with pytest.raises(ValueError):
+        logical_to_spec(("nonsense",), DEFAULT_RULES, mesh)

@@ -1,0 +1,211 @@
+"""ClusterScaler reconciliation tests with the mock provider/executor."""
+
+import time
+
+import pytest
+
+from cloudtik_tpu.control.metrics import ClusterMetrics
+from cloudtik_tpu.control.scaler import ClusterScaler
+from cloudtik_tpu.core.runtime import NodeConstraint
+from cloudtik_tpu.core.tags import (
+    NODE_KIND_HEAD, NODE_KIND_WORKER, STATUS_UP_TO_DATE,
+    TAG_NODE_GROUP_ID, TAG_NODE_KIND, TAG_NODE_STATUS, TAG_USER_NODE_TYPE)
+
+from tests.mock_infra import MockExecutor, MockProvider
+
+
+def base_config(min_workers=2, max_workers=5, with_tpu_group=False):
+    node_types = {
+        "head": {"node_config": {}, "resources": {"CPU": 4},
+                 "min_workers": 0, "max_workers": 0},
+        "worker": {"node_config": {}, "resources": {"CPU": 4},
+                   "min_workers": min_workers, "max_workers": max_workers},
+    }
+    if with_tpu_group:
+        node_types["tpu"] = {
+            "node_config": {}, "resources": {"TPU": 4},
+            "min_workers": 0, "max_workers": 8,
+            "node_group": {"atomic": True, "group_size": 4,
+                           "accelerator_type": "v5p-32"},
+        }
+    return {
+        "cluster_name": "t",
+        "workspace_name": "w",
+        "provider": {"type": "mock"},
+        "available_node_types": node_types,
+        "head_node_type": "head",
+        "max_workers": max_workers + 8,
+        "auth": {},
+        "file_mounts": {},
+        "setup_commands": ["setup-cmd"],
+        "worker_setup_commands": [],
+        "worker_start_commands": ["start-cmd"],
+        "initialization_commands": [],
+        "idle_timeout_minutes": 5,
+    }
+
+
+def make_scaler(config, provider, executors=None, constraints=None):
+    metrics = ClusterMetrics()
+    executors = executors if executors is not None else {}
+
+    def factory(node_id):
+        executor = MockExecutor(node_id)
+        executors[node_id] = executor
+        return executor
+
+    scaler = ClusterScaler(
+        config, provider, metrics,
+        executor_factory=factory, node_constraints=constraints,
+        num_launcher_threads=1)
+    return scaler, metrics, executors
+
+
+def wait_for(predicate, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def drain(scaler, passes=5, sleep=0.2):
+    for _ in range(passes):
+        scaler.update()
+        time.sleep(sleep)
+
+
+def test_scale_up_to_min_workers():
+    provider = MockProvider()
+    config = base_config(min_workers=2)
+    scaler, metrics, executors = make_scaler(config, provider)
+    scaler.update()
+    assert wait_for(lambda: len(provider.mock_nodes()) == 2)
+    # subsequent reconciliation passes spawn updaters for the new nodes
+    def all_up_to_date():
+        scaler.update()
+        nodes = provider.non_terminated_nodes({})
+        return nodes and all(
+            provider.node_tags(n).get(TAG_NODE_STATUS) == STATUS_UP_TO_DATE
+            for n in nodes)
+    assert wait_for(all_up_to_date, timeout=15)
+    some_exec = next(iter(executors.values()))
+    assert some_exec.assert_has_call("setup-cmd")
+    assert some_exec.assert_has_call("start-cmd")
+    scaler.shutdown()
+
+
+def test_scale_down_over_max():
+    provider = MockProvider()
+    config = base_config(min_workers=0, max_workers=1)
+    # pre-create 3 workers with the correct launch hash
+    scaler, metrics, executors = make_scaler(config, provider)
+    for _ in range(3):
+        provider.create_node({}, {
+            TAG_NODE_KIND: NODE_KIND_WORKER,
+            TAG_USER_NODE_TYPE: "worker",
+            TAG_NODE_STATUS: STATUS_UP_TO_DATE,
+        }, 1)
+    scaler.update()
+    assert len(provider.mock_nodes()) == 1
+    scaler.shutdown()
+
+
+def test_demand_triggers_launch():
+    provider = MockProvider()
+    config = base_config(min_workers=0, max_workers=5)
+    scaler, metrics, executors = make_scaler(config, provider)
+    metrics.set_resource_demands([{"CPU": 4}, {"CPU": 4}])
+    scaler.update()
+    assert wait_for(lambda: len(provider.mock_nodes()) == 2)
+    scaler.shutdown()
+
+
+def test_tpu_group_launched_atomically():
+    provider = MockProvider(with_groups=True)
+    config = base_config(min_workers=0, with_tpu_group=True)
+    scaler, metrics, executors = make_scaler(config, provider)
+    metrics.set_resource_demands([{"TPU": 16}])  # one v5p-32 group (4 hosts)
+    scaler.update()
+    assert wait_for(lambda: len(provider.mock_nodes()) == 4)
+    groups = provider.list_node_groups({})
+    assert len(groups) == 1
+    assert len(next(iter(groups.values()))) == 4
+    scaler.shutdown()
+
+
+def test_unhealthy_group_member_recycles_whole_group():
+    provider = MockProvider(with_groups=True)
+    config = base_config(min_workers=0, with_tpu_group=True)
+    config["available_node_types"]["tpu"]["min_workers"] = 0
+    scaler, metrics, executors = make_scaler(config, provider)
+    group_id = provider.create_node_group(
+        {}, {TAG_NODE_KIND: NODE_KIND_WORKER,
+             TAG_USER_NODE_TYPE: "tpu",
+             TAG_NODE_STATUS: STATUS_UP_TO_DATE}, 4)
+    nodes = provider.non_terminated_nodes({})
+    # heartbeats for all but one member
+    now = time.time()
+    for node_id in nodes[1:]:
+        metrics.update_heartbeat(provider.internal_ip(node_id), node_id, now)
+    metrics.update_heartbeat(provider.internal_ip(nodes[0]), nodes[0],
+                             now - 120)  # stale -> unhealthy
+    scaler.update()
+    assert provider.terminated_groups == [group_id]
+    assert len(provider.mock_nodes()) == 0
+    scaler.shutdown()
+
+
+def test_unhealthy_plain_node_recovered_via_restart():
+    provider = MockProvider()
+    config = base_config(min_workers=1)
+    scaler, metrics, executors = make_scaler(config, provider)
+    provider.create_node({}, {
+        TAG_NODE_KIND: NODE_KIND_WORKER,
+        TAG_USER_NODE_TYPE: "worker",
+        TAG_NODE_STATUS: STATUS_UP_TO_DATE,
+    }, 1)
+    node_id = provider.non_terminated_nodes({})[0]
+    metrics.update_heartbeat(provider.internal_ip(node_id), node_id,
+                             time.time() - 120)
+    scaler.update()
+    assert wait_for(lambda: node_id in executors and
+                    executors[node_id].assert_has_call("start-cmd"),
+                    timeout=10)
+    # recovery runs start commands only (restart_only), not setup
+    assert not executors[node_id].assert_has_call("setup-cmd")
+    scaler.shutdown()
+
+
+def test_quorum_holds_partial_launch():
+    provider = MockProvider()
+    config = base_config(min_workers=0, max_workers=5)
+    constraints = {"worker": NodeConstraint(minimal=3, quorum=True)}
+    scaler, metrics, executors = make_scaler(
+        config, provider, constraints=constraints)
+    # demand for 2 nodes < minimal 3: launch must be held
+    metrics.set_resource_demands([{"CPU": 4}, {"CPU": 4}])
+    scaler.update()
+    time.sleep(0.5)
+    assert len(provider.mock_nodes()) == 0
+    # demand for 3 nodes: launch proceeds
+    metrics.set_resource_demands([{"CPU": 4}] * 3)
+    scaler.update()
+    assert wait_for(lambda: len(provider.mock_nodes()) == 3)
+    scaler.shutdown()
+
+
+def test_launch_failure_does_not_wedge_pending():
+    provider = MockProvider()
+    provider.fail_creates = True
+    config = base_config(min_workers=2)
+    scaler, metrics, executors = make_scaler(config, provider)
+    scaler.update()
+    assert wait_for(lambda: scaler.pending_launches.total() == 0)
+    assert len(provider.mock_nodes()) == 0
+    # provider recovers -> next pass launches
+    provider.fail_creates = False
+    scaler.update()
+    assert wait_for(lambda: len(provider.mock_nodes()) == 2)
+    scaler.shutdown()

@@ -1,0 +1,94 @@
+"""Transformer model tests (CPU, tiny config)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cloudtik_tpu.models import transformer as T
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = T.config("tiny", attention_impl="reference")
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def test_param_count_matches_estimate(tiny):
+    cfg, params = tiny
+    actual = sum(x.size for x in jax.tree.leaves(params))
+    assert actual == cfg.num_params()
+
+
+def test_logical_axes_structure_matches_params(tiny):
+    cfg, params = tiny
+    axes = T.param_logical_axes(cfg)
+    jax.tree.map(
+        lambda p, a: None, params, axes,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            isinstance(e, (str, type(None))) for e in x))
+    # ndim of each param equals length of its axis tuple
+    flat_p = jax.tree.leaves(params)
+    flat_a = jax.tree.leaves(
+        axes, is_leaf=lambda x: isinstance(x, tuple))
+    for p, a in zip(flat_p, flat_a):
+        assert p.ndim == len(a), (p.shape, a)
+
+
+def test_forward_shapes_and_dtype(tiny):
+    cfg, params = tiny
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = T.forward(params, tokens, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_causality(tiny):
+    """Changing a future token must not affect earlier logits."""
+    cfg, params = tiny
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (1, 32)).astype(np.int32)
+    logits1 = T.forward(params, jnp.asarray(tokens), cfg)
+    tokens2 = tokens.copy()
+    tokens2[0, -1] = (tokens2[0, -1] + 1) % cfg.vocab_size
+    logits2 = T.forward(params, jnp.asarray(tokens2), cfg)
+    np.testing.assert_allclose(
+        np.asarray(logits1[0, :-1]), np.asarray(logits2[0, :-1]),
+        rtol=2e-2, atol=2e-2)
+    assert not np.allclose(np.asarray(logits1[0, -1]),
+                           np.asarray(logits2[0, -1]), atol=1e-3)
+
+
+def test_loss_ignores_masked_labels(tiny):
+    cfg, params = tiny
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    labels = jnp.full((2, 16), -100, jnp.int32)
+    labels = labels.at[0, 0].set(5)
+    loss, metrics = T.loss_fn(params, {"tokens": tokens, "labels": labels}, cfg)
+    assert jnp.isfinite(loss)
+    assert int(metrics["n_tokens"]) == 1
+
+
+def test_gradients_flow(tiny):
+    cfg, params = tiny
+    tokens = jnp.ones((1, 16), jnp.int32)
+    labels = jnp.ones((1, 16), jnp.int32)
+
+    def loss(p):
+        return T.loss_fn(p, {"tokens": tokens, "labels": labels}, cfg)[0]
+
+    grads = jax.grad(loss)(params)
+    norms = jax.tree.map(lambda g: float(jnp.abs(g).max()), grads)
+    flat = jax.tree.leaves(norms)
+    assert all(np.isfinite(v) for v in flat)
+    assert any(v > 0 for v in flat)
+
+
+def test_rope_position_dependence():
+    x = jnp.ones((1, 4, 2, 8), jnp.float32)
+    p1 = jnp.arange(4, dtype=jnp.int32)[None]
+    p2 = p1 + 7
+    r1 = T._rope(x, p1, 10_000.0)
+    r2 = T._rope(x, p2, 10_000.0)
+    assert not np.allclose(np.asarray(r1), np.asarray(r2))
