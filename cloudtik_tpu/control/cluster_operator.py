@@ -388,8 +388,9 @@ def submit_to_cluster(
         remote_dir = "~/.tik/jobs"
         remote_path = f"{remote_dir}/{os.path.basename(script)}"
         executor.run(f"mkdir -p {remote_dir}")
-        executor.run_rsync_up(os.path.expanduser(script),
-                              os.path.expanduser(remote_path))
+        # remote_path is relative to the REMOTE user's home — expanding it
+        # with the local operator's home would break whenever they differ.
+        executor.run_rsync_up(os.path.expanduser(script), remote_path)
         runnable: Optional[List[str]] = None
         for runtime in iter_runtimes(config):
             runnable = runtime.get_runnable_command(remote_path, None)
@@ -569,13 +570,6 @@ def wait_for_ready(config: Dict[str, Any],
         time.sleep(5)
     raise TimeoutError(
         f"cluster not ready after {timeout}s (want {min_workers} workers)")
-
-
-def load_head_bootstrap_config(
-        path: str = TIK_BOOTSTRAP_CONFIG_FILE) -> Dict[str, Any]:
-    import yaml
-    with open(os.path.expanduser(path)) as f:
-        return yaml.safe_load(f)
 
 
 def monitor_cluster(config: Dict[str, Any], follow: bool = False) -> str:

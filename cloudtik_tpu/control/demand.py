@@ -93,6 +93,16 @@ class ResourceDemandScheduler:
                 unfulfilled.append(demand)
 
         for demand in unfulfilled:
+            # Leftover capacity appended by earlier unfulfilled launches may
+            # already cover this demand — re-check before launching more.
+            placed = False
+            for f in free:
+                if _fits(demand, f):
+                    _consume(demand, f)
+                    placed = True
+                    break
+            if placed:
+                continue
             name = self._pick_node_type(demand)
             if name is None:
                 continue

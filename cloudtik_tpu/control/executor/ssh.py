@@ -7,6 +7,8 @@ SSHCommandExecutor, _run_helper).
 from __future__ import annotations
 
 import os
+import posixpath
+import shlex
 import subprocess
 import time
 from typing import Any, Dict, List, Optional
@@ -113,7 +115,14 @@ class SSHCommandExecutor(CommandExecutor):
         return " ".join(["ssh"] + self.ssh_options.to_ssh_args())
 
     def run_rsync_up(self, source, target, options=None):
-        args = ["rsync", "-avz", "--delete", "-e", self._rsync_rsh(),
+        # First-boot nodes lack the target's parent dirs (e.g. ~/.tik);
+        # rsync does not create them, so make them in the same remote call.
+        parent = posixpath.dirname(target.rstrip("/"))
+        rsync_path = "rsync"
+        if parent and parent not in ("/", "~"):
+            rsync_path = f"mkdir -p {_remote_path_arg(parent)} && rsync"
+        args = ["rsync", "-avz", "--delete",
+                "--rsync-path", rsync_path, "-e", self._rsync_rsh(),
                 source, f"{self.ssh_user}@{self.ssh_ip}:{target}"]
         self.process_runner.check_call(args)
 
@@ -139,5 +148,14 @@ class SSHCommandExecutor(CommandExecutor):
 
 
 def _quote(s: str) -> str:
-    import shlex
     return shlex.quote(s)
+
+
+def _remote_path_arg(path: str) -> str:
+    """Quote a remote path but leave a leading ~ bare so the remote shell
+    expands it (a quoted ~ is a literal directory named '~')."""
+    if path == "~":
+        return path
+    if path.startswith("~/"):
+        return "~/" + shlex.quote(path[2:])
+    return shlex.quote(path)

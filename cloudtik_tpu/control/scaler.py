@@ -32,7 +32,8 @@ from cloudtik_tpu.core.tags import (
     TAG_LAUNCH_CONFIG, TAG_NODE_GROUP_ID, TAG_NODE_KIND, TAG_NODE_STATUS,
     TAG_RUNTIME_CONFIG, TAG_USER_NODE_TYPE)
 from cloudtik_tpu.utils.constants import (
-    TIK_MAX_CONCURRENT_LAUNCHES, TIK_MAX_CONCURRENT_UPDATES)
+    TIK_BOOT_GRACE_S, TIK_MAX_CONCURRENT_LAUNCHES,
+    TIK_MAX_CONCURRENT_UPDATES)
 
 logger = logging.getLogger(__name__)
 
@@ -113,6 +114,10 @@ class ClusterScaler:
         self.updaters: Dict[str, NodeUpdaterThread] = {}
         self.num_failed_updates: Dict[str, int] = {}
         self.num_successful_updates: Dict[str, int] = {}
+        # When each node was first seen UP_TO_DATE: a node gets
+        # TIK_BOOT_GRACE_S from that point to deliver its first heartbeat
+        # before a missing one counts as unhealthy.
+        self.first_up_to_date_time: Dict[str, float] = {}
         self.disable_node_updaters = config.get(
             "disable_node_updaters", False)
 
@@ -187,14 +192,20 @@ class ClusterScaler:
 
     def terminate_nodes(self, nodes: NonTerminatedNodes,
                         to_terminate: Set[str]) -> None:
-        groups = self.quorum.groups_of(sorted(to_terminate))
+        # Terminating any member of an atomic group takes the whole group
+        # down — expand first so the snapshot and updater map reflect every
+        # node that actually dies, not just the ones the caller named.
+        expanded = self.quorum.expand_to_group(sorted(to_terminate))
+        groups = self.quorum.groups_of(sorted(expanded))
+        all_dead: Set[str] = set()
         for group_id, members in groups.items():
             if group_id and self.provider.supports_node_groups():
                 self.provider.terminate_node_group(group_id)
             else:
                 self.provider.terminate_nodes(members)
-        nodes.remove(to_terminate)
-        for node_id in to_terminate:
+            all_dead.update(members)
+        nodes.remove(all_dead)
+        for node_id in all_dead:
             self.updaters.pop(node_id, None)
 
     # ------------------------------------------------------------------
@@ -207,7 +218,16 @@ class ClusterScaler:
             if tags.get(TAG_NODE_STATUS) != STATUS_UP_TO_DATE:
                 continue  # still bootstrapping; updater owns it
             ip = self.provider.internal_ip(node_id)
-            if ip and not self.metrics.heartbeat_on_time(ip, now):
+            if not ip:
+                continue
+            if ip not in self.metrics.nodes:
+                # No heartbeat EVER seen: the agent is still coming up.
+                # Give it a boot-grace window from when the node first went
+                # up-to-date before condemning it (and its whole group).
+                first = self.first_up_to_date_time.setdefault(node_id, now)
+                if now - first < TIK_BOOT_GRACE_S:
+                    continue
+            if not self.metrics.heartbeat_on_time(ip, now):
                 unhealthy.append(node_id)
         lost = set(self.metrics.lost_nodes)
         unhealthy.extend(n for n in lost if n in nodes.worker_ids)

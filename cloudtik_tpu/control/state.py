@@ -14,6 +14,7 @@ backends, one client API:
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import socket
@@ -83,12 +84,28 @@ class FileStateBackend(StateBackend):
     """One JSON file per namespace under a root dir, with a process lock.
 
     Reference parity: file_state_store.py:26 (TransactionContext file locks).
+    The backend is shared by independent processes (head controller + any
+    number of CLI invocations on the same host), so every read-modify-write
+    holds an fcntl flock on a sidecar lock file in addition to the
+    in-process RLock.
     """
 
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
         self._lock = threading.RLock()
+        self._lock_path = os.path.join(root, ".lock")
+
+    @contextlib.contextmanager
+    def _flock(self):
+        import fcntl
+        with self._lock:
+            with open(self._lock_path, "w") as lf:
+                fcntl.flock(lf, fcntl.LOCK_EX)
+                try:
+                    yield
+                finally:
+                    fcntl.flock(lf, fcntl.LOCK_UN)
 
     def _path(self, ns: str) -> str:
         safe = ns.replace("/", "_")
@@ -108,18 +125,18 @@ class FileStateBackend(StateBackend):
         os.replace(tmp, self._path(ns))
 
     def put(self, ns, key, value):
-        with self._lock:
+        with self._flock():
             data = self._load(ns)
             data[key] = value.hex()
             self._store(ns, data)
 
     def get(self, ns, key):
-        with self._lock:
+        with self._flock():
             v = self._load(ns).get(key)
             return bytes.fromhex(v) if v is not None else None
 
     def delete(self, ns, key):
-        with self._lock:
+        with self._flock():
             data = self._load(ns)
             existed = data.pop(key, None) is not None
             if existed:
@@ -127,7 +144,7 @@ class FileStateBackend(StateBackend):
             return existed
 
     def keys(self, ns, prefix=""):
-        with self._lock:
+        with self._flock():
             return sorted(k for k in self._load(ns) if k.startswith(prefix))
 
 

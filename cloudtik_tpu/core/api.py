@@ -193,8 +193,8 @@ class ThisCluster:
     """Self API usable from a process running *on* the cluster head."""
 
     def __init__(self):
-        from cloudtik_tpu.control import cluster_operator
-        self.config = cluster_operator.load_head_bootstrap_config()
+        from cloudtik_tpu.control.services import load_bootstrap_config
+        self.config = load_bootstrap_config()
 
     @property
     def name(self) -> str:

@@ -23,6 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from cloudtik_tpu.parallel.mesh import MeshConfig, build_mesh
 from cloudtik_tpu.parallel.sharding import (
     AxisRules, DEFAULT_RULES, batch_sharding, tree_to_shardings)
+from cloudtik_tpu.train.checkpoint import CheckpointConfig, Checkpointer
 from cloudtik_tpu.train.optim import OptimizerConfig, make_optimizer
 
 # Peak bf16 FLOPs/s per chip by TPU generation (public spec sheet numbers),
@@ -100,6 +101,11 @@ class Trainer:
         self.state = None
         self.step = 0
         self._jitted_step = None
+        self.checkpointer: Optional[Checkpointer] = None
+        if config.checkpoint_dir and config.checkpoint_every:
+            self.checkpointer = Checkpointer(CheckpointConfig(
+                directory=config.checkpoint_dir,
+                save_interval_steps=config.checkpoint_every))
 
     # -- state -------------------------------------------------------------
     def init_state(self, rng: jax.Array) -> None:
@@ -143,6 +149,53 @@ class Trainer:
             return shapes_to_shard.get(leaf.shape, replicated)
 
         return jax.tree.map(pick, opt_shape)
+
+    # -- checkpoint --------------------------------------------------------
+    def save_checkpoint(self, force: bool = False) -> bool:
+        """Async-save current state; returns True if a save started."""
+        if self.checkpointer is None:
+            raise RuntimeError("checkpointing not configured "
+                               "(set checkpoint_dir + checkpoint_every)")
+        return self.checkpointer.save(self.step, self.state, force=force)
+
+    def restore_checkpoint(self, step: Optional[int] = None) -> int:
+        """Restore state (sharded, per-host local reads); returns the step.
+
+        The restore target is an *abstract* pytree (shapes + shardings via
+        eval_shape) — no init compute runs and no second copy of the state
+        is ever resident.
+        """
+        if self.checkpointer is None:
+            raise RuntimeError("checkpointing not configured")
+        step = (step if step is not None
+                else self.checkpointer.latest_step())
+        self.state = self.checkpointer.restore(
+            self._abstract_state(), step=step)
+        self.step = int(step)
+        return self.step
+
+    def _abstract_state(self):
+        """ShapeDtypeStructs with shardings for {params, opt_state}."""
+        def _init(rng):
+            params = self.spec.init(rng)
+            return {"params": params,
+                    "opt_state": self.optimizer.init(params)}
+
+        shapes = jax.eval_shape(_init, jax.random.PRNGKey(0))
+        shardings = {"params": self.param_shardings,
+                     "opt_state": self._opt_state_shardings()}
+        return jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            shapes, shardings)
+
+    def maybe_resume(self) -> Optional[int]:
+        """Resume from the latest checkpoint if one exists."""
+        if self.checkpointer is None:
+            return None
+        latest = self.checkpointer.latest_step()
+        if latest is None:
+            return None
+        return self.restore_checkpoint(latest)
 
     # -- step --------------------------------------------------------------
     def _build_step(self):
@@ -202,6 +255,10 @@ class Trainer:
                 self.state, metrics = jitted(self.state, batch)
                 self.step += 1
                 window_steps += 1
+                if (self.checkpointer is not None
+                        and self.config.checkpoint_every
+                        and self.step % self.config.checkpoint_every == 0):
+                    self.checkpointer.save(self.step, self.state)
                 if self.step % self.config.log_every == 0:
                     jax.block_until_ready(metrics)
                     dt = time.perf_counter() - t_window

@@ -34,6 +34,11 @@ def env_bool(key: str, default: bool) -> bool:
 TIK_UPDATE_INTERVAL_S = env_integer("TIK_UPDATE_INTERVAL_S", 5)
 # Node agent heartbeat period (reference: 1s, constants.py:136).
 TIK_HEARTBEAT_PERIOD_S = env_float("TIK_HEARTBEAT_PERIOD_S", 1.0)
+# Grace window after a node's bootstrap completes before a missing
+# heartbeat may condemn it (the freshly-started agent needs time to import,
+# connect, and publish its first heartbeat).
+TIK_BOOT_GRACE_S = env_integer("TIK_BOOT_GRACE_S", 120)
+
 # Heartbeat timeout before a node is unhealthy (reference: 30s).
 TIK_HEARTBEAT_TIMEOUT_S = env_integer("TIK_HEARTBEAT_TIMEOUT_S", 30)
 # Max boot time the scaler tolerates before declaring a launch failed.

@@ -153,6 +153,15 @@ def test_tpu_demand_launches_whole_group():
     assert out == {"tpu": 4}  # group_size 4, atomically
 
 
+def test_leftover_group_capacity_absorbs_later_demands():
+    # Two {TPU:4} demands: the first launches one 4-host group ({TPU:16}
+    # total); its leftover {TPU:12} must absorb the second demand instead
+    # of provisioning (and billing) a second slice.
+    s = scheduler()
+    out = s.get_nodes_to_launch({}, {}, [{"TPU": 4}, {"TPU": 4}], [])
+    assert out == {"tpu": 4}
+
+
 def test_group_not_partially_capped():
     # budget of 3 cannot host a group of 4: launch nothing, not a fragment
     s = scheduler(max_workers=3)
