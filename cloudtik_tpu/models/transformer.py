@@ -45,25 +45,45 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32     # master param dtype
     tie_embeddings: bool = False
     remat: bool = True                 # rematerialize each layer in backward
-    attention_impl: Optional[str] = None  # None=auto, "flash", "reference"
+    attention_impl: Optional[str] = None  # None=auto, "flash", "reference",
+    #                                       "ring" (sequence parallel)
+    # Mixture of experts: n_experts > 1 turns every MLP into an
+    # expert-parallel MoE block (ops/moe.py; `expert` mesh axis).
+    n_experts: int = 1
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 1
+
+    def moe_config(self):
+        from cloudtik_tpu.ops.moe import MoEConfig
+
+        return MoEConfig(num_experts=self.n_experts, top_k=self.moe_top_k,
+                         capacity_factor=self.moe_capacity_factor)
+
     def flops_per_token(self) -> float:
-        """Approximate training FLOPs per token (fwd+bwd), 6N + attention."""
-        n_params = self.num_params(include_embed=False)
+        """Approximate training FLOPs per token (fwd+bwd), 6N_active."""
+        n_params = self.num_params(include_embed=False, active_only=True)
         attn = 12 * self.n_layers * self.d_model * self.max_seq_len
         return 6 * n_params + attn
 
-    def num_params(self, include_embed: bool = True) -> int:
+    def num_params(self, include_embed: bool = True,
+                   active_only: bool = False) -> int:
         d, f, L = self.d_model, self.d_ff, self.n_layers
+        n_ffn = (min(self.moe_top_k, self.n_experts) if active_only
+                 else self.n_experts)
         per_layer = (
             d * self.n_heads * self.head_dim            # wq
             + 2 * d * self.n_kv_heads * self.head_dim   # wk, wv
             + self.n_heads * self.head_dim * d          # wo
-            + 3 * d * f                                  # gate, up, down
+            + n_ffn * 3 * d * f                          # gate, up, down
+            + (d * self.n_experts if self.is_moe else 0)  # router
             + 2 * d)                                     # norms
         total = L * per_layer + d                        # final norm
         if include_embed:
@@ -87,6 +107,13 @@ PRESETS: Dict[str, TransformerConfig] = {
     "tiny": TransformerConfig(
         vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
         d_ff=128, max_seq_len=128, remat=False),
+    # Expert-parallel flagship: ~8x1B-style sparse model.
+    "tpu_moe_8x1b": TransformerConfig(
+        vocab_size=32_000, d_model=2048, n_layers=16, n_heads=16,
+        n_kv_heads=16, d_ff=5504, max_seq_len=2048, n_experts=8),
+    "tiny_moe": TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, remat=False, n_experts=4),
 }
 
 
@@ -105,12 +132,22 @@ def param_logical_axes(cfg: TransformerConfig) -> Params:
         "wk": ("layers", "embed", "heads", "kv"),
         "wv": ("layers", "embed", "heads", "kv"),
         "wo": ("layers", "heads", "kv", "embed"),
-        "w_gate": ("layers", "embed", "mlp"),
-        "w_up": ("layers", "embed", "mlp"),
-        "w_down": ("layers", "mlp", "embed"),
         "ln_attn": ("layers", "norm"),
         "ln_mlp": ("layers", "norm"),
     }
+    if cfg.is_moe:
+        layers.update({
+            "w_router": ("layers", "embed", None),
+            "w_gate": ("layers", "expert", "embed", "mlp"),
+            "w_up": ("layers", "expert", "embed", "mlp"),
+            "w_down": ("layers", "expert", "mlp", "embed"),
+        })
+    else:
+        layers.update({
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        })
     axes = {
         "embed": ("vocab", "embed"),
         "layers": layers,
@@ -130,18 +167,29 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
         return (jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
                 * (fan_in ** -0.5)).astype(cfg.param_dtype)
 
-    ks = jax.random.split(k_layers, 7)
+    ks = jax.random.split(k_layers, 8)
     layers = {
         "wq": dense_init(ks[0], (L, d, H, Dh), d),
         "wk": dense_init(ks[1], (L, d, Hkv, Dh), d),
         "wv": dense_init(ks[2], (L, d, Hkv, Dh), d),
         "wo": dense_init(ks[3], (L, H, Dh, d), H * Dh),
-        "w_gate": dense_init(ks[4], (L, d, f), d),
-        "w_up": dense_init(ks[5], (L, d, f), d),
-        "w_down": dense_init(ks[6], (L, f, d), f),
         "ln_attn": jnp.ones((L, d), cfg.param_dtype),
         "ln_mlp": jnp.ones((L, d), cfg.param_dtype),
     }
+    if cfg.is_moe:
+        E = cfg.n_experts
+        layers.update({
+            "w_router": dense_init(ks[7], (L, d, E), d),
+            "w_gate": dense_init(ks[4], (L, E, d, f), d),
+            "w_up": dense_init(ks[5], (L, E, d, f), d),
+            "w_down": dense_init(ks[6], (L, E, f, d), f),
+        })
+    else:
+        layers.update({
+            "w_gate": dense_init(ks[4], (L, d, f), d),
+            "w_up": dense_init(ks[5], (L, d, f), d),
+            "w_down": dense_init(ks[6], (L, f, d), f),
+        })
     params = {
         "embed": dense_init(k_embed, (cfg.vocab_size, d), 1),
         "layers": layers,
@@ -178,7 +226,7 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 
 def _layer(cfg: TransformerConfig, x: jax.Array, layer: Params,
-           positions: jax.Array) -> jax.Array:
+           positions: jax.Array) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     B, S, d = x.shape
     # Attention block.
     h = _rms_norm(x, layer["ln_attn"], cfg.norm_eps)
@@ -196,15 +244,23 @@ def _layer(cfg: TransformerConfig, x: jax.Array, layer: Params,
     o = o.transpose(0, 2, 1, 3)  # back to [B, S, H, Dh]
     attn_out = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(cfg.dtype))
     x = x + attn_out
-    # MLP block (SwiGLU).
+    # MLP block (SwiGLU), dense or expert-parallel MoE.
     h = _rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
-    gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(cfg.dtype))
-    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
-    act = jax.nn.silu(gate) * up
-    act = with_sharding_constraint(act, "batch", "seq", "mlp")
-    down = jnp.einsum("bsf,fd->bsd", act, layer["w_down"].astype(cfg.dtype))
+    aux: Dict[str, jax.Array] = {}
+    if cfg.is_moe:
+        from cloudtik_tpu.ops.moe import moe_ffn
+
+        down, aux = moe_ffn(
+            h, layer["w_router"], layer["w_gate"], layer["w_up"],
+            layer["w_down"], cfg.moe_config())
+    else:
+        gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(cfg.dtype))
+        up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
+        act = jax.nn.silu(gate) * up
+        act = with_sharding_constraint(act, "batch", "seq", "mlp")
+        down = jnp.einsum("bsf,fd->bsd", act, layer["w_down"].astype(cfg.dtype))
     x = x + down
-    return with_sharding_constraint(x, "batch", "seq", None)
+    return with_sharding_constraint(x, "batch", "seq", None), aux
 
 
 def forward(
@@ -212,8 +268,13 @@ def forward(
     tokens: jax.Array,
     cfg: TransformerConfig,
     positions: Optional[jax.Array] = None,
-) -> jax.Array:
-    """tokens [B, S] int32 -> logits [B, S, vocab] (f32)."""
+    return_aux: bool = False,
+):
+    """tokens [B, S] int32 -> logits [B, S, vocab] (f32).
+
+    With return_aux=True also returns per-layer-averaged auxiliary metrics
+    (MoE router losses) for the training objective.
+    """
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
@@ -227,13 +288,17 @@ def forward(
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
 
     def scan_body(carry, layer_params):
-        return layer_fn(carry, layer_params, positions), None
+        carry, aux = layer_fn(carry, layer_params, positions)
+        return carry, aux
 
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x, aux_stacked = jax.lax.scan(scan_body, x, params["layers"])
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
     logits = jnp.einsum(
         "bsd,dv->bsv", x.astype(jnp.float32), head.astype(jnp.float32))
+    if return_aux:
+        aux = {k: v.mean() for k, v in aux_stacked.items()}
+        return logits, aux
     return logits
 
 
@@ -243,7 +308,7 @@ def loss_fn(
     cfg: TransformerConfig,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Causal LM loss.  batch: tokens [B,S], labels [B,S] (-100 = ignore)."""
-    logits = forward(params, batch["tokens"], cfg)
+    logits, aux = forward(params, batch["tokens"], cfg, return_aux=True)
     labels = batch["labels"]
     valid = labels != -100
     safe_labels = jnp.where(valid, labels, 0)
@@ -257,4 +322,8 @@ def loss_fn(
         "n_tokens": n_valid,
         "accuracy": ((logits.argmax(-1) == labels) & valid).sum() / n_valid,
     }
+    if aux:
+        metrics.update(aux)
+        loss = loss + aux.get("moe_aux_loss", 0.0) + aux.get("moe_z_loss", 0.0)
+        metrics["loss_with_aux"] = loss
     return loss, metrics

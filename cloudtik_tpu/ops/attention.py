@@ -92,16 +92,35 @@ def attention(
 ) -> jax.Array:
     """Multi-head / grouped-query attention.
 
-    implementation: None (auto), "flash" (Pallas), "reference" (XLA).
+    implementation: None (auto), "flash" (Pallas), "reference" (XLA),
+    "ring" (sequence-parallel ring attention).  Auto picks ring whenever the
+    ambient mesh shards the `seq` axis — so the same model code scales to
+    long context by mesh configuration alone.
     """
     impl = implementation
     if impl is None:
-        impl = "flash" if _use_flash(q, k) else "reference"
+        if _ambient_seq_size() > 1:
+            impl = "ring"
+        else:
+            impl = "flash" if _use_flash(q, k) else "reference"
+    if impl == "ring":
+        from cloudtik_tpu.ops.ring_attention import ring_attention_sharded
+
+        return ring_attention_sharded(q, k, v, causal=causal,
+                                      sm_scale=sm_scale)
     if impl == "flash":
         from cloudtik_tpu.ops.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
     return reference_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+
+
+def _ambient_seq_size() -> int:
+    """Size of the `seq` axis on the ambient mesh (1 when no mesh is set)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty or "seq" not in mesh.axis_names:
+        return 1
+    return mesh.shape["seq"]
 
 
 def _use_flash(q: jax.Array, k: jax.Array) -> bool:

@@ -1,0 +1,146 @@
+"""Mixture-of-experts layer with expert parallelism over the `expert` axis.
+
+GShard/Switch-style capacity-based routing, built from dense einsums so XLA
+lowers the whole layer onto the MXU and derives the expert all-to-all from
+shardings (GSPMD inserts it when the dispatched activations move from
+batch-sharded to expert-sharded layout) — no hand-written collective calls.
+
+The reference framework has NO expert parallelism (SURVEY.md §2.4:
+TP/PP/SP/EP/CP absent upstream); this module is part of the net-new
+parallelism vocabulary.  Everything is static-shaped (capacity is a
+trace-time constant) per XLA's compilation model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cloudtik_tpu.parallel.sharding import with_sharding_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    # Router auxiliary loss weights (Switch Transformer defaults).
+    aux_loss_weight: float = 0.01
+    z_loss_weight: float = 1e-3
+
+    def capacity(self, tokens_per_group: int) -> int:
+        """Expert buffer slots per routing group.
+
+        Routing is grouped (one group per batch row, GShard-style) so
+        capacity — and with it the dispatch-tensor size and dispatch-einsum
+        cost — stays constant as global batch grows, instead of the
+        O(tokens^2) blowup of a single global group.
+        """
+        cap = int(math.ceil(
+            self.top_k * tokens_per_group * self.capacity_factor
+            / self.num_experts))
+        return max(cap, 1)
+
+
+def router_probs(x: jax.Array, w_router: jax.Array) -> jax.Array:
+    """Token->expert probabilities in f32. x: [B,S,d]; w_router: [d,E]."""
+    logits = jnp.einsum(
+        "bsd,de->bse", x.astype(jnp.float32), w_router.astype(jnp.float32))
+    return jax.nn.softmax(logits, axis=-1), logits
+
+
+def _top_k_dispatch(
+    probs: jax.Array, cfg: MoEConfig, capacity: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Build grouped dispatch/combine tensors (group = batch row).
+
+    probs: [B,S,E] f32.  Returns (dispatch [B,S,E,C] bool-ish f32,
+    combine [B,S,E,C] f32, fraction_routed [E]).  Expert buffers are
+    per-group: slot positions are cumulative within each row.
+    """
+    B, S, E = probs.shape
+
+    dispatch = jnp.zeros((B, S, E, capacity), jnp.float32)
+    combine = jnp.zeros((B, S, E, capacity), jnp.float32)
+    remaining = probs
+    # Slots of each (group, expert) used across the top-k rounds so round
+    # r's tokens stack after round r-1's.
+    used = jnp.zeros((B, E), jnp.int32)
+    for _ in range(cfg.top_k):
+        expert = jnp.argmax(remaining, axis=-1)                  # [B,S]
+        gate = jnp.take_along_axis(
+            remaining, expert[..., None], axis=-1)[..., 0]       # [B,S]
+        onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)    # [B,S,E]
+        # Position of each token within its expert's per-group buffer.
+        pos_in_expert = (jnp.cumsum(onehot, axis=1) - 1.0)       # [B,S,E]
+        pos = (pos_in_expert * onehot).sum(-1).astype(jnp.int32) \
+            + jnp.take_along_axis(used, expert, axis=1)          # [B,S]
+        keep = pos < capacity
+        pos = jnp.clip(pos, 0, capacity - 1)
+        slot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [B,S,C]
+        contrib = (onehot * keep[..., None].astype(jnp.float32))[..., None] \
+            * slot[..., None, :]                                 # [B,S,E,C]
+        dispatch = dispatch + contrib
+        combine = combine + contrib * gate[..., None, None]
+        used = used + (onehot * keep[..., None]).sum(1).astype(jnp.int32)
+        remaining = remaining * (1.0 - onehot)
+
+    fraction_routed = dispatch.sum((0, 1, 3)) / max(B * S, 1)
+    return dispatch, combine, fraction_routed
+
+
+def moe_ffn(
+    x: jax.Array,
+    w_router: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    cfg: MoEConfig,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Expert-parallel SwiGLU feed-forward.
+
+    x: [B,S,d]; w_router: [d,E]; w_gate/w_up: [E,d,f]; w_down: [E,f,d].
+    Expert weights carry the "expert" logical axis, so on a mesh with an
+    `expert` axis each device holds E/n experts and GSPMD converts the
+    dispatch einsum into an all-to-all over ICI.
+    """
+    B, S, d = x.shape
+    E = cfg.num_experts
+    capacity = cfg.capacity(S)          # per-group (per batch row)
+    dtype = x.dtype
+
+    probs, logits = router_probs(x, w_router)
+    dispatch, combine, fraction = _top_k_dispatch(probs, cfg, capacity)
+
+    # Aux losses: load balance (Switch eq. 4) + router z-loss.
+    mean_prob = probs.mean((0, 1))                      # [E]
+    aux_loss = E * jnp.sum(fraction * mean_prob) * cfg.aux_loss_weight
+    z = jax.scipy.special.logsumexp(logits, axis=-1)
+    z_loss = jnp.mean(z ** 2) * cfg.z_loss_weight
+
+    # [E, B, C, d]: batch-sharded groups dispatched to expert-sharded
+    # buffers — the layout change GSPMD lowers to the expert all-to-all.
+    expert_in = jnp.einsum(
+        "bsec,bsd->ebcd", dispatch.astype(dtype), x)
+    expert_in = with_sharding_constraint(
+        expert_in, "expert", "batch", None, None)
+    gate = jnp.einsum("ebcd,edf->ebcf", expert_in, w_gate.astype(dtype))
+    up = jnp.einsum("ebcd,edf->ebcf", expert_in, w_up.astype(dtype))
+    act = jax.nn.silu(gate) * up
+    expert_out = jnp.einsum("ebcf,efd->ebcd", act, w_down.astype(dtype))
+    expert_out = with_sharding_constraint(
+        expert_out, "expert", "batch", None, None)
+    y = jnp.einsum("bsec,ebcd->bsd", combine.astype(dtype), expert_out)
+
+    metrics = {
+        "moe_aux_loss": aux_loss,
+        "moe_z_loss": z_loss,
+        # Fraction of dispatch slots dropped (tokens over capacity).
+        "moe_drop_fraction":
+            1.0 - dispatch.sum() / (B * S * cfg.top_k),
+    }
+    return y, metrics

@@ -1,0 +1,130 @@
+"""Ring attention: sequence/context parallelism over the `seq` mesh axis.
+
+Long-context training shards the sequence dimension across devices; exact
+attention then needs every query block to see every earlier key/value block.
+Ring attention keeps q resident and rotates the local k/v shards around the
+`seq` axis ring with `lax.ppermute` (one ICI hop per step), merging partial
+results with the flash-attention online-softmax recurrence — so the full
+[S, S] score matrix never materializes on any chip and k/v transfers overlap
+with the block matmuls that XLA schedules between permutes.
+
+The reference framework has NO sequence/context parallelism of any kind
+(SURVEY.md §2.4: TP/PP/SP/EP/CP absent; max context = one DDP replica's
+memory).  This module is the net-new capability the TPU build adds: context
+length scales linearly with the `seq` axis size.
+
+Layering: `ring_attention` is the per-shard SPMD body (callable inside
+`shard_map`); `ring_attention_sharded` wraps it for use inside a jitted
+GSPMD program, manual only over the `seq` axis (partial-manual shard_map)
+so batch/heads shardings stay compiler-managed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _pvary(x, axis_name):
+    """Mark an unvarying value as device-varying over `axis_name` (VMA)."""
+    return jax.lax.pcast(x, (axis_name,), to="varying")
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "seq",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact blockwise attention over a ring of sequence shards.
+
+    Must run inside `shard_map` (or any manual-mesh context) where
+    `axis_name` is a manual axis.  q: [B, H, S_loc, D]; k, v:
+    [B, Hkv, S_loc, D] — the *local* sequence shards.  Grouped-query
+    attention is supported by broadcasting kv heads.
+    """
+    n = jax.lax.psum(1, axis_name)
+    i = jax.lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    Hkv = k.shape[1]
+    if H % Hkv:
+        raise ValueError(f"n_heads {H} not divisible by n_kv_heads {Hkv}")
+    G = H // Hkv
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+
+    # Grouped-query layout: kv stays at Hkv heads through the ring (each
+    # ppermute moves 1/G of the broadcast-to-H volume); q is viewed as
+    # [B, Hkv, G, S, D] so all einsums batch over the kv head.
+    qf = (q.astype(jnp.float32) * sm_scale).reshape(B, Hkv, G, S, D)
+    q_pos = i * S + jnp.arange(S)
+
+    acc = _pvary(jnp.zeros((B, Hkv, G, S, D), jnp.float32), axis_name)
+    m = _pvary(jnp.full((B, Hkv, G, S), NEG_INF, jnp.float32), axis_name)
+    l = _pvary(jnp.zeros((B, Hkv, G, S), jnp.float32), axis_name)
+    # Receive the next kv block from the right neighbor each step; after n
+    # steps kv is back home (no trailing re-order needed).
+    perm = [((d + 1) % n, d) for d in range(n)]
+
+    def body(s, carry):
+        k_c, v_c, acc, m, l = carry
+        j = (i + s) % n
+        scores = jnp.einsum(
+            "bhgsd,bhtd->bhgst", qf, k_c.astype(jnp.float32))
+        if causal:
+            kv_pos = j * S + jnp.arange(S)
+            mask = q_pos[:, None] >= kv_pos[None, :]
+            scores = jnp.where(mask, scores, NEG_INF)
+        m_new = jnp.maximum(m, scores.max(-1))
+        p = jnp.exp(scores - m_new[..., None])
+        if causal:
+            # Rows whose visible set is empty in this block would otherwise
+            # get exp(NEG_INF - NEG_INF) = 1 before any real block arrives.
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhgst,bhtd->bhgsd", p, v_c.astype(jnp.float32))
+        k_c = jax.lax.ppermute(k_c, axis_name, perm)
+        v_c = jax.lax.ppermute(v_c, axis_name, perm)
+        return (k_c, v_c, acc, m_new, l)
+
+    _, _, acc, m, l = jax.lax.fori_loop(0, n, body, (k, v, acc, m, l))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(B, H, S, D).astype(q.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("axis_name", "causal", "sm_scale"))
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "seq",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Ring attention for [B, H, S, D] arrays inside a GSPMD program.
+
+    Requires an ambient mesh (`jax.set_mesh`/trainer context) with a `seq`
+    axis.  Only `seq` goes manual; all other axes remain under GSPMD.
+    """
+    spec = P(None, None, axis_name, None)
+    body = functools.partial(
+        ring_attention, axis_name=axis_name, causal=causal, sm_scale=sm_scale)
+    return jax.shard_map(
+        body,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={axis_name},
+    )(q, k, v)

@@ -103,6 +103,33 @@ def tree_to_shardings(
     )
 
 
+def tree_to_shardings_safe(
+    mesh: Mesh, logical_tree: Any, shape_tree: Any,
+    rules: AxisRules = DEFAULT_RULES,
+) -> Any:
+    """Like tree_to_shardings, but drops any mesh axis whose size does not
+    divide the corresponding array dimension (e.g. a 3-channel conv stem
+    under fsdp=2 stays replicated on that dim instead of erroring)."""
+    import math
+
+    def one(axes, shape):
+        spec = logical_to_spec(axes, rules, mesh)
+        entries = list(spec) + [None] * (len(shape.shape) - len(spec))
+        safe = []
+        for dim, entry in zip(shape.shape, entries):
+            if entry is None:
+                safe.append(None)
+                continue
+            names = (entry,) if isinstance(entry, str) else tuple(entry)
+            total = math.prod(mesh.shape[n] for n in names)
+            safe.append(entry if total and dim % total == 0 else None)
+        return NamedSharding(mesh, P(*safe))
+
+    is_axes = lambda x: isinstance(x, tuple) and all(
+        a is None or isinstance(a, str) for a in x)
+    return jax.tree.map(one, logical_tree, shape_tree, is_leaf=is_axes)
+
+
 def batch_sharding(mesh: Mesh, rules: AxisRules = DEFAULT_RULES) -> NamedSharding:
     """Sharding for a [batch, ...] host array (inputs/labels)."""
     return named_sharding(mesh, "batch", rules=rules)

@@ -1,0 +1,128 @@
+"""GCP config bootstrap: network, IAM, TPU-specific validation/defaults.
+
+Reference parity: providers/_private/gcp/config.py (VPC/IAM/key bootstrap;
+TPU role grafting :112-113,1659-1660; `_has_tpus_in_node_configs` gate
+:3315-3322 — where TPU-as-head is *forbidden*).  TPU-first divergence: TPU
+pod slices are ordinary worker node groups here; the head is a CPU VM that
+runs only the control plane, and slice workers get the TPU service scopes
+automatically.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict
+
+# Service-account roles, reference config.py HEAD_SERVICE_ACCOUNT_ROLES plus
+# the TPU roles the reference grafts for TPU clusters.
+HEAD_SERVICE_ACCOUNT_ROLES = [
+    "roles/storage.objectAdmin",
+    "roles/compute.admin",
+    "roles/iam.serviceAccountUser",
+    "roles/tpu.admin",
+]
+WORKER_SERVICE_ACCOUNT_ROLES = [
+    "roles/storage.objectAdmin",
+    "roles/logging.logWriter",
+    "roles/monitoring.metricWriter",
+]
+DEFAULT_SCOPES = ["https://www.googleapis.com/auth/cloud-platform"]
+
+DEFAULT_RUNTIME_VERSION = "tpu-ubuntu2204-base"
+
+
+def _provider(config: Dict[str, Any]) -> Dict[str, Any]:
+    return config.get("provider", {})
+
+
+def _is_tpu_type(node_config: Dict[str, Any]) -> bool:
+    return ("acceleratorType" in node_config
+            or "accelerator_type" in node_config)
+
+
+def prepare_gcp(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Fill provider-level defaults before validation."""
+    config = copy.deepcopy(config)
+    provider = config.setdefault("provider", {})
+    if provider.get("zone") and not provider.get("availability_zone"):
+        provider["availability_zone"] = provider["zone"]
+    if not provider.get("region") and provider.get("availability_zone"):
+        provider["region"] = provider["availability_zone"].rsplit("-", 1)[0]
+    return config
+
+
+def bootstrap_gcp(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Bootstrap the node configs for launch: head must be a CPU VM, TPU
+    node types get runtime version / network / scheduling defaults."""
+    config = prepare_gcp(config)
+    head_type = config.get("head_node_type")
+    node_types = config.get("available_node_types", {})
+    workspace = config.get("workspace_name", "default")
+
+    for type_name, node_type in node_types.items():
+        node_config = node_type.setdefault("node_config", {})
+        if _is_tpu_type(node_config):
+            if type_name == head_type:
+                raise ValueError(
+                    "TPU node type cannot be the head: the head runs the "
+                    "control plane on a CPU VM; TPU pod slices are worker "
+                    f"node groups (got head_node_type={type_name!r})")
+            node_config.setdefault("runtimeVersion", DEFAULT_RUNTIME_VERSION)
+            net = node_config.setdefault("networkConfig", {})
+            net.setdefault("network", _network_name(workspace))
+            net.setdefault("subnetwork", _subnet_name(workspace, private=True))
+            net.setdefault("enableExternalIps", False)
+            if node_type.get("preemptible") or node_config.pop(
+                    "preemptible", None):
+                node_config.setdefault("schedulingConfig", {})[
+                    "preemptible"] = True
+            # TPU resources for the demand scheduler: chips per host.
+            from cloudtik_tpu.providers.gcp.tpu import (
+                accelerator_chips, accelerator_hosts)
+            accel = (node_config.get("acceleratorType")
+                     or node_config.get("accelerator_type"))
+            hosts = accelerator_hosts(accel, node_config.get("num_workers"))
+            resources = node_type.setdefault("resources", {})
+            resources.setdefault(
+                "TPU", accelerator_chips(accel) // max(hosts, 1))
+            resources.setdefault("tpu_hosts", 1)
+        else:
+            _bootstrap_vm_node(node_config, workspace,
+                               is_head=(type_name == head_type))
+    return config
+
+
+def _bootstrap_vm_node(node_config: Dict[str, Any], workspace: str,
+                       is_head: bool) -> None:
+    node_config.setdefault("machineType", "n2-standard-8")
+    if "disks" not in node_config:
+        node_config["disks"] = [{
+            "boot": True,
+            "autoDelete": True,
+            "initializeParams": {
+                "sourceImage": ("projects/ubuntu-os-cloud/global/images/"
+                                "family/ubuntu-2204-lts"),
+                "diskSizeGb": "100",
+            },
+        }]
+    if "networkInterfaces" not in node_config:
+        nic: Dict[str, Any] = {
+            "subnetwork": _subnet_name(workspace, private=not is_head),
+        }
+        if is_head:
+            nic["accessConfigs"] = [{"type": "ONE_TO_ONE_NAT",
+                                     "name": "External NAT"}]
+        node_config["networkInterfaces"] = [nic]
+    node_config.setdefault("serviceAccounts", [{
+        "email": "default",
+        "scopes": DEFAULT_SCOPES,
+    }])
+
+
+def _network_name(workspace: str) -> str:
+    return f"tik-{workspace}-vpc"
+
+
+def _subnet_name(workspace: str, private: bool) -> str:
+    kind = "private" if private else "public"
+    return f"tik-{workspace}-{kind}-subnet"

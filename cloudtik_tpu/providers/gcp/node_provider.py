@@ -1,0 +1,394 @@
+"""GCP node provider: Compute VMs + TPU pod slices as atomic node groups.
+
+Reference parity: providers/_private/gcp/node_provider.py:60
+(GCPNodeProvider) and node.py:138 (GCPNodeType.{COMPUTE,TPU}).  TPU-first
+divergence: a TPU is not "a node" — it is a *pod slice* whose worker host
+VMs are the nodes the control plane sees (node ids `tpu/<name>/<idx>`),
+created and terminated atomically via the node-group contract.  This is the
+generalization SURVEY.md §7 calls for (the reference forbids TPU heads and
+has no multi-host slice story: config.py:3315-3322).
+
+Node id scheme:
+    gce/<instance-name>        — ordinary VM (head, CPU workers)
+    tpu/<tpu-name>/<worker>    — host VM #worker inside pod slice <tpu-name>
+Group id = tpu/<tpu-name>.
+
+Tags: full-fidelity tags live in instance/TPU metadata key `tik-tags`
+(JSON); a sanitized subset mirrors into cloud labels for server-side
+filtering.  TPU member nodes share the slice's metadata — per-worker tags
+(status) are cached provider-side and merged.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from typing import Any, Dict, List, Optional
+
+from cloudtik_tpu.core.node_provider import (
+    NodeLaunchException, NodeProvider)
+from cloudtik_tpu.core.tags import (
+    TAG_CLUSTER_NAME, TAG_NODE_GROUP_ID, TAG_NODE_GROUP_SIZE,
+    TAG_NODE_GROUP_WORKER_INDEX)
+from cloudtik_tpu.providers.gcp.compute import (
+    ComputeClient, instance_ips)
+from cloudtik_tpu.providers.gcp.rest import GCPApiError, RestClient
+from cloudtik_tpu.providers.gcp.tpu import (
+    PENDING_STATES, RUNNING_STATES, TpuClient, accelerator_hosts,
+    worker_endpoints)
+
+TAGS_METADATA_KEY = "tik-tags"
+
+
+def _sanitize_label(value: str) -> str:
+    """GCP labels: lowercase letters, digits, dash/underscore, <=63 chars."""
+    return re.sub(r"[^a-z0-9_-]", "-", str(value).lower())[:63]
+
+
+def _is_tpu_config(node_config: Dict[str, Any]) -> bool:
+    return "acceleratorType" in node_config or "accelerator_type" in node_config
+
+
+class GCPNodeProvider(NodeProvider):
+    """provider_config: project_id, availability_zone (or zone), region,
+    optional use_queued_resources, plus injectable rest_client for tests."""
+
+    def __init__(self, provider_config: Dict[str, Any], cluster_name: str):
+        super().__init__(provider_config, cluster_name)
+        self.project = provider_config["project_id"]
+        self.zone = (provider_config.get("availability_zone")
+                     or provider_config.get("zone"))
+        rest: Optional[RestClient] = provider_config.get("_rest_client")
+        self.tpu = TpuClient(self.project, self.zone, rest=rest)
+        self.compute = ComputeClient(self.project, self.zone, rest=rest)
+        self.use_queued_resources = provider_config.get(
+            "use_queued_resources", False)
+        self._lock = threading.RLock()
+        # node_id -> provider-side tag overlay (per-worker status on slices).
+        self._tag_overlay: Dict[str, Dict[str, str]] = {}
+        # Cache of cloud objects from the last non_terminated_nodes snapshot.
+        self._cached_instances: Dict[str, Dict[str, Any]] = {}
+        self._cached_tpus: Dict[str, Dict[str, Any]] = {}
+
+    # ---------------------------------------------------------------- tags --
+    def _meta_tags(self, obj: Dict[str, Any]) -> Dict[str, str]:
+        meta = obj.get("metadata") or {}
+        if isinstance(meta, dict) and "items" in meta:    # GCE shape
+            for item in meta.get("items", []):
+                if item.get("key") == TAGS_METADATA_KEY:
+                    return json.loads(item.get("value") or "{}")
+            return {}
+        # TPU shape: plain string map.
+        raw = meta.get(TAGS_METADATA_KEY) if isinstance(meta, dict) else None
+        return json.loads(raw) if raw else {}
+
+    def _belongs_to_cluster(self, obj: Dict[str, Any]) -> bool:
+        return self._meta_tags(obj).get(TAG_CLUSTER_NAME) == self.cluster_name
+
+    # ------------------------------------------------------------- queries --
+    def _snapshot(self) -> None:
+        instances = {}
+        for inst in self.compute.list_instances():
+            if inst.get("status") in ("STOPPING", "TERMINATED"):
+                continue
+            if self._belongs_to_cluster(inst):
+                instances[f"gce/{inst['name']}"] = inst
+        tpus = {}
+        for node in self.tpu.list_nodes():
+            state = node.get("state")
+            if state not in RUNNING_STATES | PENDING_STATES:
+                continue
+            if self._belongs_to_cluster(node):
+                name = node["name"].rsplit("/", 1)[-1]
+                tpus[name] = node
+        with self._lock:
+            self._cached_instances = instances
+            self._cached_tpus = tpus
+
+    def _tpu_member_ids(self, name: str, node: Dict[str, Any]) -> List[str]:
+        endpoints = worker_endpoints(node)
+        if not endpoints:
+            # Slice still creating: derive expected count from the type.
+            count = accelerator_hosts(
+                node.get("acceleratorType", ""),
+                self._meta_tags(node).get("_num_workers"))
+            return [f"tpu/{name}/{i}" for i in range(count)]
+        return [f"tpu/{name}/{i}" for i in range(len(endpoints))]
+
+    def non_terminated_nodes(self, tag_filters: Dict[str, str]) -> List[str]:
+        self._snapshot()
+        out = []
+        with self._lock:
+            for node_id in self._cached_instances:
+                if self._tags_match(node_id, tag_filters):
+                    out.append(node_id)
+            for name, node in self._cached_tpus.items():
+                for node_id in self._tpu_member_ids(name, node):
+                    if self._tags_match(node_id, tag_filters):
+                        out.append(node_id)
+        return sorted(out)
+
+    def _tags_match(self, node_id: str, tag_filters: Dict[str, str]) -> bool:
+        tags = self.node_tags(node_id)
+        return all(tags.get(k) == v for k, v in tag_filters.items())
+
+    def _find(self, node_id: str):
+        """Returns (kind, cloud_object, worker_idx).
+
+        Cache misses fetch OUTSIDE the provider lock — a slow cloud call
+        must not stall concurrent scaler/updater queries.
+        """
+        if node_id.startswith("gce/"):
+            with self._lock:
+                inst = self._cached_instances.get(node_id)
+            if inst is None:
+                inst = self.compute.get_instance(node_id[len("gce/"):])
+                if inst is not None:
+                    with self._lock:
+                        self._cached_instances[node_id] = inst
+            return "gce", inst, None
+        if node_id.startswith("tpu/"):
+            _, name, idx = node_id.split("/", 2)
+            with self._lock:
+                node = self._cached_tpus.get(name)
+            if node is None:
+                node = self.tpu.get_node(name)
+                if node is not None:
+                    with self._lock:
+                        self._cached_tpus[name] = node
+            return "tpu", node, int(idx)
+        raise ValueError(f"Bad node id {node_id!r}")
+
+    def is_running(self, node_id: str) -> bool:
+        kind, obj, _ = self._find(node_id)
+        if obj is None:
+            return False
+        if kind == "gce":
+            return obj.get("status") == "RUNNING"
+        return obj.get("state") in RUNNING_STATES
+
+    def is_terminated(self, node_id: str) -> bool:
+        kind, obj, _ = self._find(node_id)
+        if obj is None:
+            return True
+        if kind == "gce":
+            return obj.get("status") not in ("RUNNING", "PROVISIONING",
+                                             "STAGING")
+        return obj.get("state") not in RUNNING_STATES | PENDING_STATES
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        kind, obj, idx = self._find(node_id)
+        if obj is None:
+            return {}
+        tags = dict(self._meta_tags(obj))
+        tags.pop("_num_workers", None)
+        if kind == "tpu":
+            name = node_id.split("/")[1]
+            size = len(worker_endpoints(obj)) or int(
+                tags.get(TAG_NODE_GROUP_SIZE, 0) or 0)
+            tags[TAG_NODE_GROUP_ID] = f"tpu/{name}"
+            tags[TAG_NODE_GROUP_WORKER_INDEX] = str(idx)
+            if size:
+                tags[TAG_NODE_GROUP_SIZE] = str(size)
+        with self._lock:
+            tags.update(self._tag_overlay.get(node_id, {}))
+        return tags
+
+    def external_ip(self, node_id: str) -> Optional[str]:
+        kind, obj, idx = self._find(node_id)
+        if obj is None:
+            return None
+        if kind == "gce":
+            return instance_ips(obj)["external_ip"]
+        eps = worker_endpoints(obj)
+        return eps[idx]["external_ip"] if idx < len(eps) else None
+
+    def internal_ip(self, node_id: str) -> Optional[str]:
+        kind, obj, idx = self._find(node_id)
+        if obj is None:
+            return None
+        if kind == "gce":
+            return instance_ips(obj)["internal_ip"]
+        eps = worker_endpoints(obj)
+        return eps[idx]["internal_ip"] if idx < len(eps) else None
+
+    # ------------------------------------------------------------ mutation --
+    def create_node(self, node_config: Dict[str, Any], tags: Dict[str, str],
+                    count: int) -> Optional[Dict[str, Any]]:
+        if _is_tpu_config(node_config):
+            created = {}
+            for _ in range(count):
+                group_id = self.create_node_group(node_config, tags, 0)
+                created[group_id] = {"group": True}
+            return created
+        created = {}
+        for i in range(count):
+            name = self._vm_name(tags)
+            body = self._instance_body(name, node_config, tags)
+            try:
+                self.compute.insert_instance(body)
+            except GCPApiError as e:
+                raise NodeLaunchException(
+                    "quota" if e.status == 403 else f"http-{e.status}",
+                    str(e), src_exc_info=None)
+            created[f"gce/{name}"] = {"name": name}
+        return created
+
+    def _vm_name(self, tags: Dict[str, str]) -> str:
+        import uuid
+        kind = tags.get("tik-node-kind", "node")
+        return _sanitize_label(
+            f"{self.cluster_name}-{kind}-{uuid.uuid4().hex[:8]}")
+
+    def _instance_body(self, name: str, node_config: Dict[str, Any],
+                       tags: Dict[str, str]) -> Dict[str, Any]:
+        body = {k: v for k, v in node_config.items()
+                if k not in ("metadata", "labels")}
+        body["name"] = name
+        machine = body.get("machineType", "n2-standard-8")
+        if "/" not in machine:
+            body["machineType"] = (
+                f"zones/{self.zone}/machineTypes/{machine}")
+        labels = dict(node_config.get("labels") or {})
+        labels["tik-cluster"] = _sanitize_label(self.cluster_name)
+        body["labels"] = labels
+        items = list((node_config.get("metadata") or {}).get("items", []))
+        items.append({"key": TAGS_METADATA_KEY, "value": json.dumps(tags)})
+        body["metadata"] = {"items": items}
+        return body
+
+    def set_node_tags(self, node_id: str, tags: Dict[str, str]) -> None:
+        kind, obj, _ = self._find(node_id)
+        if obj is None:
+            raise ValueError(f"node {node_id} not found")
+        if kind == "tpu":
+            # Per-worker tags (updater status) stay provider-side; tags that
+            # apply to the whole slice are pushed to TPU metadata.
+            with self._lock:
+                overlay = self._tag_overlay.setdefault(node_id, {})
+                overlay.update(tags)
+            return
+        # Re-fetch for a fresh metadata fingerprint (setMetadata is
+        # compare-and-swap on it; a cached fingerprint 412s after any write).
+        name = node_id[len("gce/"):]
+        fresh = self.compute.get_instance(name)
+        if fresh is None:
+            raise ValueError(f"node {node_id} disappeared")
+        merged = {**self._meta_tags(fresh), **tags}
+        meta = fresh.get("metadata") or {}
+        items = [i for i in meta.get("items", [])
+                 if i.get("key") != TAGS_METADATA_KEY]
+        items.append({"key": TAGS_METADATA_KEY, "value": json.dumps(merged)})
+        self.compute.set_metadata(
+            name, {"items": items, "fingerprint": meta.get("fingerprint")})
+        with self._lock:
+            # Invalidate: next read re-fetches the post-write fingerprint.
+            self._cached_instances.pop(node_id, None)
+
+    def terminate_node(self, node_id: str) -> Optional[Dict[str, Any]]:
+        if node_id.startswith("tpu/"):
+            # Terminating any slice member terminates the slice (atomic).
+            group_id = "/".join(node_id.split("/")[:2])
+            self.terminate_node_group(group_id)
+            return {node_id: {"group": group_id}}
+        name = node_id[len("gce/"):]
+        self.compute.delete_instance(name)
+        with self._lock:
+            self._cached_instances.pop(node_id, None)
+        return {node_id: {}}
+
+    # --------------------------------------------------------- node groups --
+    def supports_node_groups(self) -> bool:
+        return True
+
+    def create_node_group(self, node_config: Dict[str, Any],
+                          tags: Dict[str, str], group_size: int,
+                          ) -> Optional[str]:
+        import uuid
+        accel = (node_config.get("acceleratorType")
+                 or node_config.get("accelerator_type"))
+        name = _sanitize_label(
+            f"{self.cluster_name}-tpu-{uuid.uuid4().hex[:8]}")
+        num_workers = (node_config.get("num_workers")
+                       or (group_size if group_size > 0 else None)
+                       or accelerator_hosts(accel))
+        full_tags = dict(tags)
+        full_tags[TAG_NODE_GROUP_SIZE] = str(num_workers)
+        meta = dict(node_config.get("metadata") or {})
+        meta[TAGS_METADATA_KEY] = json.dumps(
+            {**full_tags, "_num_workers": num_workers})
+        body = {
+            "acceleratorType": accel,
+            "runtimeVersion": node_config.get(
+                "runtimeVersion", "tpu-ubuntu2204-base"),
+            "metadata": meta,
+            "labels": {"tik-cluster": _sanitize_label(self.cluster_name)},
+        }
+        for key in ("networkConfig", "schedulingConfig", "serviceAccount",
+                    "dataDisks", "tags", "shieldedInstanceConfig"):
+            if key in node_config:
+                body[key] = node_config[key]
+        try:
+            if self.use_queued_resources:
+                self.tpu.create_queued_resource(name, {
+                    "tpu": {"nodeSpec": [{
+                        "parent": self.tpu._parent,
+                        "nodeId": name,
+                        "node": body,
+                    }]},
+                })
+            else:
+                self.tpu.create_node(name, body)
+        except GCPApiError as e:
+            category = "stockout" if e.status == 429 else (
+                "quota" if e.status == 403 else f"http-{e.status}")
+            raise NodeLaunchException(category, str(e))
+        return f"tpu/{name}"
+
+    def terminate_node_group(self, group_id: str) -> None:
+        name = group_id.split("/", 1)[1]
+        if self.use_queued_resources:
+            try:
+                self.tpu.delete_queued_resource(name)
+            except GCPApiError as e:
+                if not e.not_found:
+                    raise
+        try:
+            self.tpu.delete_node(name)
+        except GCPApiError as e:
+            if not e.not_found:
+                raise
+        with self._lock:
+            self._cached_tpus.pop(name, None)
+            for node_id in list(self._tag_overlay):
+                if node_id.startswith(group_id + "/"):
+                    del self._tag_overlay[node_id]
+
+    def list_node_groups(self, tag_filters: Dict[str, str]
+                         ) -> Dict[str, List[str]]:
+        self._snapshot()
+        out: Dict[str, List[str]] = {}
+        with self._lock:
+            for name, node in self._cached_tpus.items():
+                members = self._tpu_member_ids(name, node)
+                matching = [m for m in members
+                            if self._tags_match(m, tag_filters)]
+                if matching:
+                    out[f"tpu/{name}"] = members
+        return out
+
+    # ------------------------------------------------------ config pipeline --
+    @staticmethod
+    def bootstrap_config(cluster_config: Dict[str, Any]) -> Dict[str, Any]:
+        from cloudtik_tpu.providers.gcp.config import bootstrap_gcp
+        return bootstrap_gcp(cluster_config)
+
+    @staticmethod
+    def validate_config(provider_config: Dict[str, Any]) -> None:
+        for key in ("project_id",):
+            if not provider_config.get(key):
+                raise ValueError(f"gcp provider requires {key!r}")
+        if not (provider_config.get("availability_zone")
+                or provider_config.get("zone")):
+            raise ValueError("gcp provider requires availability_zone")

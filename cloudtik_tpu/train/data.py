@@ -32,6 +32,23 @@ def synthetic_lm_batches(
         yield {"tokens": tokens, "labels": labels.astype(np.int32)}
 
 
+def synthetic_image_batches(
+    batch_size: int,
+    image_size: int,
+    num_classes: int,
+    seed: int = 0,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Synthetic image-classification batches (NHWC float32)."""
+    rng = np.random.default_rng(seed)
+    while True:
+        yield {
+            "images": rng.standard_normal(
+                (batch_size, image_size, image_size, 3)).astype(np.float32),
+            "labels": rng.integers(
+                0, num_classes, (batch_size,), dtype=np.int32),
+        }
+
+
 def global_batches(
     local_iter: Iterator[Dict[str, np.ndarray]],
     sharding: NamedSharding,

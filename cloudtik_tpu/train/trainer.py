@@ -22,7 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cloudtik_tpu.parallel.mesh import MeshConfig, build_mesh
 from cloudtik_tpu.parallel.sharding import (
-    AxisRules, DEFAULT_RULES, batch_sharding, tree_to_shardings)
+    AxisRules, DEFAULT_RULES, batch_sharding, tree_to_shardings_safe)
 from cloudtik_tpu.train.checkpoint import CheckpointConfig, Checkpointer
 from cloudtik_tpu.train.optim import OptimizerConfig, make_optimizer
 
@@ -72,6 +72,18 @@ def transformer_spec(cfg) -> ModelSpec:
     )
 
 
+def resnet_spec(cfg) -> ModelSpec:
+    """Image models: "token" accounting is per image (seq_len=1)."""
+    from cloudtik_tpu.models import resnet as R
+
+    return ModelSpec(
+        init=lambda rng: R.init_params(rng, cfg),
+        loss_fn=lambda params, batch: R.loss_fn(params, batch, cfg),
+        logical_axes=R.param_logical_axes(cfg),
+        flops_per_token=cfg.flops_per_image(),
+    )
+
+
 @dataclasses.dataclass
 class TrainerConfig:
     global_batch_size: int = 8
@@ -94,8 +106,9 @@ class Trainer:
         self.config = config
         self.mesh = mesh if mesh is not None else build_mesh(config.mesh)
         self.optimizer = make_optimizer(config.optimizer)
-        self.param_shardings = tree_to_shardings(
-            self.mesh, spec.logical_axes, config.rules)
+        params_shape = jax.eval_shape(spec.init, jax.random.PRNGKey(0))
+        self.param_shardings = tree_to_shardings_safe(
+            self.mesh, spec.logical_axes, params_shape, config.rules)
         self.data_sharding = batch_sharding(self.mesh, config.rules)
         self.step_fn = self._build_step()
         self.state = None

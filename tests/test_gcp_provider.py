@@ -1,0 +1,256 @@
+"""GCP provider against a fake REST cloud (no network, no SDK).
+
+Mirrors the reference's MockProvider strategy (SURVEY.md §4) one layer
+lower: the fake implements the REST surface, so the real provider logic —
+node-id scheme, slice atomicity, tag plumbing, bootstrap — is what's tested.
+"""
+
+import json
+import re
+
+import pytest
+
+from cloudtik_tpu.core.node_provider import NodeLaunchException
+from cloudtik_tpu.core.tags import (
+    TAG_CLUSTER_NAME, TAG_NODE_GROUP_ID, TAG_NODE_GROUP_SIZE,
+    TAG_NODE_GROUP_WORKER_INDEX, TAG_NODE_KIND)
+from cloudtik_tpu.providers.gcp.config import bootstrap_gcp
+from cloudtik_tpu.providers.gcp.node_provider import GCPNodeProvider
+from cloudtik_tpu.providers.gcp.rest import RestClient, RestResponse
+from cloudtik_tpu.providers.gcp.tpu import accelerator_hosts
+from cloudtik_tpu.providers.gcp.workspace_provider import GCPWorkspaceProvider
+from cloudtik_tpu.core.workspace_provider import Existence
+
+
+class FakeGCP:
+    """In-memory GCE + TPU REST backend."""
+
+    def __init__(self):
+        self.instances = {}       # name -> body
+        self.tpus = {}            # name -> body
+        self.networks = {}
+        self.subnets = {}
+        self.routers = {}
+        self.firewalls = {}
+        self.calls = []
+        self.fail_next = None     # (status, message)
+
+    def transport(self, method, url, body, headers):
+        self.calls.append((method, url))
+        if self.fail_next:
+            status, msg = self.fail_next
+            self.fail_next = None
+            return RestResponse(status, {"error": {"message": msg}})
+        try:
+            return self._route(method, url, body)
+        except KeyError:
+            return RestResponse(404, {"error": {"message": "not found"}})
+
+    def _route(self, method, url, body):
+        path = url.split("?")[0]
+        # --- TPU API ---
+        m = re.search(r"tpu\.googleapis\.com/v2/.*/nodes(?:/([^/?]+))?$", path)
+        if m:
+            name = m.group(1)
+            if method == "GET" and name:
+                return RestResponse(200, self.tpus[name])
+            if method == "GET":
+                return RestResponse(200, {"nodes": list(self.tpus.values())})
+            if method == "POST":
+                node_id = re.search(r"nodeId=([^&]+)", url).group(1)
+                node = dict(body)
+                node["name"] = f"projects/p/locations/z/nodes/{node_id}"
+                node["state"] = "READY"
+                n = accelerator_hosts(body["acceleratorType"])
+                node["networkEndpoints"] = [
+                    {"ipAddress": f"10.0.0.{i+10}",
+                     "accessConfig": {"externalIp": f"34.1.1.{i+10}"}}
+                    for i in range(n)]
+                self.tpus[node_id] = node
+                return RestResponse(200, node)
+            if method == "DELETE" and name:
+                del self.tpus[name]
+                return RestResponse(200, {})
+        if "queuedResources" in path:
+            return RestResponse(200, {})
+        # --- Compute API ---
+        m = re.search(r"compute/v1/projects/[^/]+/zones/[^/]+/instances"
+                      r"(?:/([^/?]+))?(?:/(setLabels|setMetadata))?$", path)
+        if m:
+            name, verb = m.group(1), m.group(2)
+            if verb == "setMetadata":
+                self.instances[name]["metadata"] = {
+                    "items": body["items"], "fingerprint": "fp2"}
+                return RestResponse(200, {})
+            if method == "GET" and name:
+                return RestResponse(200, self.instances[name])
+            if method == "GET":
+                return RestResponse(
+                    200, {"items": list(self.instances.values())})
+            if method == "POST" and not name:
+                inst = dict(body)
+                inst["status"] = "RUNNING"
+                inst.setdefault("metadata", {})["fingerprint"] = "fp1"
+                inst["networkInterfaces"] = [{
+                    "networkIP": f"10.0.1.{len(self.instances)+5}",
+                    "accessConfigs": [{"natIP": "34.2.2.2"}]}]
+                self.instances[inst["name"]] = inst
+                return RestResponse(200, inst)
+            if method == "DELETE" and name:
+                del self.instances[name]
+                return RestResponse(200, {})
+        # --- Workspace objects ---
+        for store, pattern in (
+                (self.networks, r"/global/networks(?:/([^/?]+))?$"),
+                (self.subnets, r"/subnetworks(?:/([^/?]+))?$"),
+                (self.routers, r"/routers(?:/([^/?]+))?$"),
+                (self.firewalls, r"/global/firewalls(?:/([^/?]+))?$")):
+            m = re.search(pattern, path)
+            if m:
+                name = m.group(1)
+                if method == "GET" and name:
+                    return RestResponse(200, store[name])
+                if method == "POST":
+                    store[body["name"]] = body
+                    return RestResponse(200, body)
+                if method == "DELETE" and name:
+                    del store[name]
+                    return RestResponse(200, {})
+        raise AssertionError(f"unrouted: {method} {url}")
+
+
+@pytest.fixture()
+def fake():
+    return FakeGCP()
+
+
+@pytest.fixture()
+def provider(fake):
+    rest = RestClient(transport=fake.transport,
+                      token_provider=lambda: "test-token")
+    return GCPNodeProvider(
+        {"type": "gcp", "project_id": "proj",
+         "availability_zone": "us-central2-b", "_rest_client": rest},
+        "clusterA")
+
+
+def test_accelerator_hosts():
+    # v2-v4/v5p suffix = TensorCores (8/host); v5e/v6e suffix = chips (8/host)
+    assert accelerator_hosts("v5p-32") == 4
+    assert accelerator_hosts("v4-8") == 1
+    assert accelerator_hosts("v3-32") == 4
+    assert accelerator_hosts("v5litepod-16") == 2
+    assert accelerator_hosts("v5e-4") == 1
+    assert accelerator_hosts("v5p-32", num_workers=16) == 16
+
+
+def test_create_vm_node(provider, fake):
+    provider.create_node({"machineType": "n2-standard-4"},
+                         {TAG_CLUSTER_NAME: "clusterA",
+                          TAG_NODE_KIND: "head"}, 1)
+    nodes = provider.non_terminated_nodes({})
+    assert len(nodes) == 1
+    assert nodes[0].startswith("gce/")
+    assert provider.is_running(nodes[0])
+    assert provider.internal_ip(nodes[0]).startswith("10.")
+    tags = provider.node_tags(nodes[0])
+    assert tags[TAG_NODE_KIND] == "head"
+
+
+def test_tpu_slice_is_atomic_group(provider, fake):
+    provider.create_node({"acceleratorType": "v5p-32"},
+                         {TAG_CLUSTER_NAME: "clusterA",
+                          TAG_NODE_KIND: "worker"}, 1)
+    nodes = provider.non_terminated_nodes({})
+    assert len(nodes) == 4  # v5p-32 = 16 chips = 4 host VMs
+    groups = provider.list_node_groups({})
+    assert len(groups) == 1
+    group_id, members = next(iter(groups.items()))
+    assert members == sorted(nodes)
+    tags = provider.node_tags(members[3])
+    assert tags[TAG_NODE_GROUP_ID] == group_id
+    assert tags[TAG_NODE_GROUP_WORKER_INDEX] == "3"
+    assert tags[TAG_NODE_GROUP_SIZE] == "4"
+    # Each member has its own IP from the slice endpoints.
+    ips = {provider.internal_ip(m) for m in members}
+    assert len(ips) == 4
+    # Terminating ANY member terminates the whole slice.
+    provider.terminate_node(members[2])
+    assert provider.non_terminated_nodes({}) == []
+
+
+def test_per_worker_tags_are_overlayed(provider):
+    provider.create_node({"acceleratorType": "v4-16"},
+                         {TAG_CLUSTER_NAME: "clusterA"}, 1)
+    nodes = provider.non_terminated_nodes({})
+    provider.set_node_tags(nodes[0], {"tik-node-status": "up-to-date"})
+    assert provider.node_tags(nodes[0])["tik-node-status"] == "up-to-date"
+    assert "tik-node-status" not in provider.node_tags(nodes[1])
+
+
+def test_launch_failure_categorized(provider, fake):
+    fake.fail_next = (403, "quota exceeded")
+    with pytest.raises(NodeLaunchException) as e:
+        provider.create_node({"acceleratorType": "v5p-32"},
+                             {TAG_CLUSTER_NAME: "clusterA"}, 1)
+    assert e.value.category == "quota"
+
+
+def test_vm_tag_update_roundtrip(provider):
+    provider.create_node({"machineType": "n2-standard-4"},
+                         {TAG_CLUSTER_NAME: "clusterA"}, 1)
+    node = provider.non_terminated_nodes({})[0]
+    provider.set_node_tags(node, {"tik-node-status": "up-to-date"})
+    assert provider.node_tags(node)["tik-node-status"] == "up-to-date"
+
+
+def test_bootstrap_rejects_tpu_head():
+    config = {
+        "head_node_type": "tpu_worker",
+        "workspace_name": "ws",
+        "available_node_types": {
+            "tpu_worker": {"node_config": {"acceleratorType": "v5p-32"}},
+        },
+        "provider": {"type": "gcp", "project_id": "p",
+                     "availability_zone": "us-central2-b"},
+    }
+    with pytest.raises(ValueError, match="cannot be the head"):
+        bootstrap_gcp(config)
+
+
+def test_bootstrap_fills_tpu_defaults():
+    config = {
+        "head_node_type": "head",
+        "workspace_name": "ws",
+        "available_node_types": {
+            "head": {"node_config": {}},
+            "tpu": {"node_config": {"acceleratorType": "v5p-32"}},
+        },
+        "provider": {"type": "gcp", "project_id": "p",
+                     "availability_zone": "us-central2-b"},
+    }
+    out = bootstrap_gcp(config)
+    tpu_conf = out["available_node_types"]["tpu"]["node_config"]
+    assert tpu_conf["runtimeVersion"]
+    assert tpu_conf["networkConfig"]["network"] == "tik-ws-vpc"
+    assert out["available_node_types"]["tpu"]["resources"]["TPU"] == 4
+    head_conf = out["available_node_types"]["head"]["node_config"]
+    assert head_conf["networkInterfaces"][0]["accessConfigs"]
+    assert out["provider"]["region"] == "us-central2"
+
+
+def test_workspace_create_delete_cycle(fake):
+    rest = RestClient(transport=fake.transport,
+                      token_provider=lambda: "t")
+    ws = GCPWorkspaceProvider(
+        {"project_id": "proj", "region": "us-central2",
+         "_rest_client": rest}, "ws1")
+    assert ws.check_workspace_existence({}) == Existence.NOT_EXIST
+    ws.create_workspace({})
+    assert ws.check_workspace_existence({}) == Existence.COMPLETED
+    assert "tik-ws1-vpc" in fake.networks
+    assert len(fake.subnets) == 2
+    assert len(fake.firewalls) == 2
+    assert fake.routers["tik-ws1-router"]["nats"]
+    ws.delete_workspace({})
+    assert ws.check_workspace_existence({}) == Existence.NOT_EXIST
