@@ -53,6 +53,15 @@ class StateBackend:
     def keys(self, ns: str, prefix: str = "") -> List[str]:
         raise NotImplementedError
 
+    def cas(self, ns: str, key: str, expected: Optional[bytes],
+            value: bytes) -> bool:
+        """Atomic compare-and-swap: write `value` iff the current value is
+        `expected` (None = key absent).  Foundation for distributed locks
+        and leader election (reference: runtime/common/lock/,
+        leader_election/ — consul/etcd sessions; here the head state store
+        provides the atomicity)."""
+        raise NotImplementedError
+
     def close(self) -> None:
         pass
 
@@ -78,6 +87,13 @@ class InMemoryStateBackend(StateBackend):
         with self._lock:
             return sorted(k for k in self._data.get(ns, {}) if
                           k.startswith(prefix))
+
+    def cas(self, ns, key, expected, value):
+        with self._lock:
+            if self._data.get(ns, {}).get(key) != expected:
+                return False
+            self._data.setdefault(ns, {})[key] = value
+            return True
 
 
 class FileStateBackend(StateBackend):
@@ -147,6 +163,17 @@ class FileStateBackend(StateBackend):
         with self._flock():
             return sorted(k for k in self._load(ns) if k.startswith(prefix))
 
+    def cas(self, ns, key, expected, value):
+        with self._flock():
+            data = self._load(ns)
+            current = data.get(key)
+            expected_hex = expected.hex() if expected is not None else None
+            if current != expected_hex:
+                return False
+            data[key] = value.hex()
+            self._store(ns, data)
+            return True
+
 
 # --------------------------------------------------------------------------
 # TCP server + client backend
@@ -202,6 +229,11 @@ class _StateRequestHandler(socketserver.BaseRequestHandler):
                         resp = {"ok": True,
                                 "keys": backend.keys(req["ns"],
                                                      req.get("prefix", ""))}
+                    elif op == "cas":
+                        resp = {"ok": True,
+                                "swapped": backend.cas(
+                                    req["ns"], req["key"],
+                                    req.get("expected"), req["value"])}
                     elif op == "ping":
                         resp = {"ok": True, "time": time.time()}
                     else:
@@ -292,6 +324,10 @@ class TcpStateBackend(StateBackend):
     def keys(self, ns, prefix=""):
         return self._call({"op": "keys", "ns": ns, "prefix": prefix})["keys"]
 
+    def cas(self, ns, key, expected, value):
+        return self._call({"op": "cas", "ns": ns, "key": key,
+                           "expected": expected, "value": value})["swapped"]
+
     def ping(self) -> bool:
         try:
             return self._call({"op": "ping"})["ok"]
@@ -336,6 +372,10 @@ class StateClient:
 
     def kv_keys(self, prefix: str = "", ns: str = TABLE_USER) -> List[str]:
         return self.backend.keys(ns, prefix)
+
+    def kv_cas(self, key: str, expected: Optional[bytes], value: bytes,
+               ns: str = TABLE_USER) -> bool:
+        return self.backend.cas(ns, key, expected, value)
 
     # object tables
     def table_put(self, table: str, key: str, obj: Dict[str, Any]) -> None:

@@ -32,6 +32,13 @@ class MoEConfig:
     aux_loss_weight: float = 0.01
     z_loss_weight: float = 1e-3
 
+    def __post_init__(self):
+        if self.top_k > self.num_experts:
+            raise ValueError(
+                f"top_k ({self.top_k}) must be <= num_experts "
+                f"({self.num_experts}); extra routing rounds would dispatch "
+                f"phantom weight-0 tokens that consume capacity slots")
+
     def capacity(self, tokens_per_group: int) -> int:
         """Expert buffer slots per routing group.
 
@@ -46,8 +53,10 @@ class MoEConfig:
         return max(cap, 1)
 
 
-def router_probs(x: jax.Array, w_router: jax.Array) -> jax.Array:
-    """Token->expert probabilities in f32. x: [B,S,d]; w_router: [d,E]."""
+def router_probs(
+    x: jax.Array, w_router: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """(probs, logits), both f32. x: [B,S,d]; w_router: [d,E]."""
     logits = jnp.einsum(
         "bsd,de->bse", x.astype(jnp.float32), w_router.astype(jnp.float32))
     return jax.nn.softmax(logits, axis=-1), logits

@@ -84,6 +84,10 @@ def _urllib_transport(method: str, url: str, body: Optional[Dict[str, Any]],
         except (ValueError, TypeError):
             parsed = {"error": {"message": raw.decode(errors="replace")}}
         return RestResponse(e.code, parsed)
+    except (urllib.error.URLError, OSError) as e:
+        # Transport failure (DNS, refused, timeout): surface as a retriable
+        # 503 so RestClient's retry loop handles it.
+        return RestResponse(503, {"error": {"message": f"transport: {e}"}})
 
 
 class RestClient:

@@ -1,0 +1,7 @@
+"""Shared runtime library: locks, leader election, discovery, health.
+
+Reference parity: runtime/common/ (SURVEY.md §2.3 — service discovery client
+lib, distributed locks lock/{consul,etcd,redis}_lock.py, leader election
+leader_election/consul_leader_election.py, health_check.py,
+active_standby_service.py, runtime_base.py:12).
+"""

@@ -1,0 +1,115 @@
+"""Service runtime base: declarative common shape for service plugins.
+
+Reference parity: runtime/common/runtime_base.py:12 (RuntimeBase defaults)
++ the per-runtime boilerplate every reference runtime repeats (runtime.py /
+utils.py / defaults.yaml per SURVEY.md §2.3).  A subclass declares its
+service name, port, placement, process keyword and health check; the base
+implements the Runtime hooks from those declarations.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from cloudtik_tpu.core.runtime import (
+    NodeConstraint, Runtime, RuntimeHealthCheck)
+
+HEAD = "head"
+WORKER = "worker"
+ALL_NODES = "node"
+
+
+class ServiceRuntimeBase(Runtime):
+    """Declarative base for service runtimes.
+
+    Class attributes subclasses override:
+      SERVICE_NAME    registered discovery name (required)
+      DEFAULT_PORT    service port (required)
+      PROTOCOL        "tcp"/"http"
+      NODE_KIND       HEAD / WORKER / ALL_NODES — where the service runs
+      PROCESS_KEYWORD cmdline keyword for the node agent's process scan
+      MINIMAL_NODES   >0 -> NodeConstraint(minimal=..) (stateful clusters)
+      QUORUM          members form a persistent quorum (etcd/zk semantics)
+      ENDPOINT_NAME   human-facing endpoint label (None -> no endpoint)
+      DEPENDENCIES    runtime names that must configure first
+    """
+
+    SERVICE_NAME: str = ""
+    DEFAULT_PORT: int = 0
+    PROTOCOL: str = "tcp"
+    NODE_KIND: str = HEAD
+    PROCESS_KEYWORD: str = ""
+    MINIMAL_NODES: int = 0
+    QUORUM: bool = False
+    ENDPOINT_NAME: Optional[str] = None
+    DEPENDENCIES: List[str] = []
+
+    @property
+    def port(self) -> int:
+        return int(self.runtime_config.get("port", self.DEFAULT_PORT))
+
+    # -- services / endpoints --------------------------------------------
+    def get_runtime_services(self, cluster_config, cluster_head_ip):
+        return {self.SERVICE_NAME: {
+            "protocol": self.PROTOCOL,
+            "port": self.port,
+            "node_kind": self.NODE_KIND,
+            "tags": dict(self.runtime_config.get("tags", {})),
+        }}
+
+    def get_runtime_endpoints(self, cluster_config, cluster_head_ip):
+        if self.ENDPOINT_NAME is None:
+            return None
+        scheme = "http" if self.PROTOCOL == "http" else "tcp"
+        return {self.SERVICE_NAME: {
+            "name": self.ENDPOINT_NAME,
+            "url": f"{scheme}://{cluster_head_ip}:{self.port}",
+        }}
+
+    def get_head_service_ports(self):
+        if self.NODE_KIND != HEAD:
+            return None
+        return {self.SERVICE_NAME: {"protocol": "TCP", "port": self.port}}
+
+    # -- placement / constraints -----------------------------------------
+    def get_node_constraints(self, cluster_config, node_type):
+        minimal = int(self.runtime_config.get(
+            "minimal_nodes", self.MINIMAL_NODES))
+        if minimal <= 0:
+            return None
+        return NodeConstraint(minimal=minimal, quorum=self.QUORUM,
+                              scalable=not self.QUORUM)
+
+    # -- observability ----------------------------------------------------
+    def get_logs(self) -> Dict[str, str]:
+        return {self.SERVICE_NAME:
+                f"~/.tik/logs/{self.SERVICE_NAME}"}
+
+    def get_processes(self) -> Optional[List[Tuple[str, bool, str, str]]]:
+        keyword = self.PROCESS_KEYWORD or self.SERVICE_NAME
+        return [(keyword, False, self.SERVICE_NAME, self.NODE_KIND)]
+
+    def get_health_check(self, cluster_config):
+        return RuntimeHealthCheck(
+            name=self.SERVICE_NAME,
+            script=f"tcp:{self.port}",
+            port=self.port)
+
+    @classmethod
+    def get_dependencies(cls) -> List[str]:
+        return list(cls.DEPENDENCIES)
+
+    # -- node lifecycle helpers -------------------------------------------
+    def conf_dir(self, node_context: Dict[str, Any]) -> str:
+        base = node_context.get("conf_dir",
+                                f"~/.tik/{self.SERVICE_NAME}")
+        path = os.path.expanduser(base)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def runs_on(self, node_context: Dict[str, Any]) -> bool:
+        if self.NODE_KIND == ALL_NODES:
+            return True
+        is_head = bool(node_context.get("is_head"))
+        return is_head if self.NODE_KIND == HEAD else not is_head

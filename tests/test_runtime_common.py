@@ -1,0 +1,247 @@
+"""Tests for the runtime common library: locks, leader election,
+discovery client, health checks, active/standby."""
+
+import threading
+import time
+
+import pytest
+
+from cloudtik_tpu.control.state import (
+    InMemoryStateBackend, StateClient, StateServer, TcpStateBackend)
+from cloudtik_tpu.runtimes.common.active_standby import ActiveStandbyService
+from cloudtik_tpu.runtimes.common.discovery_client import (
+    DiscoveryType, discover_endpoint_for_config, discover_service,
+    discover_service_one, wait_for_service)
+from cloudtik_tpu.runtimes.common.health_check import (
+    HealthCheckServer, tcp_port_check)
+from cloudtik_tpu.runtimes.common.leader_election import LeaderElection
+from cloudtik_tpu.runtimes.common.lock import (
+    LockAcquireError, StateLock)
+from cloudtik_tpu.runtimes.discovery.runtime import ServiceRegistry
+
+
+@pytest.fixture
+def state():
+    return StateClient(InMemoryStateBackend())
+
+
+class TestCAS:
+    def test_cas_absent(self, state):
+        assert state.kv_cas("k", None, b"v1")
+        assert state.kv_get("k") == b"v1"
+
+    def test_cas_mismatch(self, state):
+        state.kv_put("k", b"v1")
+        assert not state.kv_cas("k", b"other", b"v2")
+        assert state.kv_get("k") == b"v1"
+
+    def test_cas_match(self, state):
+        state.kv_put("k", b"v1")
+        assert state.kv_cas("k", b"v1", b"v2")
+        assert state.kv_get("k") == b"v2"
+
+    def test_cas_over_tcp(self):
+        server = StateServer(host="127.0.0.1", port=0)
+        server.start()
+        try:
+            client = TcpStateBackend("127.0.0.1", server.port)
+            assert client.cas("ns", "k", None, b"a")
+            assert not client.cas("ns", "k", b"wrong", b"b")
+            assert client.cas("ns", "k", b"a", b"b")
+            assert client.get("ns", "k") == b"b"
+        finally:
+            server.stop()
+
+
+class TestStateLock:
+    def test_mutual_exclusion(self, state):
+        l1 = StateLock(state, "m", ttl_s=5, owner_id="a")
+        l2 = StateLock(state, "m", ttl_s=5, owner_id="b")
+        assert l1.try_acquire()
+        assert not l2.try_acquire()
+        l1.release()
+        assert l2.try_acquire()
+
+    def test_acquire_timeout(self, state):
+        l1 = StateLock(state, "m", ttl_s=5, owner_id="a")
+        l1.acquire()
+        l2 = StateLock(state, "m", ttl_s=5, owner_id="b")
+        with pytest.raises(LockAcquireError):
+            l2.acquire(timeout_s=0.3, poll_s=0.05)
+
+    def test_expired_lease_taken_over(self, state):
+        l1 = StateLock(state, "m", ttl_s=0.1, owner_id="a")
+        assert l1.try_acquire()
+        l1._stop_renewer()  # simulate holder death: no renewal
+        time.sleep(0.25)
+        l2 = StateLock(state, "m", ttl_s=5, owner_id="b")
+        assert l2.try_acquire()
+        # dead holder's release must not clobber the new owner
+        l1.release()
+        assert l2.held()
+
+    def test_context_manager(self, state):
+        with StateLock(state, "m", ttl_s=5) as lock:
+            assert lock.held()
+        assert not lock.held()
+
+    def test_contended_counter(self, state):
+        """N threads increment a counter under the lock; no lost updates."""
+        counter = {"v": 0}
+
+        def worker():
+            for _ in range(20):
+                with StateLock(state, "ctr", ttl_s=5):
+                    counter["v"] += 1
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert counter["v"] == 80
+
+
+class TestLeaderElection:
+    def test_single_leader(self, state):
+        elected = []
+        e1 = LeaderElection(state, "svc", member_id="m1",
+                            metadata={"ip": "10.0.0.1"},
+                            on_elected=lambda: elected.append("m1"))
+        e2 = LeaderElection(state, "svc", member_id="m2",
+                            metadata={"ip": "10.0.0.2"},
+                            on_elected=lambda: elected.append("m2"))
+        e1.start(poll_s=0.05)
+        deadline = time.time() + 5
+        while not e1.is_leader and time.time() < deadline:
+            time.sleep(0.02)
+        assert e1.is_leader
+        e2.start(poll_s=0.05)
+        time.sleep(0.2)
+        assert not e2.is_leader
+        leader = e2.leader()
+        assert leader["member_id"] == "m1"
+        assert leader["ip"] == "10.0.0.1"
+        e1.resign()
+        e2.resign()
+
+    def test_failover(self, state):
+        e1 = LeaderElection(state, "svc", member_id="m1", ttl_s=0.2)
+        e2 = LeaderElection(state, "svc", member_id="m2", ttl_s=0.2)
+        e1.start(poll_s=0.02)
+        deadline = time.time() + 5
+        while not e1.is_leader and time.time() < deadline:
+            time.sleep(0.02)
+        e2.start(poll_s=0.02)
+        # kill m1's renewal without a clean resign
+        e1._stop.set()
+        e1._lock._stop_renewer()
+        deadline = time.time() + 5
+        while not e2.is_leader and time.time() < deadline:
+            time.sleep(0.05)
+        assert e2.is_leader
+        e2.resign()
+
+
+class TestActiveStandby:
+    def test_activation_and_lookup(self, state):
+        events = []
+        svc = ActiveStandbyService(
+            state, "postgres", member_id="n1",
+            metadata={"ip": "10.0.0.1", "port": 5432},
+            activate=lambda: events.append("up"),
+            deactivate=lambda: events.append("down"))
+        svc.start()
+        assert svc.wait_active(timeout_s=5)
+        assert events == ["up"]
+        active = svc.get_active()
+        assert active["member_id"] == "n1"
+        assert active["port"] == 5432
+        svc.stop()
+        assert events == ["up", "down"]
+
+
+class TestDiscoveryClient:
+    def _registry(self, state):
+        return ServiceRegistry(state, cluster="c1", workspace="w1")
+
+    def test_discover(self, state):
+        reg = self._registry(state)
+        reg.register("mysql", "n1", "10.0.0.1", 3306)
+        reg.register("mysql", "n2", "10.0.0.2", 3306)
+        addrs = discover_service(reg, "mysql")
+        assert {a.host for a in addrs} == {"10.0.0.1", "10.0.0.2"}
+        assert discover_service_one(reg, "mysql") is not None
+        assert discover_service(reg, "absent") == []
+
+    def test_tag_filter(self, state):
+        reg = self._registry(state)
+        reg.register("pg", "n1", "10.0.0.1", 5432, tags={"role": "primary"})
+        reg.register("pg", "n2", "10.0.0.2", 5432, tags={"role": "replica"})
+        addrs = discover_service(reg, "pg", tags={"role": "primary"})
+        assert [a.host for a in addrs] == ["10.0.0.1"]
+
+    def test_wait_for_service(self, state):
+        reg = self._registry(state)
+
+        def later():
+            time.sleep(0.15)
+            reg.register("kafka", "n1", "10.0.0.9", 9092)
+
+        threading.Thread(target=later).start()
+        addr = wait_for_service(reg, "kafka", timeout_s=5, poll_s=0.05)
+        assert addr.host == "10.0.0.9"
+        with pytest.raises(TimeoutError):
+            wait_for_service(reg, "nope", timeout_s=0.2, poll_s=0.05)
+
+    def test_endpoint_for_config_explicit_wins(self, state):
+        reg = self._registry(state)
+        reg.register("mysql", "n1", "10.0.0.1", 3306)
+        cfg = {"runtime": {"metastore": {"mysql_endpoint": "db.example:3307"}}}
+        ep = discover_endpoint_for_config(
+            cfg, "metastore", "mysql", lambda: reg, default_port=3306)
+        assert ep == {"host": "db.example", "port": 3307,
+                      "discovery": DiscoveryType.CONFIG.value}
+
+    def test_endpoint_for_config_discovered(self, state):
+        reg = self._registry(state)
+        reg.register("mysql", "n1", "10.0.0.1", 3306)
+        ep = discover_endpoint_for_config(
+            {}, "metastore", "mysql", lambda: reg, default_port=3306)
+        assert ep["host"] == "10.0.0.1"
+        assert ep["discovery"] == DiscoveryType.CLUSTER.value
+
+
+class TestHealthCheck:
+    def test_checks_and_http(self, state):
+        hc = HealthCheckServer(host="127.0.0.1", port=0)
+        hc.register("good", lambda: (True, "fine"))
+        hc.register("bad", lambda: (False, "broken"))
+        hc.start()
+        try:
+            import urllib.error
+            import urllib.request
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{hc.port}/good", timeout=5) as r:
+                assert r.status == 200
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{hc.port}/bad", timeout=5)
+            assert ei.value.code == 503
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{hc.port}/unknown", timeout=5)
+            assert ei.value.code == 404
+        finally:
+            hc.stop()
+
+    def test_tcp_port_check(self, state):
+        hc = HealthCheckServer(host="127.0.0.1", port=0)
+        hc.start()
+        try:
+            ok, _ = tcp_port_check("127.0.0.1", hc.port)()
+            assert ok
+            bad, _ = tcp_port_check("127.0.0.1", 1)()  # closed port
+            assert not bad
+        finally:
+            hc.stop()
