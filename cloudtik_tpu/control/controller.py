@@ -16,8 +16,11 @@ from typing import Any, Dict, List, Optional
 from cloudtik_tpu.control.metrics import ClusterMetrics
 from cloudtik_tpu.control.scaler import ClusterScaler
 from cloudtik_tpu.control.state import (
-    StateClient, TABLE_HEARTBEAT, TABLE_METRICS, TABLE_SCALING)
+    StateClient, TABLE_HEARTBEAT, TABLE_METRICS, TABLE_NODES,
+    TABLE_SCALING)
 from cloudtik_tpu.core.node_provider import NodeProvider
+from cloudtik_tpu.core.tags import (
+    NODE_KIND_HEAD, TAG_NODE_KIND, TAG_NODE_SEQ_ID)
 from cloudtik_tpu.core.scaling_policy import ScalingPolicy
 from cloudtik_tpu.utils.constants import (
     TIK_METRICS_PORT_DEFAULT, TIK_UPDATE_INTERVAL_S)
@@ -95,11 +98,67 @@ class ClusterController:
         self.cluster_metrics.set_resource_demands(demands)
         self.cluster_metrics.set_lost_nodes(lost)
 
+    def _publish_node_table(self) -> None:
+        """Authoritative cluster membership into TABLE_NODES — consumed by
+        quorum runtimes (etcd/zookeeper/kafka/...) and the DNS renderers.
+
+        Also assigns stable seq ids (TAG_NODE_SEQ_ID) to untagged nodes:
+        head=1, workers get the smallest unused id — mysql server ids, zk
+        myids and DNS names depend on these staying unique and stable.
+        The tick loop is single-threaded, so assignment is race-free.
+        """
+        try:
+            node_ids = self.provider.non_terminated_nodes({})
+        except Exception:
+            logger.exception("node-table snapshot failed")
+            return
+        snapshot = []
+        for node_id in node_ids:
+            try:
+                tags = self.provider.node_tags(node_id)
+                ip = self.provider.internal_ip(node_id)
+            except Exception:
+                continue
+            snapshot.append((node_id, tags, ip))
+        used = {int(t.get(TAG_NODE_SEQ_ID, 0) or 0)
+                for _, t, _ in snapshot}
+        next_seq = 2  # 1 is reserved for the head
+        live = set()
+        for node_id, tags, ip in snapshot:
+            kind = tags.get(TAG_NODE_KIND, "worker")
+            seq = int(tags.get(TAG_NODE_SEQ_ID, 0) or 0)
+            if seq <= 0:
+                if kind == NODE_KIND_HEAD:
+                    seq = 1
+                else:
+                    while next_seq in used:
+                        next_seq += 1
+                    seq = next_seq
+                used.add(seq)
+                try:
+                    self.provider.set_node_tags(
+                        node_id, {TAG_NODE_SEQ_ID: str(seq)})
+                except Exception:
+                    logger.exception("seq-id tagging failed for %s",
+                                     node_id)
+            live.add(node_id)
+            self.state.table_put(TABLE_NODES, node_id, {
+                "ip": ip or "",
+                "kind": kind,
+                "is_head": kind == NODE_KIND_HEAD,
+                "seq_id": seq,
+                "time": time.time(),
+            })
+        for stale in self.state.table_list(TABLE_NODES):
+            if stale not in live:
+                self.state.table_delete(TABLE_NODES, stale)
+
     # -- loop ---------------------------------------------------------------
     def tick(self) -> None:
         self._pull_heartbeats()
         self._pull_node_metrics()
         self._pull_scaling_states()
+        self._publish_node_table()
         self.scaler.update()
         self.ticks += 1
         self.state.table_put("controller", "status", {

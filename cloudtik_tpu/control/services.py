@@ -123,16 +123,32 @@ class NodeServicesStarter:
         runtimes = iter_runtimes(self.config)
         process_specs = []
         log_dirs: Dict[str, str] = {"tik": TIK_LOGS_DIR}
+        # Node identity from the controller-published membership table
+        # (seq_id for stable server ids, node_ip for bind addresses).
+        my_info: Dict[str, Any] = {}
+        try:
+            my_info = self.state_client.table_get("nodes",
+                                                  self.node_id) or {}
+        except Exception:
+            logger.warning("nodes table unavailable; using defaults")
+        node_context = {
+            "is_head": self.is_head,
+            "head_ip": self.head_ip,
+            "node_id": self.node_id,
+            "node_ip": my_info.get("ip") or (
+                self.head_ip if self.is_head else ""),
+            "seq_id": my_info.get("seq_id",
+                                  1 if self.is_head else 0),
+            "config": self.config,
+            # stateful runtimes (etcd/zookeeper/kafka/...) resolve peer
+            # identity + membership through the state client
+            "state_client": self.state_client,
+        }
         for runtime in runtimes:
             specs = runtime.get_processes()
             if specs:
                 process_specs.extend(specs)
             log_dirs.update(runtime.get_logs())
-            node_context = {
-                "is_head": self.is_head,
-                "head_ip": self.head_ip,
-                "config": self.config,
-            }
             try:
                 runtime.node_configure(node_context)
                 runtime.node_services(node_context, "start")

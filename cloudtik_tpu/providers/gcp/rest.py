@@ -86,8 +86,12 @@ def _urllib_transport(method: str, url: str, body: Optional[Dict[str, Any]],
         return RestResponse(e.code, parsed)
     except (urllib.error.URLError, OSError) as e:
         # Transport failure (DNS, refused, timeout): surface as a retriable
-        # 503 so RestClient's retry loop handles it.
-        return RestResponse(503, {"error": {"message": f"transport: {e}"}})
+        # 503 so RestClient's retry loop handles it.  Marked so the client
+        # can refuse to retry non-idempotent methods on ambiguous failures
+        # (a timed-out POST may have been accepted server-side).
+        return RestResponse(
+            503, {"error": {"message": f"transport: {e}"},
+                  "transport_error": True})
 
 
 class RestClient:
@@ -123,7 +127,12 @@ class RestClient:
             if resp.status < 400:
                 return resp.body
             last = resp
+            ambiguous_transport = (
+                isinstance(resp.body, dict)
+                and resp.body.get("transport_error")
+                and method not in ("GET", "DELETE"))
             if resp.status in (429, 500, 502, 503, 504) \
+                    and not ambiguous_transport \
                     and attempt < self._max_retries:
                 time.sleep(self._retry_base_delay * (2 ** attempt))
                 continue

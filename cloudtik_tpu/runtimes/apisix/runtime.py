@@ -1,0 +1,53 @@
+"""APISIX runtime: API gateway (standalone declarative mode).
+
+Reference parity: runtime/apisix (SURVEY.md §2.3 — 1,220 LoC).  Renders
+apisix.yaml in standalone mode: routes + upstream node maps from the
+cluster service registry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from cloudtik_tpu.runtimes.common.runtime_base import (
+    HEAD, ServiceRuntimeBase)
+from cloudtik_tpu.runtimes.kong.runtime import _discovered_http_services
+
+APISIX_PORT = 9080
+
+
+def render_apisix_yaml(services: List[Dict[str, Any]]) -> str:
+    """services: [{name, targets: [{ip, port}]}] -> apisix.yaml text
+    (standalone mode requires the trailing #END marker)."""
+    import yaml
+    routes = []
+    for svc in services:
+        nodes = {f"{t['ip']}:{t['port']}": 1
+                 for t in sorted(svc["targets"],
+                                 key=lambda t: (t["ip"], t["port"]))}
+        routes.append({
+            "uri": f"/{svc['name']}/*",
+            "name": svc["name"],
+            "upstream": {"type": "roundrobin", "nodes": nodes},
+        })
+    return yaml.safe_dump({"routes": routes},
+                          sort_keys=False) + "#END\n"
+
+
+class APISIXRuntime(ServiceRuntimeBase):
+    SERVICE_NAME = "apisix"
+    DEFAULT_PORT = APISIX_PORT
+    PROTOCOL = "http"
+    NODE_KIND = HEAD
+    PROCESS_KEYWORD = "apisix"
+    ENDPOINT_NAME = "APISIX Gateway"
+
+    def node_configure(self, node_context: Dict[str, Any]) -> None:
+        if not self.runs_on(node_context):
+            return
+        import os
+        services = _discovered_http_services(
+            node_context, self.runtime_config)
+        with open(os.path.join(self.conf_dir(node_context),
+                               "apisix.yaml"), "w") as f:
+            f.write(render_apisix_yaml(services))

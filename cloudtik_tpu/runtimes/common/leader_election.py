@@ -9,6 +9,7 @@ active/standby services) to pick exactly one active member.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from typing import Any, Callable, Dict, Optional
@@ -16,6 +17,8 @@ from typing import Any, Callable, Dict, Optional
 from cloudtik_tpu.control.state import StateClient
 from cloudtik_tpu.runtimes.common.lock import (
     LOCK_NS, StateLock, _decode, default_owner_id)
+
+logger = logging.getLogger(__name__)
 
 ELECTION_NS = "elections"
 
@@ -75,12 +78,27 @@ class LeaderElection:
                         self._lock._start_renewer()
                         self._is_leader = True
                         if self.on_elected:
-                            self.on_elected()
+                            try:
+                                self.on_elected()
+                            except Exception:
+                                # failed activation: give up leadership so
+                                # a standby can take over (a raised
+                                # callback must never leave a dead member
+                                # renewing the lease)
+                                logger.exception(
+                                    "on_elected failed for %s; "
+                                    "resigning", self.name)
+                                self._is_leader = False
+                                self._lock.release()
                 else:
                     if not self._lock.held():
                         self._is_leader = False
                         if self.on_revoked:
-                            self.on_revoked()
+                            try:
+                                self.on_revoked()
+                            except Exception:
+                                logger.exception(
+                                    "on_revoked failed for %s", self.name)
                 self._stop.wait(poll_s)
 
         self._thread = threading.Thread(

@@ -1,0 +1,1 @@
+"""Shared DNS rendering for the dnsmasq/bind/coredns runtimes."""

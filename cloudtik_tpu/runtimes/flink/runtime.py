@@ -1,0 +1,60 @@
+"""Flink runtime: streaming engine (JobManager head / TaskManagers workers).
+
+Reference parity: runtime/flink (SURVEY.md §2.3 — 970 LoC; Flink on YARN).
+This build renders standalone-cluster flink-conf.yaml (no YARN required);
+when the yarn runtime is present the services script launches a YARN
+session instead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from cloudtik_tpu.runtimes.common.runtime_base import (
+    ALL_NODES, ServiceRuntimeBase)
+
+JM_RPC_PORT = 6123
+JM_UI_PORT = 8081
+
+
+def render_flink_conf(jobmanager_ip: str,
+                      jm_memory_mb: int = 1600,
+                      tm_memory_mb: int = 1728,
+                      slots_per_tm: int = 2) -> str:
+    return "\n".join([
+        f"jobmanager.rpc.address: {jobmanager_ip}",
+        f"jobmanager.rpc.port: {JM_RPC_PORT}",
+        f"jobmanager.memory.process.size: {jm_memory_mb}m",
+        f"taskmanager.memory.process.size: {tm_memory_mb}m",
+        f"taskmanager.numberOfTaskSlots: {slots_per_tm}",
+        f"rest.port: {JM_UI_PORT}",
+        "rest.address: 0.0.0.0",
+        "execution.checkpointing.interval: 60000",
+    ]) + "\n"
+
+
+class FlinkRuntime(ServiceRuntimeBase):
+    SERVICE_NAME = "flink"
+    DEFAULT_PORT = JM_UI_PORT
+    PROTOCOL = "http"
+    NODE_KIND = ALL_NODES
+    PROCESS_KEYWORD = "flink"
+    ENDPOINT_NAME = "Flink Dashboard"
+
+    def node_configure(self, node_context: Dict[str, Any]) -> None:
+        import os
+        conf = render_flink_conf(
+            node_context.get("head_ip", ""),
+            tm_memory_mb=int(
+                self.runtime_config.get("tm_memory_mb", 1728)),
+            slots_per_tm=int(
+                self.runtime_config.get("slots_per_tm", 2)))
+        with open(os.path.join(self.conf_dir(node_context),
+                               "flink-conf.yaml"), "w") as f:
+            f.write(conf)
+
+    def get_processes(self):
+        return [("StandaloneSessionClusterEntrypoint", False,
+                 "Flink JobManager", "head"),
+                ("TaskManagerRunner", False,
+                 "Flink TaskManager", "worker")]

@@ -1,0 +1,83 @@
+"""HDFS runtime: NameNode on head, DataNodes on workers.
+
+Reference parity: runtime/hdfs (SURVEY.md §2.3 — 1,362 LoC; NN/DN).
+Renders core-site.xml + hdfs-site.xml; the TPU build's primary storage path
+is GCS (mount runtime), HDFS exists for Spark/analytics parity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+from cloudtik_tpu.runtimes.common.runtime_base import (
+    ALL_NODES, ServiceRuntimeBase)
+
+NN_RPC_PORT = 9000
+NN_HTTP_PORT = 9870
+DN_PORT = 9866
+
+
+def _xml_configuration(props: List[Tuple[str, Any]]) -> str:
+    body = "\n".join(
+        f"  <property>\n    <name>{k}</name>\n"
+        f"    <value>{v}</value>\n  </property>"
+        for k, v in props)
+    return ("<?xml version=\"1.0\"?>\n<configuration>\n"
+            f"{body}\n</configuration>\n")
+
+
+def render_core_site(namenode_ip: str, rpc_port: int = NN_RPC_PORT) -> str:
+    return _xml_configuration([
+        ("fs.defaultFS", f"hdfs://{namenode_ip}:{rpc_port}"),
+        ("hadoop.tmp.dir", "/tmp/hadoop-tik"),
+    ])
+
+
+def render_hdfs_site(is_namenode: bool, replication: int = 3,
+                     data_dirs: str = "~/.tik/hdfs/data") -> str:
+    props = [
+        ("dfs.replication", replication),
+        ("dfs.namenode.name.dir", "~/.tik/hdfs/name"),
+        ("dfs.datanode.data.dir", data_dirs),
+        ("dfs.namenode.http-address", f"0.0.0.0:{NN_HTTP_PORT}"),
+        ("dfs.permissions.enabled", "false"),
+    ]
+    return _xml_configuration(props)
+
+
+class HDFSRuntime(ServiceRuntimeBase):
+    SERVICE_NAME = "hdfs"
+    DEFAULT_PORT = NN_RPC_PORT
+    NODE_KIND = ALL_NODES
+    PROCESS_KEYWORD = "NameNode"
+    ENDPOINT_NAME = "HDFS NameNode UI"
+
+    def node_configure(self, node_context: Dict[str, Any]) -> None:
+        import os
+        conf_dir = self.conf_dir(node_context)
+        head_ip = node_context.get("head_ip", "")
+        with open(os.path.join(conf_dir, "core-site.xml"), "w") as f:
+            f.write(render_core_site(head_ip, rpc_port=self.port))
+        with open(os.path.join(conf_dir, "hdfs-site.xml"), "w") as f:
+            f.write(render_hdfs_site(
+                is_namenode=bool(node_context.get("is_head")),
+                replication=int(
+                    self.runtime_config.get("replication", 3))))
+
+    def get_runtime_services(self, cluster_config, cluster_head_ip):
+        return {
+            "hdfs": {"protocol": "tcp", "port": self.port,
+                     "node_kind": "head", "tags": {"role": "namenode"}},
+            "hdfs-http": {"protocol": "http", "port": NN_HTTP_PORT,
+                          "node_kind": "head"},
+        }
+
+    def get_runtime_endpoints(self, cluster_config, cluster_head_ip):
+        return {"hdfs": {
+            "name": "HDFS NameNode UI",
+            "url": f"http://{cluster_head_ip}:{NN_HTTP_PORT}",
+        }}
+
+    def get_processes(self):
+        return [("NameNode", False, "HDFS NameNode", "head"),
+                ("DataNode", False, "HDFS DataNode", "worker")]

@@ -1,0 +1,84 @@
+"""Kong runtime: API gateway with declarative config from discovery.
+
+Reference parity: runtime/kong (SURVEY.md §2.3 — 3,217 LoC).  Renders
+kong.yml (DB-less declarative format): one service+route per discovered
+HTTP service, upstream targets from the registry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from cloudtik_tpu.runtimes.common.runtime_base import (
+    HEAD, ServiceRuntimeBase)
+
+KONG_PROXY_PORT = 8000
+KONG_ADMIN_PORT = 8001
+
+
+def render_kong_declarative(services: List[Dict[str, Any]]) -> str:
+    """services: [{name, path, targets: [{ip, port}]}] -> kong.yml text."""
+    import yaml
+    doc: Dict[str, Any] = {"_format_version": "3.0",
+                           "services": [], "upstreams": []}
+    for svc in services:
+        name = svc["name"]
+        doc["upstreams"].append({
+            "name": f"{name}.upstream",
+            "targets": [
+                {"target": f"{t['ip']}:{t['port']}", "weight": 100}
+                for t in sorted(svc["targets"],
+                                key=lambda t: (t["ip"], t["port"]))],
+        })
+        doc["services"].append({
+            "name": name,
+            "host": f"{name}.upstream",
+            "routes": [{"name": f"{name}-route",
+                        "paths": [svc.get("path", f"/{name}")]}],
+        })
+    return yaml.safe_dump(doc, sort_keys=False)
+
+
+class KongRuntime(ServiceRuntimeBase):
+    SERVICE_NAME = "kong"
+    DEFAULT_PORT = KONG_PROXY_PORT
+    PROTOCOL = "http"
+    NODE_KIND = HEAD
+    PROCESS_KEYWORD = "kong"
+    ENDPOINT_NAME = "Kong API Gateway"
+
+    def node_configure(self, node_context: Dict[str, Any]) -> None:
+        if not self.runs_on(node_context):
+            return
+        import os
+        services = _discovered_http_services(
+            node_context, self.runtime_config)
+        with open(os.path.join(self.conf_dir(node_context),
+                               "kong.yml"), "w") as f:
+            f.write(render_kong_declarative(services))
+
+
+def _discovered_http_services(node_context: Dict[str, Any],
+                              runtime_config: Dict[str, Any]
+                              ) -> List[Dict[str, Any]]:
+    state = node_context.get("state_client")
+    if state is None:
+        return []
+    from cloudtik_tpu.runtimes.common.discovery_client import (
+        discover_service)
+    from cloudtik_tpu.runtimes.discovery.runtime import ServiceRegistry
+    config = node_context.get("config", {})
+    registry = ServiceRegistry(
+        state, cluster=config.get("cluster_name", ""),
+        workspace=config.get("workspace_name", ""))
+    names = runtime_config.get("services") or sorted(
+        {s["name"] for s in registry.query()
+         if s.get("protocol") == "http"})
+    out = []
+    for name in names:
+        addrs = discover_service(registry, name)
+        if addrs:
+            out.append({"name": name,
+                        "targets": [{"ip": a.host, "port": a.port}
+                                    for a in addrs]})
+    return out

@@ -1,0 +1,67 @@
+"""MongoDB runtime: replica set across cluster nodes.
+
+Reference parity: runtime/mongodb (SURVEY.md §2.3 — 3,341 LoC; replica-set
+HA).  Renders mongod.conf plus the rs.initiate() document the services
+script applies once on the head.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+from cloudtik_tpu.runtimes.common.runtime_base import (
+    ALL_NODES, ServiceRuntimeBase)
+from cloudtik_tpu.runtimes.etcd.runtime import quorum_members
+
+MONGO_PORT = 27017
+REPLICA_SET = "tik-rs"
+
+
+def render_mongod_conf(port: int = MONGO_PORT,
+                       replica_set: str = REPLICA_SET,
+                       data_dir: str = "~/.tik/mongodb/data",
+                       cache_gb: float = 0.5) -> str:
+    import yaml
+    return yaml.safe_dump({
+        "net": {"port": port, "bindIp": "0.0.0.0"},
+        "storage": {"dbPath": data_dir,
+                    "wiredTiger": {"engineConfig":
+                                   {"cacheSizeGB": cache_gb}}},
+        "replication": {"replSetName": replica_set},
+    })
+
+
+def render_replset_initiate(members: List[Dict[str, Any]],
+                            port: int = MONGO_PORT,
+                            replica_set: str = REPLICA_SET) -> str:
+    """rs.initiate() JSON: head is priority-2 so it wins initial election."""
+    docs = []
+    for i, m in enumerate(sorted(members, key=lambda m: m["name"])):
+        docs.append({"_id": i, "host": f"{m['ip']}:{port}",
+                     "priority": 2 if m.get("is_head") else 1})
+    return json.dumps({"_id": replica_set, "members": docs}, indent=1)
+
+
+class MongoDBRuntime(ServiceRuntimeBase):
+    SERVICE_NAME = "mongodb"
+    DEFAULT_PORT = MONGO_PORT
+    NODE_KIND = ALL_NODES
+    PROCESS_KEYWORD = "mongod"
+
+    def node_configure(self, node_context: Dict[str, Any]) -> None:
+        import os
+        conf_dir = self.conf_dir(node_context)
+        with open(os.path.join(conf_dir, "mongod.conf"), "w") as f:
+            f.write(render_mongod_conf(
+                port=self.port,
+                cache_gb=float(self.runtime_config.get("cache_gb", 0.5))))
+        if node_context.get("is_head"):
+            members = [{"name": node_context.get("node_id", "head"),
+                        "ip": node_context.get("head_ip", ""),
+                        "is_head": True}]
+            members += [dict(m, is_head=False)
+                        for m in quorum_members(node_context)
+                        if m["name"] != node_context.get("node_id")]
+            with open(os.path.join(conf_dir, "initiate.json"), "w") as f:
+                f.write(render_replset_initiate(members, port=self.port))

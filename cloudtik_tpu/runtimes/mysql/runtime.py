@@ -1,0 +1,71 @@
+"""MySQL runtime: source-replica replication.
+
+Reference parity: runtime/mysql (SURVEY.md §2.3 — 1,438 LoC; HA via
+replication).  Source on head, replicas on workers; server ids are derived
+from the node's stable seq id so they survive restarts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from cloudtik_tpu.runtimes.common.runtime_base import (
+    ALL_NODES, ServiceRuntimeBase)
+
+MYSQL_PORT = 3306
+
+
+def render_my_cnf(server_id: int, port: int = MYSQL_PORT,
+                  is_source: bool = True,
+                  source_ip: Optional[str] = None,
+                  buffer_pool_mb: int = 256,
+                  data_dir: str = "~/.tik/mysql/data") -> str:
+    lines = [
+        "[mysqld]",
+        f"server-id = {server_id}",
+        f"port = {port}",
+        "bind-address = 0.0.0.0",
+        f"datadir = {data_dir}",
+        f"innodb_buffer_pool_size = {buffer_pool_mb}M",
+        "log-bin = mysql-bin",
+        "binlog_format = ROW",
+        "gtid_mode = ON",
+        "enforce-gtid-consistency = ON",
+    ]
+    if not is_source:
+        lines += [
+            "relay-log = relay-bin",
+            "read_only = ON",
+            f"# replicate from {source_ip}:{port} (CHANGE REPLICATION "
+            "SOURCE issued by the services script)",
+        ]
+    return "\n".join(lines) + "\n"
+
+
+class MySQLRuntime(ServiceRuntimeBase):
+    SERVICE_NAME = "mysql"
+    DEFAULT_PORT = MYSQL_PORT
+    NODE_KIND = ALL_NODES
+    PROCESS_KEYWORD = "mysqld"
+
+    def node_configure(self, node_context: Dict[str, Any]) -> None:
+        import os
+        is_head = bool(node_context.get("is_head"))
+        seq = int(node_context.get("seq_id", 0))
+        conf = render_my_cnf(
+            server_id=seq + 1, port=self.port, is_source=is_head,
+            source_ip=node_context.get("head_ip"),
+            buffer_pool_mb=int(
+                self.runtime_config.get("buffer_pool_mb", 256)))
+        with open(os.path.join(self.conf_dir(node_context),
+                               "my.cnf"), "w") as f:
+            f.write(conf)
+
+    def get_runtime_services(self, cluster_config, cluster_head_ip):
+        return {
+            "mysql": {"protocol": "tcp", "port": self.port,
+                      "node_kind": "head", "tags": {"role": "source"}},
+            "mysql-replica": {"protocol": "tcp", "port": self.port,
+                              "node_kind": "worker",
+                              "tags": {"role": "replica"}},
+        }

@@ -1,0 +1,60 @@
+"""PgBouncer runtime: lightweight Postgres connection pooler.
+
+Reference parity: runtime/pgbouncer (SURVEY.md §2.3 — 1,245 LoC).  Renders
+pgbouncer.ini pointed at the discovered postgres primary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from cloudtik_tpu.runtimes.common.runtime_base import (
+    HEAD, ServiceRuntimeBase)
+from cloudtik_tpu.runtimes.pgpool.runtime import _postgres_backends
+
+PGBOUNCER_PORT = 6432
+
+
+def render_pgbouncer_ini(primary_ip: str, primary_port: int = 5432,
+                         port: int = PGBOUNCER_PORT,
+                         pool_mode: str = "transaction",
+                         max_client_conn: int = 200,
+                         default_pool_size: int = 20) -> str:
+    return "\n".join([
+        "[databases]",
+        f"* = host={primary_ip} port={primary_port}",
+        "",
+        "[pgbouncer]",
+        f"listen_port = {port}",
+        "listen_addr = 0.0.0.0",
+        "auth_type = md5",
+        "auth_file = ~/.tik/pgbouncer/userlist.txt",
+        f"pool_mode = {pool_mode}",
+        f"max_client_conn = {max_client_conn}",
+        f"default_pool_size = {default_pool_size}",
+    ]) + "\n"
+
+
+class PgBouncerRuntime(ServiceRuntimeBase):
+    SERVICE_NAME = "pgbouncer"
+    DEFAULT_PORT = PGBOUNCER_PORT
+    NODE_KIND = HEAD
+    PROCESS_KEYWORD = "pgbouncer"
+    DEPENDENCIES = ["postgres"]
+
+    def node_configure(self, node_context: Dict[str, Any]) -> None:
+        if not self.runs_on(node_context):
+            return
+        import os
+        backends = _postgres_backends(node_context)
+        primary = next((b for b in backends if b["role"] == "primary"),
+                       None)
+        if primary is None:
+            primary = {"ip": node_context.get("head_ip", "127.0.0.1"),
+                       "port": 5432}
+        ini = render_pgbouncer_ini(
+            primary["ip"], primary["port"], port=self.port,
+            pool_mode=self.runtime_config.get("pool_mode", "transaction"))
+        with open(os.path.join(self.conf_dir(node_context),
+                               "pgbouncer.ini"), "w") as f:
+            f.write(ini)

@@ -1,0 +1,77 @@
+"""Pgpool-II runtime: Postgres pooling/load-balancing proxy.
+
+Reference parity: runtime/pgpool (SURVEY.md §2.3 — 2,267 LoC).  Renders
+pgpool.conf with the backend list resolved from the cluster's postgres
+primary + replicas (discovery tags role=primary/replica).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from cloudtik_tpu.runtimes.common.runtime_base import (
+    HEAD, ServiceRuntimeBase)
+
+PGPOOL_PORT = 9999
+
+
+def render_pgpool_conf(backends: List[Dict[str, Any]],
+                       port: int = PGPOOL_PORT) -> str:
+    """backends: [{ip, port, role}] — primary gets flag ALWAYS_PRIMARY."""
+    lines = [
+        f"port = {port}",
+        "listen_addresses = '*'",
+        "backend_clustering_mode = 'streaming_replication'",
+        "load_balance_mode = on",
+        "sr_check_period = 10",
+        "health_check_period = 10",
+    ]
+    ordered = sorted(backends,
+                     key=lambda b: (b.get("role") != "primary", b["ip"]))
+    for i, be in enumerate(ordered):
+        lines += [
+            f"backend_hostname{i} = '{be['ip']}'",
+            f"backend_port{i} = {be['port']}",
+            f"backend_weight{i} = 1",
+        ]
+        if be.get("role") == "primary":
+            lines.append(f"backend_flag{i} = 'ALWAYS_PRIMARY'")
+    return "\n".join(lines) + "\n"
+
+
+class PgpoolRuntime(ServiceRuntimeBase):
+    SERVICE_NAME = "pgpool"
+    DEFAULT_PORT = PGPOOL_PORT
+    NODE_KIND = HEAD
+    PROCESS_KEYWORD = "pgpool"
+    DEPENDENCIES = ["postgres"]
+
+    def node_configure(self, node_context: Dict[str, Any]) -> None:
+        if not self.runs_on(node_context):
+            return
+        import os
+        backends = _postgres_backends(node_context)
+        with open(os.path.join(self.conf_dir(node_context),
+                               "pgpool.conf"), "w") as f:
+            f.write(render_pgpool_conf(backends, port=self.port))
+
+
+def _postgres_backends(node_context: Dict[str, Any]
+                       ) -> List[Dict[str, Any]]:
+    state = node_context.get("state_client")
+    if state is None:
+        return []
+    from cloudtik_tpu.runtimes.common.discovery_client import (
+        discover_service)
+    from cloudtik_tpu.runtimes.discovery.runtime import ServiceRegistry
+    config = node_context.get("config", {})
+    registry = ServiceRegistry(
+        state, cluster=config.get("cluster_name", ""),
+        workspace=config.get("workspace_name", ""))
+    backends = []
+    for name, role in (("postgres", "primary"),
+                       ("postgres-replica", "replica")):
+        for addr in discover_service(registry, name):
+            backends.append({"ip": addr.host, "port": addr.port,
+                             "role": role})
+    return backends

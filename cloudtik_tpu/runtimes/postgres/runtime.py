@@ -1,0 +1,96 @@
+"""PostgreSQL runtime: primary + streaming replicas with failover.
+
+Reference parity: runtime/postgres (SURVEY.md §2.3 — 4,120 LoC; HA via
+replication + consul/etcd leader election).  Primary election rides the
+common active-standby service on the head state store; replicas render
+primary_conninfo from the elected primary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from cloudtik_tpu.runtimes.common.runtime_base import (
+    ALL_NODES, ServiceRuntimeBase)
+
+PG_PORT = 5432
+
+
+def render_postgresql_conf(port: int = PG_PORT,
+                           max_connections: int = 100,
+                           shared_buffers_mb: int = 128,
+                           is_primary: bool = True,
+                           synchronous: bool = False) -> str:
+    lines = [
+        "listen_addresses = '*'",
+        f"port = {port}",
+        f"max_connections = {max_connections}",
+        f"shared_buffers = {shared_buffers_mb}MB",
+        "wal_level = replica",
+        "max_wal_senders = 10",
+        "max_replication_slots = 10",
+        "hot_standby = on",
+    ]
+    if is_primary and synchronous:
+        lines.append("synchronous_standby_names = '*'")
+    return "\n".join(lines) + "\n"
+
+
+def render_pg_hba(subnet_cidrs: List[str],
+                  replication_user: str = "replicator") -> str:
+    lines = [
+        "local   all             all                     trust",
+        "host    all             all   127.0.0.1/32      md5",
+    ]
+    for cidr in subnet_cidrs:
+        lines.append(f"host    all             all   {cidr:<17} md5")
+        lines.append(
+            f"host    replication     {replication_user} {cidr:<17} md5")
+    return "\n".join(lines) + "\n"
+
+
+def render_replica_conninfo(primary_ip: str, port: int = PG_PORT,
+                            user: str = "replicator",
+                            password: str = "") -> str:
+    """standby signal settings appended to postgresql.auto.conf."""
+    auth = f" password={password}" if password else ""
+    return (f"primary_conninfo = 'host={primary_ip} port={port} "
+            f"user={user}{auth} application_name=tik_standby'\n")
+
+
+class PostgresRuntime(ServiceRuntimeBase):
+    SERVICE_NAME = "postgres"
+    DEFAULT_PORT = PG_PORT
+    NODE_KIND = ALL_NODES
+    PROCESS_KEYWORD = "postgres"
+
+    def node_configure(self, node_context: Dict[str, Any]) -> None:
+        import os
+        is_head = bool(node_context.get("is_head"))
+        conf_dir = self.conf_dir(node_context)
+        with open(os.path.join(conf_dir, "postgresql.conf"), "w") as f:
+            f.write(render_postgresql_conf(
+                port=self.port, is_primary=is_head,
+                shared_buffers_mb=int(
+                    self.runtime_config.get("shared_buffers_mb", 128)),
+                synchronous=bool(
+                    self.runtime_config.get("synchronous", False))))
+        with open(os.path.join(conf_dir, "pg_hba.conf"), "w") as f:
+            f.write(render_pg_hba(
+                self.runtime_config.get("allowed_cidrs", ["10.0.0.0/8"])))
+        if not is_head:
+            with open(os.path.join(conf_dir, "standby.conf"), "w") as f:
+                f.write(render_replica_conninfo(
+                    node_context.get("head_ip", ""), port=self.port,
+                    password=self.runtime_config.get(
+                        "replication_password", "")))
+
+    def get_runtime_services(self, cluster_config, cluster_head_ip):
+        return {
+            "postgres": {"protocol": "tcp", "port": self.port,
+                         "node_kind": "head",
+                         "tags": {"role": "primary"}},
+            "postgres-replica": {"protocol": "tcp", "port": self.port,
+                                 "node_kind": "worker",
+                                 "tags": {"role": "replica"}},
+        }

@@ -1,0 +1,37 @@
+"""Presto runtime: distributed SQL (coordinator head / workers).
+
+Reference parity: runtime/presto (SURVEY.md §2.3 — 665 LoC).  Same config
+shape as Trino (they share lineage); kept as a distinct runtime for
+capability parity with the reference's separate presto plugin.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from cloudtik_tpu.runtimes.common.runtime_base import (
+    ALL_NODES, ServiceRuntimeBase)
+from cloudtik_tpu.runtimes.trino.runtime import (
+    render_hive_catalog, render_trino_config)
+
+PRESTO_PORT = 8082
+
+
+class PrestoRuntime(ServiceRuntimeBase):
+    SERVICE_NAME = "presto"
+    DEFAULT_PORT = PRESTO_PORT
+    PROTOCOL = "http"
+    NODE_KIND = ALL_NODES
+    PROCESS_KEYWORD = "com.facebook.presto.server.PrestoServer"
+    ENDPOINT_NAME = "Presto"
+
+    def node_configure(self, node_context: Dict[str, Any]) -> None:
+        import os
+        conf_dir = self.conf_dir(node_context)
+        files = render_trino_config(
+            bool(node_context.get("is_head")),
+            node_context.get("head_ip", ""), port=self.port,
+            heap_gb=int(self.runtime_config.get("heap_gb", 4)))
+        for fname, content in files.items():
+            with open(os.path.join(conf_dir, fname), "w") as f:
+                f.write(content)

@@ -1,0 +1,72 @@
+"""Redis runtime: cache/KV with primary-replica replication.
+
+Reference parity: runtime/redis (SURVEY.md §2.3 — 2,965 LoC; HA via
+replication + leader election).  Primary runs on the head; workers render
+`replicaof` pointing at it.  Failover promotes a replica through the
+common active-standby service.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from cloudtik_tpu.runtimes.common.runtime_base import (
+    ALL_NODES, ServiceRuntimeBase)
+
+REDIS_PORT = 6379
+
+
+def render_redis_conf(port: int = REDIS_PORT,
+                      primary_ip: Optional[str] = None,
+                      primary_port: int = REDIS_PORT,
+                      password: Optional[str] = None,
+                      data_dir: str = "~/.tik/redis/data",
+                      maxmemory_mb: int = 0) -> str:
+    """redis.conf text; replica when primary_ip is another host."""
+    lines = [
+        f"port {port}",
+        "bind 0.0.0.0",
+        "protected-mode no" if not password else "protected-mode yes",
+        f"dir {data_dir}",
+        "appendonly yes",
+        "save 900 1",
+    ]
+    if maxmemory_mb:
+        lines += [f"maxmemory {maxmemory_mb}mb",
+                  "maxmemory-policy allkeys-lru"]
+    if password:
+        lines += [f"requirepass {password}",
+                  f"masterauth {password}"]
+    if primary_ip:
+        lines.append(f"replicaof {primary_ip} {primary_port}")
+    return "\n".join(lines) + "\n"
+
+
+class RedisRuntime(ServiceRuntimeBase):
+    SERVICE_NAME = "redis"
+    DEFAULT_PORT = REDIS_PORT
+    NODE_KIND = ALL_NODES
+    PROCESS_KEYWORD = "redis-server"
+
+    def node_configure(self, node_context: Dict[str, Any]) -> None:
+        import os
+        is_head = bool(node_context.get("is_head"))
+        conf = render_redis_conf(
+            port=self.port,
+            primary_ip=None if is_head else node_context.get("head_ip"),
+            primary_port=self.port,
+            password=self.runtime_config.get("password"),
+            maxmemory_mb=int(self.runtime_config.get("maxmemory_mb", 0)))
+        with open(os.path.join(self.conf_dir(node_context),
+                               "redis.conf"), "w") as f:
+            f.write(conf)
+
+    def get_runtime_services(self, cluster_config, cluster_head_ip):
+        return {
+            "redis": {"protocol": "tcp", "port": self.port,
+                      "node_kind": "head",
+                      "tags": {"role": "primary"}},
+            "redis-replica": {"protocol": "tcp", "port": self.port,
+                              "node_kind": "worker",
+                              "tags": {"role": "replica"}},
+        }

@@ -22,6 +22,43 @@ _BUILT_IN: Dict[str, str] = {
     "spark": "cloudtik_tpu.runtimes.spark.runtime:SparkRuntime",
     "grafana": "cloudtik_tpu.runtimes.grafana.runtime:GrafanaRuntime",
     "mlflow": "cloudtik_tpu.runtimes.mlflow.runtime:MLflowRuntime",
+    # stateful / data services
+    "etcd": "cloudtik_tpu.runtimes.etcd.runtime:EtcdRuntime",
+    "zookeeper":
+        "cloudtik_tpu.runtimes.zookeeper.runtime:ZooKeeperRuntime",
+    "kafka": "cloudtik_tpu.runtimes.kafka.runtime:KafkaRuntime",
+    "redis": "cloudtik_tpu.runtimes.redis.runtime:RedisRuntime",
+    "mysql": "cloudtik_tpu.runtimes.mysql.runtime:MySQLRuntime",
+    "postgres":
+        "cloudtik_tpu.runtimes.postgres.runtime:PostgresRuntime",
+    "mongodb": "cloudtik_tpu.runtimes.mongodb.runtime:MongoDBRuntime",
+    "elasticsearch":
+        "cloudtik_tpu.runtimes.elasticsearch.runtime:ElasticsearchRuntime",
+    "hdfs": "cloudtik_tpu.runtimes.hdfs.runtime:HDFSRuntime",
+    "metastore":
+        "cloudtik_tpu.runtimes.metastore.runtime:MetastoreRuntime",
+    "minio": "cloudtik_tpu.runtimes.minio.runtime:MinIORuntime",
+    "consul": "cloudtik_tpu.runtimes.consul.runtime:ConsulRuntime",
+    # load balancers / gateways / DNS / health
+    "haproxy": "cloudtik_tpu.runtimes.haproxy.runtime:HAProxyRuntime",
+    "nginx": "cloudtik_tpu.runtimes.nginx.runtime:NginxRuntime",
+    "kong": "cloudtik_tpu.runtimes.kong.runtime:KongRuntime",
+    "apisix": "cloudtik_tpu.runtimes.apisix.runtime:APISIXRuntime",
+    "loadbalancer":
+        "cloudtik_tpu.runtimes.loadbalancer.runtime:LoadBalancerRuntime",
+    "dnsmasq": "cloudtik_tpu.runtimes.dnsmasq.runtime:DnsmasqRuntime",
+    "bind": "cloudtik_tpu.runtimes.bind.runtime:BindRuntime",
+    "coredns": "cloudtik_tpu.runtimes.coredns.runtime:CoreDNSRuntime",
+    "xinetd": "cloudtik_tpu.runtimes.xinetd.runtime:XinetdRuntime",
+    # compute / SQL engines / poolers
+    "yarn": "cloudtik_tpu.runtimes.yarn.runtime:YARNRuntime",
+    "flink": "cloudtik_tpu.runtimes.flink.runtime:FlinkRuntime",
+    "ray": "cloudtik_tpu.runtimes.ray.runtime:RayRuntime",
+    "trino": "cloudtik_tpu.runtimes.trino.runtime:TrinoRuntime",
+    "presto": "cloudtik_tpu.runtimes.presto.runtime:PrestoRuntime",
+    "pgpool": "cloudtik_tpu.runtimes.pgpool.runtime:PgpoolRuntime",
+    "pgbouncer":
+        "cloudtik_tpu.runtimes.pgbouncer.runtime:PgBouncerRuntime",
 }
 
 # Installed on every cluster unless disabled (reference: DEFAULT_RUNTIMES =

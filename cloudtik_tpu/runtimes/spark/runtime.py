@@ -1,7 +1,7 @@
 """Spark/ETL runtime: batch ETL feeding TPU training clusters.
 
 Reference parity: runtime/spark (SURVEY.md §2.3 — Spark on YARN, memory
-sizing utils.py:49-86, `cloudtik submit` job路由 via get_runnable_command
+sizing utils.py:49-86, `cloudtik submit` job routing via get_runnable_command
 runtime/spark/utils.py:170).  TPU-first scope for this build: Spark runs in
 standalone mode (no YARN/HDFS dependency), sized from node resources, and
 its headline job is exporting tokenized training shards to the shared

@@ -1,0 +1,233 @@
+"""Tests for LB/gateway/DNS/health runtimes + compute/SQL runtimes."""
+
+import json
+
+import pytest
+import yaml
+
+from cloudtik_tpu.control.state import InMemoryStateBackend, StateClient
+from cloudtik_tpu.core.load_balancer_provider import LoadBalancerProvider
+from cloudtik_tpu.runtimes.apisix.runtime import render_apisix_yaml
+from cloudtik_tpu.runtimes.bind.runtime import (
+    render_named_conf, render_zone_file)
+from cloudtik_tpu.runtimes.coredns.runtime import render_corefile
+from cloudtik_tpu.runtimes.discovery.runtime import ServiceRegistry
+from cloudtik_tpu.runtimes.dns.records import cluster_dns_records
+from cloudtik_tpu.runtimes.dnsmasq.runtime import (
+    render_dnsmasq_conf, render_hosts_file)
+from cloudtik_tpu.runtimes.flink.runtime import render_flink_conf
+from cloudtik_tpu.runtimes.haproxy.runtime import (
+    HAProxyRuntime, backends_from_registry, render_haproxy_cfg)
+from cloudtik_tpu.runtimes.kong.runtime import render_kong_declarative
+from cloudtik_tpu.runtimes.loadbalancer.runtime import (
+    LoadBalancerController, desired_load_balancers,
+    reconcile_load_balancers)
+from cloudtik_tpu.runtimes.nginx.runtime import render_nginx_conf
+from cloudtik_tpu.runtimes.pgbouncer.runtime import render_pgbouncer_ini
+from cloudtik_tpu.runtimes.pgpool.runtime import render_pgpool_conf
+from cloudtik_tpu.runtimes.ray.runtime import ray_start_command
+from cloudtik_tpu.runtimes.registry import get_runtime_cls
+from cloudtik_tpu.runtimes.trino.runtime import (
+    render_hive_catalog, render_trino_config)
+from cloudtik_tpu.runtimes.xinetd.runtime import build_health_server
+from cloudtik_tpu.runtimes.yarn.runtime import (
+    render_yarn_site, size_node_resources)
+
+
+@pytest.fixture
+def registry():
+    state = StateClient(InMemoryStateBackend())
+    reg = ServiceRegistry(state, cluster="c1", workspace="w1")
+    reg.register("mlflow", "n-0", "10.0.0.1", 5000, protocol="http")
+    reg.register("mlflow", "n-1", "10.0.0.2", 5000, protocol="http")
+    reg.register("postgres", "head", "10.0.0.100", 5432,
+                 tags={"role": "primary", "lb-expose": "true"})
+    return reg
+
+
+class TestRegistryBatch2:
+    @pytest.mark.parametrize("name", [
+        "haproxy", "nginx", "kong", "apisix", "loadbalancer", "dnsmasq",
+        "bind", "coredns", "xinetd", "yarn", "flink", "ray", "trino",
+        "presto", "pgpool", "pgbouncer"])
+    def test_all_registered(self, name):
+        rt = get_runtime_cls(name)({})
+        assert rt is not None
+
+
+class TestHAProxy:
+    def test_render(self):
+        cfg = render_haproxy_cfg([{
+            "name": "mlflow", "bind_port": 5000, "mode": "http",
+            "backends": [{"name": "n-1", "ip": "10.0.0.2", "port": 5000},
+                         {"name": "n-0", "ip": "10.0.0.1", "port": 5000}],
+        }])
+        assert "frontend mlflow_fe" in cfg
+        assert "bind *:5000" in cfg
+        # backends sorted for stable config hashing
+        assert cfg.index("server n-0") < cfg.index("server n-1")
+
+    def test_backends_from_registry(self, registry):
+        frontends = backends_from_registry(registry, ["mlflow"])
+        assert len(frontends) == 1
+        assert len(frontends[0]["backends"]) == 2
+        # bound off the service port so head-hosted primaries keep theirs
+        assert frontends[0]["bind_port"] == 15000
+
+    def test_bind_port_override(self, registry):
+        frontends = backends_from_registry(
+            registry, ["mlflow"], bind_ports={"mlflow": 8443})
+        assert frontends[0]["bind_port"] == 8443
+
+
+class TestNginxKongApisix:
+    UP = [{"name": "mlflow", "path": "/mlflow",
+           "servers": [{"ip": "10.0.0.1", "port": 5000}],
+           "targets": [{"ip": "10.0.0.1", "port": 5000}]}]
+
+    def test_nginx(self):
+        conf = render_nginx_conf(self.UP)
+        assert "upstream mlflow" in conf
+        assert "proxy_pass http://mlflow/" in conf
+
+    def test_kong(self):
+        doc = yaml.safe_load(render_kong_declarative(self.UP))
+        assert doc["services"][0]["host"] == "mlflow.upstream"
+        assert doc["upstreams"][0]["targets"][0]["target"] == \
+            "10.0.0.1:5000"
+
+    def test_apisix(self):
+        text = render_apisix_yaml(self.UP)
+        assert text.endswith("#END\n")
+        doc = yaml.safe_load(text.replace("#END", ""))
+        assert doc["routes"][0]["upstream"]["nodes"] == {
+            "10.0.0.1:5000": 1}
+
+
+class FakeLBProvider(LoadBalancerProvider):
+    def __init__(self):
+        super().__init__({}, "w1")
+        self.lbs = {}
+
+    def list(self):
+        return dict(self.lbs)
+
+    def create(self, config):
+        self.lbs[config["name"]] = dict(config, managed=True)
+
+    def update(self, lb, config):
+        self.lbs[lb["name"]] = dict(config, managed=True)
+
+    def delete(self, lb):
+        self.lbs.pop(lb["name"], None)
+
+
+class TestLoadBalancerController:
+    def test_desired_from_tags(self, registry):
+        desired = desired_load_balancers(registry.query(), "w1")
+        assert list(desired) == ["w1-postgres"]
+        assert desired["w1-postgres"]["targets"] == [
+            {"ip": "10.0.0.100", "port": 5432}]
+
+    def test_reconcile_create_update_delete(self, registry):
+        provider = FakeLBProvider()
+        ctrl = LoadBalancerController(provider, registry, "w1")
+        out = ctrl.run_once()
+        assert out["created"] == ["w1-postgres"]
+        # new replica appears -> update
+        registry.register("postgres", "n-1", "10.0.0.2", 5432,
+                          tags={"role": "replica", "lb-expose": "true"})
+        out = ctrl.run_once()
+        assert out["updated"] == ["w1-postgres"]
+        assert len(provider.lbs["w1-postgres"]["targets"]) == 2
+        # service deregistered -> delete
+        registry.deregister("postgres", "head")
+        registry.deregister("postgres", "n-1")
+        out = ctrl.run_once()
+        assert out["deleted"] == ["w1-postgres"]
+        assert provider.lbs == {}
+
+
+class TestDNS:
+    NODES = {"n-0": {"ip": "10.0.0.1", "seq_id": 1},
+             "n-1": {"ip": "10.0.0.2", "seq_id": 2}}
+    SVCS = [{"name": "mlflow", "ip": "10.0.0.1", "port": 5000}]
+
+    def test_records(self):
+        recs = cluster_dns_records("c1", "w1", self.NODES, self.SVCS)
+        assert ("c1-1.w1.tik", "10.0.0.1") in recs
+        assert ("mlflow.c1.w1.tik", "10.0.0.1") in recs
+
+    def test_hosts_and_dnsmasq(self):
+        recs = cluster_dns_records("c1", "w1", self.NODES, self.SVCS)
+        hosts = render_hosts_file(recs)
+        assert "10.0.0.1 c1-1.w1.tik" in hosts
+        conf = render_dnsmasq_conf("/tmp/hosts", port=5353)
+        assert "port=5353" in conf and "local=/tik/" in conf
+
+    def test_bind_zone(self):
+        recs = cluster_dns_records("c1", "w1", self.NODES, self.SVCS)
+        zone = render_zone_file("w1.tik", recs, "10.0.0.100")
+        assert "c1-1 IN A 10.0.0.1" in zone
+        assert "IN SOA" in zone
+        named = render_named_conf("w1.tik", "/tmp/zone")
+        assert 'zone "w1.tik"' in named
+
+    def test_corefile(self):
+        conf = render_corefile("/tmp/hosts", domain="tik")
+        assert "hosts /tmp/hosts tik" in conf
+        assert "forward . 8.8.8.8" in conf
+
+
+class TestHealthExposure:
+    def test_build_from_runtimes(self):
+        config = {"runtime": {"types": ["redis", "mysql"]}}
+        server = build_health_server(config, host="127.0.0.1", port=0)
+        assert set(server._checks) == {"redis", "mysql"}
+        ok, detail = server.run_check("redis")
+        assert not ok  # nothing listening on 6379 in tests
+
+
+class TestComputeRuntimes:
+    def test_yarn_sizing(self):
+        mem, cores = size_node_resources(16384, 8)
+        assert mem == 13107 and cores == 7
+        site = render_yarn_site("10.0.0.100", nm_memory_mb=mem,
+                                nm_vcores=cores)
+        assert "10.0.0.100:8032" in site
+
+    def test_flink_conf(self):
+        conf = render_flink_conf("10.0.0.100", slots_per_tm=4)
+        assert "jobmanager.rpc.address: 10.0.0.100" in conf
+        assert "taskmanager.numberOfTaskSlots: 4" in conf
+
+    def test_ray_commands(self):
+        head = ray_start_command(True, "10.0.0.100")
+        worker = ray_start_command(False, "10.0.0.100", num_cpus=8)
+        assert "--head" in head
+        assert "--address=10.0.0.100:6380" in worker
+        assert "--num-cpus=8" in worker
+
+    def test_trino_config(self):
+        files = render_trino_config(True, "10.0.0.100", heap_gb=8)
+        assert "coordinator=true" in files["config.properties"]
+        assert "-Xmx8G" in files["jvm.config"]
+        worker = render_trino_config(False, "10.0.0.100")
+        assert "coordinator=false" in worker["config.properties"]
+        assert "include-coordinator" not in worker["config.properties"]
+        catalog = render_hive_catalog("10.0.0.5")
+        assert "thrift://10.0.0.5:9083" in catalog
+
+    def test_pgpool_primary_first(self):
+        conf = render_pgpool_conf([
+            {"ip": "10.0.0.2", "port": 5432, "role": "replica"},
+            {"ip": "10.0.0.1", "port": 5432, "role": "primary"},
+        ])
+        assert "backend_hostname0 = '10.0.0.1'" in conf
+        assert "backend_flag0 = 'ALWAYS_PRIMARY'" in conf
+        assert "backend_hostname1 = '10.0.0.2'" in conf
+
+    def test_pgbouncer(self):
+        ini = render_pgbouncer_ini("10.0.0.1")
+        assert "* = host=10.0.0.1 port=5432" in ini
+        assert "pool_mode = transaction" in ini

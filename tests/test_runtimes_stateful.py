@@ -1,0 +1,256 @@
+"""Tests for the stateful/data-service runtime batch."""
+
+import json
+import os
+
+import pytest
+import yaml
+
+from cloudtik_tpu.control.state import InMemoryStateBackend, StateClient
+from cloudtik_tpu.core.runtime import Runtime
+from cloudtik_tpu.runtimes.consul.runtime import (
+    render_consul_config, render_service_registrations)
+from cloudtik_tpu.runtimes.discovery.runtime import ServiceRegistry
+from cloudtik_tpu.runtimes.elasticsearch.runtime import (
+    render_elasticsearch_yml)
+from cloudtik_tpu.runtimes.etcd.runtime import (
+    EtcdRuntime, render_etcd_config)
+from cloudtik_tpu.runtimes.hdfs.runtime import (
+    render_core_site, render_hdfs_site)
+from cloudtik_tpu.runtimes.kafka.runtime import (
+    KafkaRuntime, render_server_properties)
+from cloudtik_tpu.runtimes.metastore.runtime import (
+    MetastoreRuntime, render_hive_site)
+from cloudtik_tpu.runtimes.minio.runtime import render_minio_env
+from cloudtik_tpu.runtimes.mongodb.runtime import (
+    render_mongod_conf, render_replset_initiate)
+from cloudtik_tpu.runtimes.mysql.runtime import render_my_cnf
+from cloudtik_tpu.runtimes.postgres.runtime import (
+    render_pg_hba, render_postgresql_conf, render_replica_conninfo)
+from cloudtik_tpu.runtimes.redis.runtime import render_redis_conf
+from cloudtik_tpu.runtimes.registry import get_runtime_cls
+from cloudtik_tpu.runtimes.zookeeper.runtime import render_zoo_cfg
+
+PEERS = [
+    {"name": "n-0", "ip": "10.0.0.1"},
+    {"name": "n-1", "ip": "10.0.0.2"},
+    {"name": "n-2", "ip": "10.0.0.3"},
+]
+
+
+class TestRegistry:
+    @pytest.mark.parametrize("name", [
+        "etcd", "zookeeper", "kafka", "redis", "mysql", "postgres",
+        "mongodb", "elasticsearch", "hdfs", "metastore", "minio",
+        "consul"])
+    def test_all_registered(self, name):
+        cls = get_runtime_cls(name)
+        rt = cls({})
+        assert isinstance(rt, Runtime)
+        services = rt.get_runtime_services({}, "10.0.0.1")
+        assert services
+        assert all("port" in s for s in services.values())
+
+
+class TestEtcd:
+    def test_render(self):
+        cfg = render_etcd_config("n-1", "10.0.0.2", PEERS)
+        assert cfg["name"] == "n-1"
+        assert cfg["initial-cluster"] == (
+            "n-0=http://10.0.0.1:2380,n-1=http://10.0.0.2:2380,"
+            "n-2=http://10.0.0.3:2380")
+        assert "10.0.0.2:2379" in cfg["advertise-client-urls"]
+
+    def test_quorum_constraint(self):
+        rt = EtcdRuntime({})
+        c = rt.get_node_constraints({}, "worker")
+        assert c.minimal == 3 and c.quorum
+
+
+class TestZooKeeper:
+    def test_render_identical_across_members(self):
+        cfg1, ids1 = render_zoo_cfg(PEERS)
+        cfg2, ids2 = render_zoo_cfg(list(reversed(PEERS)))
+        assert cfg1 == cfg2 and ids1 == ids2
+        assert "server.1=10.0.0.1:2888:3888" in cfg1
+        assert ids1 == {"n-0": 1, "n-1": 2, "n-2": 3}
+
+
+class TestKafka:
+    def test_kraft_mode(self):
+        props = render_server_properties("n-1", "10.0.0.2", PEERS)
+        assert "node.id=2" in props
+        assert ("controller.quorum.voters=1@10.0.0.1:9093,"
+                "2@10.0.0.2:9093,3@10.0.0.3:9093") in props
+        assert "process.roles=broker,controller" in props
+        assert "zookeeper.connect" not in props
+
+    def test_zookeeper_mode(self):
+        props = render_server_properties(
+            "n-0", "10.0.0.1", PEERS,
+            zookeeper_connect="10.0.0.1:2181,10.0.0.2:2181")
+        assert "zookeeper.connect=10.0.0.1:2181,10.0.0.2:2181" in props
+        assert "process.roles" not in props
+        assert "broker.id=1" in props
+
+    def test_replication_capped_at_3(self):
+        props = render_server_properties(
+            "n-0", "10.0.0.1",
+            [{"name": f"n-{i}", "ip": f"10.0.0.{i}"} for i in range(5)])
+        assert "default.replication.factor=3" in props
+
+
+class TestRedis:
+    def test_primary(self):
+        conf = render_redis_conf()
+        assert "replicaof" not in conf
+        assert "appendonly yes" in conf
+
+    def test_replica_with_password(self):
+        conf = render_redis_conf(primary_ip="10.0.0.1", password="pw",
+                                 maxmemory_mb=512)
+        assert "replicaof 10.0.0.1 6379" in conf
+        assert "requirepass pw" in conf
+        assert "masterauth pw" in conf
+        assert "maxmemory 512mb" in conf
+
+
+class TestMySQL:
+    def test_source_vs_replica(self):
+        src = render_my_cnf(server_id=1)
+        rep = render_my_cnf(server_id=2, is_source=False,
+                            source_ip="10.0.0.1")
+        assert "server-id = 1" in src and "read_only" not in src
+        assert "read_only = ON" in rep
+        assert "gtid_mode = ON" in src
+
+
+class TestPostgres:
+    def test_primary_conf(self):
+        conf = render_postgresql_conf(is_primary=True, synchronous=True)
+        assert "wal_level = replica" in conf
+        assert "synchronous_standby_names" in conf
+
+    def test_hba_covers_cidrs(self):
+        hba = render_pg_hba(["10.0.0.0/8", "192.168.0.0/16"])
+        assert "10.0.0.0/8" in hba and "192.168.0.0/16" in hba
+        assert "replication" in hba
+
+    def test_replica_conninfo(self):
+        info = render_replica_conninfo("10.0.0.1", password="pw")
+        assert "host=10.0.0.1" in info and "password=pw" in info
+
+
+class TestMongoDB:
+    def test_conf_and_initiate(self):
+        conf = yaml.safe_load(render_mongod_conf())
+        assert conf["replication"]["replSetName"] == "tik-rs"
+        doc = json.loads(render_replset_initiate(
+            [dict(PEERS[0], is_head=True)] + PEERS[1:]))
+        assert len(doc["members"]) == 3
+        head = next(m for m in doc["members"]
+                    if m["host"].startswith("10.0.0.1"))
+        assert head["priority"] == 2
+
+
+class TestElasticsearch:
+    def test_render(self):
+        cfg = yaml.safe_load(render_elasticsearch_yml(
+            "n-1", "10.0.0.2", PEERS, cluster_name="c1"))
+        assert cfg["cluster.name"] == "c1"
+        assert "10.0.0.1:9300" in cfg["discovery.seed_hosts"]
+        assert cfg["cluster.initial_master_nodes"] == ["n-0", "n-1", "n-2"]
+
+
+class TestHDFS:
+    def test_sites(self):
+        core = render_core_site("10.0.0.1")
+        assert "hdfs://10.0.0.1:9000" in core
+        site = render_hdfs_site(is_namenode=True, replication=2)
+        assert "<value>2</value>" in site
+
+
+class TestMetastore:
+    def test_hive_site_mysql(self):
+        site = render_hive_site("mysql", "10.0.0.5", 3306)
+        assert "jdbc:mysql://10.0.0.5:3306/metastore" in site
+        assert "com.mysql.cj.jdbc.Driver" in site
+
+    def test_hive_site_postgres(self):
+        site = render_hive_site("postgres", "10.0.0.5", 5432)
+        assert "jdbc:postgresql://10.0.0.5:5432/metastore" in site
+
+    def test_discovers_db_from_registry(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HOME", str(tmp_path))
+        state = StateClient(InMemoryStateBackend())
+        reg = ServiceRegistry(state, cluster="c1", workspace="w1")
+        reg.register("mysql", "n-0", "10.0.0.7", 3306)
+        rt = MetastoreRuntime({})
+        ctx = {"is_head": True, "head_ip": "10.0.0.1",
+               "node_id": "head", "state_client": state,
+               "config": {"cluster_name": "c1", "workspace_name": "w1"},
+               "conf_dir": str(tmp_path / "metastore")}
+        rt.node_configure(ctx)
+        site = (tmp_path / "metastore" / "hive-site.xml").read_text()
+        assert "10.0.0.7:3306" in site
+
+
+class TestMinIO:
+    def test_distributed_volumes(self):
+        env = render_minio_env(PEERS)
+        assert ("http://10.0.0.1:9000~/.tik/minio/data "
+                "http://10.0.0.2:9000~/.tik/minio/data") in env
+
+    def test_single_node(self):
+        env = render_minio_env(PEERS[:1])
+        assert "http://" not in env.split("MINIO_VOLUMES")[1].split("\n")[0]
+
+
+class TestConsul:
+    def test_server_and_agent(self):
+        server = json.loads(render_consul_config(
+            "head", "10.0.0.1", True, ["10.0.0.1"], bootstrap_expect=1))
+        assert server["server"] is True
+        agent = json.loads(render_consul_config(
+            "n-1", "10.0.0.2", False, ["10.0.0.1"]))
+        assert "server" not in agent
+        assert agent["retry_join"] == ["10.0.0.1"]
+
+    def test_service_registrations(self):
+        docs = json.loads(render_service_registrations(
+            {"mysql": {"port": 3306, "tags": {"role": "source"}}},
+            "10.0.0.2"))
+        assert docs["services"][0]["name"] == "mysql"
+        assert docs["services"][0]["checks"][0]["tcp"] == "10.0.0.2:3306"
+
+
+class TestNodeConfigureEndToEnd:
+    """Drive node_configure for quorum runtimes through the nodes table."""
+
+    def _context(self, tmp_path, node_id, is_head=False):
+        state = StateClient(InMemoryStateBackend())
+        for i in range(3):
+            state.table_put("nodes", f"n-{i}",
+                            {"ip": f"10.0.0.{i + 1}", "kind": "worker"})
+        return {"is_head": is_head, "head_ip": "10.0.0.100",
+                "node_id": node_id, "state_client": state,
+                "config": {"cluster_name": "c1", "workspace_name": "w1",
+                           "runtime": {"types": []}},
+                "conf_dir": str(tmp_path / node_id)}
+
+    def test_etcd_node_configure(self, tmp_path):
+        rt = EtcdRuntime({})
+        ctx = self._context(tmp_path, "n-1")
+        rt.node_configure(ctx)
+        cfg = yaml.safe_load(
+            (tmp_path / "n-1" / "etcd.yaml").read_text())
+        assert cfg["name"] == "n-1"
+        assert cfg["initial-cluster"].count("=") == 3
+
+    def test_kafka_node_configure_kraft(self, tmp_path):
+        rt = KafkaRuntime({})
+        ctx = self._context(tmp_path, "n-2")
+        rt.node_configure(ctx)
+        props = (tmp_path / "n-2" / "server.properties").read_text()
+        assert "node.id=3" in props
+        assert "controller.quorum.voters" in props
