@@ -1,0 +1,291 @@
+"""BERT encoder — masked-LM pretraining + classification fine-tune heads.
+
+Reference parity: applications/ai/quickstart bert-large recipes (SURVEY.md
+§2.8 — torch-DDP pretrain phase1/2 + SQuAD fine-tune; BASELINE config
+"BERT-Large SQuAD 8-host DP").  TPU-first: same functional/scan/logical-
+axis design as models/transformer.py, but bidirectional attention
+(causal=False), learned positions, post-LN GELU blocks, and a pooled
+classification path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cloudtik_tpu.ops.attention import attention
+from cloudtik_tpu.parallel.sharding import with_sharding_constraint
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30_522
+    d_model: int = 1024
+    n_layers: int = 24
+    n_heads: int = 16
+    d_ff: int = 4096
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    num_labels: int = 0          # >0 adds a classification head
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def num_params(self) -> int:
+        d, f, L = self.d_model, self.d_ff, self.n_layers
+        per_layer = 4 * d * d + 2 * d * f + 9 * d  # qkv+o, ffn, norms+bias
+        embed = (self.vocab_size + self.max_seq_len
+                 + self.type_vocab_size) * d
+        return L * per_layer + embed + 2 * d
+
+    def flops_per_token(self) -> float:
+        n = self.num_params() - self.vocab_size * self.d_model
+        attn = 12 * self.n_layers * self.d_model * self.max_seq_len
+        return 6 * n + attn
+
+
+PRESETS: Dict[str, BertConfig] = {
+    "bert_large": BertConfig(),
+    "bert_base": BertConfig(d_model=768, n_layers=12, n_heads=12,
+                            d_ff=3072),
+    "tiny": BertConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                       d_ff=128, max_seq_len=128, remat=False),
+}
+
+
+def config(name: str, **overrides) -> BertConfig:
+    return dataclasses.replace(PRESETS[name], **overrides)
+
+
+# --------------------------------------------------------------------------
+# Parameters
+# --------------------------------------------------------------------------
+
+def param_logical_axes(cfg: BertConfig) -> Params:
+    layers = {
+        "wq": ("layers", "embed", "heads", "kv"),
+        "wk": ("layers", "embed", "heads", "kv"),
+        "wv": ("layers", "embed", "heads", "kv"),
+        "wo": ("layers", "heads", "kv", "embed"),
+        "bq": ("layers", "heads", "kv"),
+        "bk": ("layers", "heads", "kv"),
+        "bv": ("layers", "heads", "kv"),
+        "bo": ("layers", "norm"),
+        "ln1_scale": ("layers", "norm"),
+        "ln1_bias": ("layers", "norm"),
+        "w_ff1": ("layers", "embed", "mlp"),
+        "b_ff1": ("layers", "mlp"),
+        "w_ff2": ("layers", "mlp", "embed"),
+        "b_ff2": ("layers", "norm"),
+        "ln2_scale": ("layers", "norm"),
+        "ln2_bias": ("layers", "norm"),
+    }
+    axes: Params = {
+        "embed": ("vocab", "embed"),
+        "pos_embed": (None, "embed"),
+        "type_embed": (None, "embed"),
+        "embed_ln_scale": ("norm",),
+        "embed_ln_bias": ("norm",),
+        "layers": layers,
+        "mlm_dense": ("embed", "embed"),
+        "mlm_bias": ("norm",),
+        "mlm_ln_scale": ("norm",),
+        "mlm_ln_bias": ("norm",),
+        "mlm_out_bias": ("vocab",),
+    }
+    if cfg.num_labels:
+        axes["pooler"] = ("embed", "embed")
+        axes["pooler_bias"] = ("norm",)
+        axes["cls"] = ("embed", None)
+        axes["cls_bias"] = (None,)
+    return axes
+
+
+def init_params(rng: jax.Array, cfg: BertConfig) -> Params:
+    d, f, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    H, Dh = cfg.n_heads, cfg.head_dim
+    pdt = cfg.param_dtype
+    ks = jax.random.split(rng, 16)
+
+    def dense(key, shape):
+        # BERT's original init: N(0, 0.02) truncated, not fan-in scaled.
+        return (jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+                * 0.02).astype(pdt)
+
+    layers = {
+        "wq": dense(ks[0], (L, d, H, Dh)),
+        "wk": dense(ks[1], (L, d, H, Dh)),
+        "wv": dense(ks[2], (L, d, H, Dh)),
+        "wo": dense(ks[3], (L, H, Dh, d)),
+        "bq": jnp.zeros((L, H, Dh), pdt),
+        "bk": jnp.zeros((L, H, Dh), pdt),
+        "bv": jnp.zeros((L, H, Dh), pdt),
+        "bo": jnp.zeros((L, d), pdt),
+        "ln1_scale": jnp.ones((L, d), pdt),
+        "ln1_bias": jnp.zeros((L, d), pdt),
+        "w_ff1": dense(ks[4], (L, d, f)),
+        "b_ff1": jnp.zeros((L, f), pdt),
+        "w_ff2": dense(ks[5], (L, f, d)),
+        "b_ff2": jnp.zeros((L, d), pdt),
+        "ln2_scale": jnp.ones((L, d), pdt),
+        "ln2_bias": jnp.zeros((L, d), pdt),
+    }
+    params: Params = {
+        "embed": dense(ks[6], (cfg.vocab_size, d)),
+        "pos_embed": dense(ks[7], (cfg.max_seq_len, d)),
+        "type_embed": dense(ks[8], (cfg.type_vocab_size, d)),
+        "embed_ln_scale": jnp.ones((d,), pdt),
+        "embed_ln_bias": jnp.zeros((d,), pdt),
+        "layers": layers,
+        "mlm_dense": dense(ks[9], (d, d)),
+        "mlm_bias": jnp.zeros((d,), pdt),
+        "mlm_ln_scale": jnp.ones((d,), pdt),
+        "mlm_ln_bias": jnp.zeros((d,), pdt),
+        "mlm_out_bias": jnp.zeros((cfg.vocab_size,), pdt),
+    }
+    if cfg.num_labels:
+        params["pooler"] = dense(ks[10], (d, d))
+        params["pooler_bias"] = jnp.zeros((d,), pdt)
+        params["cls"] = dense(ks[11], (d, cfg.num_labels))
+        params["cls_bias"] = jnp.zeros((cfg.num_labels,), pdt)
+    return params
+
+
+# --------------------------------------------------------------------------
+# Forward
+# --------------------------------------------------------------------------
+
+def _layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    out = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (out * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _layer(cfg: BertConfig, x: jax.Array, p: Params) -> jax.Array:
+    dt = cfg.dtype
+    q = jnp.einsum("bsd,dhk->bshk", x, p["wq"].astype(dt)) \
+        + p["bq"].astype(dt)
+    k = jnp.einsum("bsd,dhk->bshk", x, p["wk"].astype(dt)) \
+        + p["bk"].astype(dt)
+    v = jnp.einsum("bsd,dhk->bshk", x, p["wv"].astype(dt)) \
+        + p["bv"].astype(dt)
+    q = with_sharding_constraint(q, "batch", "seq", "heads", None)
+    o = attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                  v.transpose(0, 2, 1, 3), causal=False)
+    o = o.transpose(0, 2, 1, 3)
+    attn = jnp.einsum("bshk,hkd->bsd", o, p["wo"].astype(dt)) \
+        + p["bo"].astype(dt)
+    x = _layer_norm(x + attn, p["ln1_scale"], p["ln1_bias"], cfg.norm_eps)
+    h = jnp.einsum("bsd,df->bsf", x, p["w_ff1"].astype(dt)) \
+        + p["b_ff1"].astype(dt)
+    h = jax.nn.gelu(h, approximate=True)
+    h = with_sharding_constraint(h, "batch", "seq", "mlp")
+    h = jnp.einsum("bsf,fd->bsd", h, p["w_ff2"].astype(dt)) \
+        + p["b_ff2"].astype(dt)
+    x = _layer_norm(x + h, p["ln2_scale"], p["ln2_bias"], cfg.norm_eps)
+    return with_sharding_constraint(x, "batch", "seq", None)
+
+
+def encode(params: Params, tokens: jax.Array, cfg: BertConfig,
+           type_ids: Optional[jax.Array] = None) -> jax.Array:
+    """tokens [B,S] -> hidden [B,S,d] (cfg.dtype)."""
+    B, S = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)
+    x = x + params["pos_embed"][:S][None]
+    if type_ids is not None:
+        x = x + jnp.take(params["type_embed"], type_ids, axis=0)
+    else:
+        x = x + params["type_embed"][0][None, None]
+    x = _layer_norm(x.astype(cfg.dtype), params["embed_ln_scale"],
+                    params["embed_ln_bias"], cfg.norm_eps)
+    x = with_sharding_constraint(x, "batch", "seq", None)
+
+    layer_fn = functools.partial(_layer, cfg)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(
+            layer_fn,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+
+    def body(carry, layer_params):
+        return layer_fn(carry, layer_params), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return x
+
+
+def mlm_logits(params: Params, hidden: jax.Array,
+               cfg: BertConfig) -> jax.Array:
+    """Masked-LM head with tied output embedding: [B,S,d] -> [B,S,V]."""
+    h = hidden.astype(jnp.float32) @ params["mlm_dense"].astype(jnp.float32)
+    h = jax.nn.gelu(h, approximate=True)
+    h = _layer_norm(h, params["mlm_ln_scale"], params["mlm_ln_bias"],
+                    cfg.norm_eps)
+    return h @ params["embed"].astype(jnp.float32).T \
+        + params["mlm_out_bias"].astype(jnp.float32)
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array],
+            cfg: BertConfig) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """MLM objective.
+
+    Preferred batch layout (TPU-efficient, BERT's original shape): tokens
+    [B,S], mlm_positions [B,P], mlm_labels [B,P] (-100 pads) — the vocab
+    projection runs only on the P gathered positions (~15% of S), saving
+    ~6x head FLOPs and the [B,S,V] f32 activation.  Fallback layout:
+    labels [B,S] with -100 at unmasked positions (projects every
+    position).
+    """
+    hidden = encode(params, batch["tokens"], cfg, batch.get("type_ids"))
+    if "mlm_positions" in batch:
+        positions = batch["mlm_positions"]                 # [B, P]
+        labels = batch["mlm_labels"]                       # [B, P]
+        hidden = jnp.take_along_axis(
+            hidden, positions[..., None], axis=1)          # [B, P, d]
+    else:
+        labels = batch["labels"]
+    logits = mlm_logits(params, hidden, cfg)
+    valid = labels != -100
+    safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    token_logp = jnp.take_along_axis(logp, safe[..., None], -1)[..., 0]
+    n_valid = jnp.maximum(valid.sum(), 1)
+    loss = -(token_logp * valid).sum() / n_valid
+    return loss, {
+        "loss": loss,
+        "mlm_accuracy":
+            ((logits.argmax(-1) == labels) & valid).sum() / n_valid,
+    }
+
+
+def classify_loss_fn(params: Params, batch: Dict[str, jax.Array],
+                     cfg: BertConfig
+                     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Sequence classification (fine-tune path; requires num_labels>0).
+    batch: tokens [B,S], labels [B]."""
+    hidden = encode(params, batch["tokens"], cfg, batch.get("type_ids"))
+    pooled = jnp.tanh(hidden[:, 0].astype(jnp.float32)
+                      @ params["pooler"].astype(jnp.float32)
+                      + params["pooler_bias"].astype(jnp.float32))
+    logits = pooled @ params["cls"].astype(jnp.float32) \
+        + params["cls_bias"].astype(jnp.float32)
+    labels = batch["labels"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    loss = -jnp.take_along_axis(logp, labels[:, None], -1).mean()
+    return loss, {
+        "loss": loss,
+        "accuracy": (logits.argmax(-1) == labels).mean(),
+    }

@@ -1,0 +1,179 @@
+"""DLRM — deep learning recommendation model with sharded embeddings.
+
+Reference parity: applications/ai/quickstart dlrm recipes (SURVEY.md §2.8;
+BASELINE config "DLRM Criteo-1TB Spark->SparseCore").  TPU-first design:
+  * The sparse path is a single stacked embedding tensor [T, rows, dim]
+    with logical axes ("expert", "vocab", "embed") — sharding the row axis
+    over the mesh gives the SparseCore-style distributed embedding layout,
+    and XLA derives the all-to-all from the gather's sharding (no
+    hand-written alltoall, mirroring how GSPMD handles MoE dispatch).
+  * Same-size tables are stacked so one gather serves all features
+    (static shapes, MXU-friendly downstream interaction).
+  * Dense path: bottom MLP -> pairwise dot interaction -> top MLP, all
+    bf16 matmuls with f32 accumulation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cloudtik_tpu.parallel.sharding import with_sharding_constraint
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class DLRMConfig:
+    num_tables: int = 26                  # criteo sparse features
+    rows_per_table: int = 100_000         # hashed vocabulary per feature
+    embed_dim: int = 128
+    num_dense: int = 13                   # criteo dense features
+    bottom_mlp: Tuple[int, ...] = (512, 256, 128)
+    top_mlp: Tuple[int, ...] = (1024, 1024, 512, 256, 1)
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    def num_params(self) -> int:
+        n = self.num_tables * self.rows_per_table * self.embed_dim
+        d_in = self.num_dense
+        for d_out in self.bottom_mlp:
+            n += d_in * d_out + d_out
+            d_in = d_out
+        d_in = self.interaction_dim()
+        for d_out in self.top_mlp:
+            n += d_in * d_out + d_out
+            d_in = d_out
+        return n
+
+    def interaction_dim(self) -> int:
+        f = self.num_tables + 1           # sparse features + dense vector
+        return self.bottom_mlp[-1] + (f * (f - 1)) // 2
+
+    def flops_per_example(self) -> float:
+        """fwd+bwd (3x fwd) MLP FLOPs; embedding gathers are
+        bandwidth-bound and excluded (standard DLRM accounting)."""
+        flops = 0.0
+        d_in = self.num_dense
+        for d_out in self.bottom_mlp:
+            flops += 2 * d_in * d_out
+            d_in = d_out
+        f = self.num_tables + 1
+        flops += 2 * f * f * self.embed_dim       # interaction matmul
+        d_in = self.interaction_dim()
+        for d_out in self.top_mlp:
+            flops += 2 * d_in * d_out
+            d_in = d_out
+        return 3.0 * flops
+
+
+PRESETS: Dict[str, DLRMConfig] = {
+    "criteo_terabyte": DLRMConfig(),
+    "tiny": DLRMConfig(num_tables=4, rows_per_table=100, embed_dim=16,
+                       num_dense=4, bottom_mlp=(32, 16),
+                       top_mlp=(32, 16, 1)),
+}
+
+
+def config(name: str, **overrides) -> DLRMConfig:
+    return dataclasses.replace(PRESETS[name], **overrides)
+
+
+def param_logical_axes(cfg: DLRMConfig) -> Params:
+    def mlp_axes(n):
+        return [{"kernel": ("embed", "mlp"), "bias": ("mlp",)}
+                for _ in range(n)]
+
+    return {
+        # row axis sharded over the mesh = distributed embedding shards
+        "embeddings": ("expert", "vocab", "embed"),
+        "bottom": mlp_axes(len(cfg.bottom_mlp)),
+        "top": mlp_axes(len(cfg.top_mlp)),
+    }
+
+
+def init_params(rng: jax.Array, cfg: DLRMConfig) -> Params:
+    pdt = cfg.param_dtype
+    k_embed, k_bottom, k_top = jax.random.split(rng, 3)
+
+    def mlp(key, d_in, widths):
+        out = []
+        for i, d_out in enumerate(widths):
+            k = jax.random.fold_in(key, i)
+            out.append({
+                "kernel": (jax.random.truncated_normal(
+                    k, -2, 2, (d_in, d_out), jnp.float32)
+                    * (2.0 / d_in) ** 0.5).astype(pdt),
+                "bias": jnp.zeros((d_out,), pdt),
+            })
+            d_in = d_out
+        return out
+
+    return {
+        "embeddings": (jax.random.truncated_normal(
+            k_embed, -2, 2,
+            (cfg.num_tables, cfg.rows_per_table, cfg.embed_dim),
+            jnp.float32) * cfg.embed_dim ** -0.5).astype(pdt),
+        "bottom": mlp(k_bottom, cfg.num_dense, cfg.bottom_mlp),
+        "top": mlp(k_top, cfg.interaction_dim(), cfg.top_mlp),
+    }
+
+
+def _mlp(x: jax.Array, layers, dtype, final_linear: bool) -> jax.Array:
+    for i, layer in enumerate(layers):
+        x = x @ layer["kernel"].astype(dtype) + layer["bias"].astype(dtype)
+        if not (final_linear and i == len(layers) - 1):
+            x = jax.nn.relu(x)
+    return x
+
+
+def forward(params: Params, dense: jax.Array, sparse_ids: jax.Array,
+            cfg: DLRMConfig) -> jax.Array:
+    """dense [B, num_dense] f32; sparse_ids [B, T] int32 -> logits [B]."""
+    dt = cfg.dtype
+    d = _mlp(dense.astype(dt), params["bottom"], dt, final_linear=False)
+    d = with_sharding_constraint(d, "batch", None)
+
+    # One gather over the stacked tables: [T, R, D][t, ids[b,t]] -> [B,T,D].
+    tables = params["embeddings"].astype(dt)
+    e = _gather_embed(tables, sparse_ids)
+    e = with_sharding_constraint(e, "batch", None, None)
+
+    # Pairwise dot interaction over [dense + T] feature vectors.
+    feats = jnp.concatenate([d[:, None, :], e], axis=1)   # [B, F, D]
+    inter = jnp.einsum("bfd,bgd->bfg", feats, feats)      # [B, F, F]
+    F = feats.shape[1]
+    iu, ju = jnp.triu_indices(F, k=1)
+    inter_flat = inter[:, iu, ju]                          # [B, F(F-1)/2]
+
+    top_in = jnp.concatenate([d, inter_flat.astype(dt)], axis=-1)
+    out = _mlp(top_in, params["top"], dt, final_linear=True)
+    return out[..., 0].astype(jnp.float32)
+
+
+def _gather_embed(tables: jax.Array, sparse_ids: jax.Array) -> jax.Array:
+    """[T,R,D] gather at per-table ids [B,T] -> [B,T,D].  take_along_axis
+    keeps a static-shaped gather XLA shards over the row axis."""
+    B, T = sparse_ids.shape
+    ids = sparse_ids.T[:, :, None]                         # [T, B, 1]
+    picked = jnp.take_along_axis(tables, ids, axis=1)      # [T, B, D]
+    return picked.transpose(1, 0, 2)
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array],
+            cfg: DLRMConfig) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Click prediction.  batch: dense [B,num_dense], sparse_ids [B,T],
+    labels [B] in {0,1}."""
+    logits = forward(params, batch["dense"], batch["sparse_ids"], cfg)
+    labels = batch["labels"].astype(jnp.float32)
+    loss = jnp.mean(
+        jnp.maximum(logits, 0) - logits * labels
+        + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+    preds = (logits > 0).astype(jnp.float32)
+    return loss, {
+        "loss": loss,
+        "accuracy": (preds == labels).mean(),
+    }

@@ -1,0 +1,231 @@
+"""ResNet (v1.5) image classifier — the ImageNet baseline config.
+
+Reference parity: applications/ai/quickstart resnet50 recipes (SURVEY.md
+§2.8 — torch model zoo driven by DDP); here a native JAX/XLA program:
+  * NHWC layout + bf16 compute — XLA tiles convs straight onto the MXU.
+  * Functional params pytree with logical axes ("conv_in"/"conv_out"
+    sharded over the tensor axis under TP; batch over data/fsdp).
+  * Per-batch normalization statistics at train time (the functional
+    equivalent of BatchNorm train mode); inference uses provided
+    moving stats.
+  * Blocks are unrolled Python loops (16 blocks — compile time is fine,
+    and the stage shapes differ so a scan would force padding).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cloudtik_tpu.ops.conv import (
+    conv_kernel_axes, conv_kernel_init, conv_nhwc)
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    image_size: int = 224
+    stage_blocks: Tuple[int, ...] = (3, 4, 6, 3)     # resnet50
+    stage_widths: Tuple[int, ...] = (256, 512, 1024, 2048)
+    stem_width: int = 64
+    bottleneck: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    norm_eps: float = 1e-5
+
+    def flops_per_image(self) -> float:
+        """Approximate fwd+bwd FLOPs per image (3x forward), computed from
+        the conv shapes analytically."""
+        return 3.0 * _forward_flops(self)
+
+
+PRESETS: Dict[str, ResNetConfig] = {
+    "resnet50": ResNetConfig(),
+    "resnet18": ResNetConfig(stage_blocks=(2, 2, 2, 2),
+                             stage_widths=(64, 128, 256, 512),
+                             bottleneck=False),
+    "tiny": ResNetConfig(num_classes=10, image_size=32,
+                         stage_blocks=(1, 1), stage_widths=(64, 128),
+                         stem_width=16),
+}
+
+
+def config(name: str, **overrides) -> ResNetConfig:
+    return dataclasses.replace(PRESETS[name], **overrides)
+
+
+def _forward_flops(cfg: ResNetConfig) -> float:
+    """2 * MACs of every conv + the fc, at the config's image size."""
+    flops = 0.0
+    size = cfg.image_size // 2                       # stem stride 2
+    flops += 2 * (7 * 7 * 3 * cfg.stem_width) * size * size
+    size //= 2                                       # maxpool
+    c_in = cfg.stem_width
+    for stage, (n_blocks, width) in enumerate(
+            zip(cfg.stage_blocks, cfg.stage_widths)):
+        stride = 1 if stage == 0 else 2
+        for block in range(n_blocks):
+            s = stride if block == 0 else 1
+            out_size = size // s
+            if cfg.bottleneck:
+                mid = width // 4
+                flops += 2 * (c_in * mid) * out_size ** 2            # 1x1
+                flops += 2 * (9 * mid * mid) * out_size ** 2         # 3x3
+                flops += 2 * (mid * width) * out_size ** 2           # 1x1
+            else:
+                flops += 2 * (9 * c_in * width) * out_size ** 2
+                flops += 2 * (9 * width * width) * out_size ** 2
+            if block == 0:
+                flops += 2 * (c_in * width) * out_size ** 2          # proj
+            c_in = width
+            size = out_size
+    flops += 2 * c_in * cfg.num_classes
+    return flops
+
+
+# --------------------------------------------------------------------------
+# Parameters
+# --------------------------------------------------------------------------
+
+def _block_axes(bottleneck: bool) -> Dict[str, Any]:
+    n_convs = 3 if bottleneck else 2
+    axes: Dict[str, Any] = {}
+    for i in range(n_convs):
+        axes[f"conv{i}"] = conv_kernel_axes()
+        axes[f"scale{i}"] = ("norm",)
+        axes[f"bias{i}"] = ("norm",)
+    return axes
+
+
+def param_logical_axes(cfg: ResNetConfig) -> Params:
+    axes: Dict[str, Any] = {
+        "stem": {"conv": conv_kernel_axes(), "scale": ("norm",),
+                 "bias": ("norm",)},
+        "fc": {"kernel": ("embed", "vocab"), "bias": ("vocab",)},
+    }
+    for stage, n_blocks in enumerate(cfg.stage_blocks):
+        blocks = []
+        for block in range(n_blocks):
+            b = _block_axes(cfg.bottleneck)
+            if block == 0:
+                b["proj"] = conv_kernel_axes()
+                b["proj_scale"] = ("norm",)
+                b["proj_bias"] = ("norm",)
+            blocks.append(b)
+        axes[f"stage{stage}"] = blocks
+    return axes
+
+
+def init_params(rng: jax.Array, cfg: ResNetConfig) -> Params:
+    pdt = cfg.param_dtype
+    keys = iter(jax.random.split(rng, 256))
+
+    def norm_pair(c):
+        return jnp.ones((c,), pdt), jnp.zeros((c,), pdt)
+
+    scale, bias = norm_pair(cfg.stem_width)
+    params: Params = {
+        "stem": {"conv": conv_kernel_init(next(keys), 7, 7, 3, cfg.stem_width,
+                                    pdt),
+                 "scale": scale, "bias": bias},
+    }
+    c_in = cfg.stem_width
+    for stage, (n_blocks, width) in enumerate(
+            zip(cfg.stage_blocks, cfg.stage_widths)):
+        blocks: List[Params] = []
+        for block in range(n_blocks):
+            b: Params = {}
+            if cfg.bottleneck:
+                mid = width // 4
+                shapes = [(1, 1, c_in, mid), (3, 3, mid, mid),
+                          (1, 1, mid, width)]
+            else:
+                shapes = [(3, 3, c_in, width), (3, 3, width, width)]
+            for i, (kh, kw, ci, co) in enumerate(shapes):
+                b[f"conv{i}"] = conv_kernel_init(next(keys), kh, kw, ci, co, pdt)
+                b[f"scale{i}"], b[f"bias{i}"] = norm_pair(co)
+            if block == 0:
+                b["proj"] = conv_kernel_init(next(keys), 1, 1, c_in, width, pdt)
+                b["proj_scale"], b["proj_bias"] = norm_pair(width)
+            blocks.append(b)
+            c_in = width
+        params[f"stage{stage}"] = blocks
+    params["fc"] = {
+        "kernel": (jax.random.truncated_normal(
+            next(keys), -2, 2, (c_in, cfg.num_classes), jnp.float32)
+            * c_in ** -0.5).astype(pdt),
+        "bias": jnp.zeros((cfg.num_classes,), pdt),
+    }
+    return params
+
+
+# --------------------------------------------------------------------------
+# Forward
+# --------------------------------------------------------------------------
+
+def _batch_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
+                eps: float) -> jax.Array:
+    """Per-batch statistics over (N, H, W) in f32 (train-mode BN)."""
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(axis=(0, 1, 2), keepdims=True)
+    var = x32.var(axis=(0, 1, 2), keepdims=True)
+    normed = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    out = normed * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def _block(x: jax.Array, b: Params, cfg: ResNetConfig,
+           stride: int) -> jax.Array:
+    shortcut = x
+    n_convs = 3 if cfg.bottleneck else 2
+    h = x
+    for i in range(n_convs):
+        # v1.5: the stride lives on the 3x3 conv
+        s = stride if (i == (1 if cfg.bottleneck else 0)) else 1
+        h = conv_nhwc(h, b[f"conv{i}"], stride=s, dtype=cfg.dtype)
+        h = _batch_norm(h, b[f"scale{i}"], b[f"bias{i}"], cfg.norm_eps)
+        if i < n_convs - 1:
+            h = jax.nn.relu(h)
+    if "proj" in b:
+        shortcut = conv_nhwc(shortcut, b["proj"], stride=stride,
+                         dtype=cfg.dtype)
+        shortcut = _batch_norm(shortcut, b["proj_scale"], b["proj_bias"],
+                               cfg.norm_eps)
+    return jax.nn.relu(h + shortcut)
+
+
+def forward(params: Params, images: jax.Array,
+            cfg: ResNetConfig) -> jax.Array:
+    """images [B, H, W, 3] -> logits [B, num_classes] (f32)."""
+    x = conv_nhwc(images, params["stem"]["conv"], stride=2, dtype=cfg.dtype)
+    x = _batch_norm(x, params["stem"]["scale"], params["stem"]["bias"],
+                    cfg.norm_eps)
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+    for stage in range(len(cfg.stage_blocks)):
+        stride = 1 if stage == 0 else 2
+        for block, b in enumerate(params[f"stage{stage}"]):
+            x = _block(x, b, cfg, stride if block == 0 else 1)
+    x = x.mean(axis=(1, 2)).astype(jnp.float32)       # global avg pool
+    fc = params["fc"]
+    return x @ fc["kernel"].astype(jnp.float32) \
+        + fc["bias"].astype(jnp.float32)
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array],
+            cfg: ResNetConfig) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """batch: images [B,H,W,3] f32, labels [B] int32."""
+    logits = forward(params, batch["images"], cfg)
+    labels = batch["labels"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    loss = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+    return loss, {
+        "loss": loss,
+        "accuracy": (logits.argmax(-1) == labels).mean(),
+    }

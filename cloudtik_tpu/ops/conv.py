@@ -1,0 +1,36 @@
+"""Shared NHWC convolution helpers for the vision models.
+
+One conv path for resnet/diffusion: NHWC layout + HWIO kernels so XLA
+tiles straight onto the MXU; He-init scaled by kernel fan-in; logical
+kernel axes (conv_in -> fsdp rows, conv_out -> tensor cols).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+KERNEL_AXES: Tuple[None, None, str, str] = (None, None, "conv_in",
+                                            "conv_out")
+
+
+def conv_kernel_axes() -> Tuple[None, None, str, str]:
+    return KERNEL_AXES
+
+
+def conv_nhwc(x: jax.Array, kernel: jax.Array, stride: int = 1,
+              dtype=jnp.bfloat16) -> jax.Array:
+    return jax.lax.conv_general_dilated(
+        x.astype(dtype), kernel.astype(dtype),
+        window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def conv_kernel_init(key, kh: int, kw: int, c_in: int, c_out: int,
+                     param_dtype) -> jax.Array:
+    fan_in = kh * kw * c_in
+    return (jax.random.truncated_normal(
+        key, -2, 2, (kh, kw, c_in, c_out), jnp.float32)
+        * (2.0 / fan_in) ** 0.5).astype(param_dtype)

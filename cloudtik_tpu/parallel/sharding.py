@@ -32,6 +32,8 @@ DEFAULT_RULES: AxisRules = (
     ("expert", "expert"),
     ("layers", None),           # scanned layer stack axis stays replicated
     ("norm", None),
+    ("conv_in", "fsdp"),        # conv kernels: rows FSDP, cols TP
+    ("conv_out", "tensor"),
 )
 
 

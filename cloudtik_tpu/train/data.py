@@ -49,6 +49,77 @@ def synthetic_image_batches(
         }
 
 
+def synthetic_dlrm_batches(
+    batch_size: int,
+    num_dense: int,
+    num_tables: int,
+    rows_per_table: int,
+    seed: int = 0,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Synthetic click-prediction batches (dense features + sparse ids)."""
+    rng = np.random.default_rng(seed)
+    while True:
+        yield {
+            "dense": rng.standard_normal(
+                (batch_size, num_dense)).astype(np.float32),
+            "sparse_ids": rng.integers(
+                0, rows_per_table, (batch_size, num_tables),
+                dtype=np.int32),
+            "labels": rng.integers(0, 2, (batch_size,), dtype=np.int32),
+        }
+
+
+def synthetic_diffusion_batches(
+    batch_size: int,
+    image_size: int,
+    channels: int,
+    seed: int = 0,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Synthetic latent-diffusion batches (latents + noise + timestep)."""
+    rng = np.random.default_rng(seed)
+    while True:
+        yield {
+            "latents": rng.standard_normal(
+                (batch_size, image_size, image_size, channels)
+            ).astype(np.float32),
+            "noise": rng.standard_normal(
+                (batch_size, image_size, image_size, channels)
+            ).astype(np.float32),
+            "t": rng.uniform(0, 1, (batch_size,)).astype(np.float32),
+        }
+
+
+def synthetic_mlm_batches(
+    batch_size: int,
+    seq_len: int,
+    vocab_size: int,
+    mask_prob: float = 0.15,
+    mask_token: int = 1,
+    max_predictions: Optional[int] = None,
+    seed: int = 0,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Synthetic masked-LM batches (BERT objective).
+
+    Emits the gathered layout (mlm_positions/mlm_labels, P =
+    max_predictions) so the vocab projection runs only on masked
+    positions; P defaults to ceil(mask_prob * seq_len).
+    """
+    rng = np.random.default_rng(seed)
+    P = max_predictions or max(int(np.ceil(mask_prob * seq_len)), 1)
+    while True:
+        tokens = rng.integers(
+            2, vocab_size, (batch_size, seq_len), dtype=np.int32)
+        positions = np.stack([
+            rng.choice(seq_len, size=P, replace=False)
+            for _ in range(batch_size)]).astype(np.int32)
+        labels = np.take_along_axis(tokens, positions, axis=1)
+        masked = tokens.copy()
+        np.put_along_axis(masked, positions, mask_token, axis=1)
+        yield {"tokens": masked,
+               "mlm_positions": positions,
+               "mlm_labels": labels.astype(np.int32)}
+
+
 def global_batches(
     local_iter: Iterator[Dict[str, np.ndarray]],
     sharding: NamedSharding,

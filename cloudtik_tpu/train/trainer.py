@@ -84,6 +84,40 @@ def resnet_spec(cfg) -> ModelSpec:
     )
 
 
+def bert_spec(cfg, objective: str = "mlm") -> ModelSpec:
+    from cloudtik_tpu.models import bert as B
+
+    loss = B.loss_fn if objective == "mlm" else B.classify_loss_fn
+    return ModelSpec(
+        init=lambda rng: B.init_params(rng, cfg),
+        loss_fn=lambda params, batch: loss(params, batch, cfg),
+        logical_axes=B.param_logical_axes(cfg),
+        flops_per_token=cfg.flops_per_token(),
+    )
+
+
+def dlrm_spec(cfg) -> ModelSpec:
+    from cloudtik_tpu.models import dlrm as D
+
+    return ModelSpec(
+        init=lambda rng: D.init_params(rng, cfg),
+        loss_fn=lambda params, batch: D.loss_fn(params, batch, cfg),
+        logical_axes=D.param_logical_axes(cfg),
+        flops_per_token=cfg.flops_per_example(),
+    )
+
+
+def diffusion_spec(cfg) -> ModelSpec:
+    from cloudtik_tpu.models import diffusion as U
+
+    return ModelSpec(
+        init=lambda rng: U.init_params(rng, cfg),
+        loss_fn=lambda params, batch: U.loss_fn(params, batch, cfg),
+        logical_axes=U.param_logical_axes(cfg),
+        flops_per_token=cfg.flops_per_image(),
+    )
+
+
 @dataclasses.dataclass
 class TrainerConfig:
     global_batch_size: int = 8

@@ -1,0 +1,30 @@
+"""BERT-Large MLM pretraining recipe (BASELINE north star + config #2).
+
+Reference recipe: applications/ai/quickstart/bin/bert-large/
+train-distributed.sh (DDP phase-1 pretrain over cloudtik-run, gloo/oneCCL
+backend).  Here the 8-host data-parallel run is just --data 8 on the mesh;
+MFU is reported by the trainer (north star: >=45% on v5p-32).
+"""
+
+from cloudtik_tpu.models import bert as B
+from cloudtik_tpu.train.data import synthetic_mlm_batches
+from cloudtik_tpu.train.trainer import bert_spec
+
+from common import build_recipe_trainer, recipe_argparser, run_and_report
+
+
+def main():
+    p = recipe_argparser("bert-large")
+    p.add_argument("--model", default="bert_large")
+    p.add_argument("--seq-len", type=int, default=512)
+    args = p.parse_args()
+
+    cfg = B.config(args.model, max_seq_len=args.seq_len)
+    trainer = build_recipe_trainer(bert_spec(cfg), args,
+                                   seq_len=args.seq_len)
+    data = synthetic_mlm_batches(args.batch, args.seq_len, cfg.vocab_size)
+    run_and_report(trainer, data, args.steps, args.batch, "seq")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Shared recipe harness: arg parsing, mesh setup, throughput report.
+
+These recipes are the TPU-native equivalents of the reference's
+applications/ai/quickstart/bin/* shell recipes (SURVEY.md §2.8): instead of
+`cloudtik-run` spawning torch-DDP processes, each recipe builds a mesh and
+runs the sharded Trainer; multi-host launch is `tik-run recipe.py` (every
+TPU host runs the same SPMD program).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Any, Dict, Optional
+
+from cloudtik_tpu.parallel.mesh import MeshConfig, build_mesh
+from cloudtik_tpu.train.trainer import Trainer, TrainerConfig
+
+
+def recipe_argparser(name: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(name)
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--data", type=int, default=1, help="data mesh axis")
+    p.add_argument("--fsdp", type=int, default=1)
+    p.add_argument("--tensor", type=int, default=1)
+    p.add_argument("--seq", type=int, default=1)
+    p.add_argument("--expert", type=int, default=1)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--log-every", type=int, default=10)
+    return p
+
+
+def build_recipe_trainer(spec, args, seq_len: int = 1) -> Trainer:
+    mesh = build_mesh(MeshConfig(
+        data=args.data, fsdp=args.fsdp, tensor=args.tensor,
+        seq=args.seq, expert=args.expert))
+    return Trainer(spec, TrainerConfig(
+        global_batch_size=args.batch, seq_len=seq_len,
+        log_every=args.log_every,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every), mesh=mesh)
+
+
+def run_and_report(trainer: Trainer, data, steps: int,
+                   items_per_step: float, unit: str) -> Dict[str, Any]:
+    """Train; print one JSON result line with throughput (+MFU if known)."""
+    t0 = time.perf_counter()
+    out = trainer.fit(data, num_steps=steps)
+    dt = time.perf_counter() - t0
+    last = out["history"][-1] if out["history"] else {}
+    result = {
+        "steps": steps,
+        f"{unit}_per_sec": round(items_per_step * steps / dt, 2),
+        "wall_s": round(dt, 2),
+        "final_loss": (round(float(last["loss"]), 4)
+                       if "loss" in last else None),
+    }
+    if "mfu" in last:
+        result["mfu"] = round(float(last["mfu"]), 4)
+    print(json.dumps(result))
+    return result

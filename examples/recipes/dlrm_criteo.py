@@ -1,0 +1,29 @@
+"""DLRM Criteo recipe (BASELINE config #4, examples/sec).
+
+Reference path: Spark-runtime ETL -> DLRM CPU training.  Here the sparse
+embedding stack shards over the mesh (SparseCore-style distributed rows;
+XLA derives the all-to-all) and the ETL hand-off is a tokenized-shards
+directory the Spark runtime exports (train/data.py loaders).
+"""
+
+from cloudtik_tpu.models import dlrm as D
+from cloudtik_tpu.train.data import synthetic_dlrm_batches
+from cloudtik_tpu.train.trainer import dlrm_spec
+
+from common import build_recipe_trainer, recipe_argparser, run_and_report
+
+
+def main():
+    p = recipe_argparser("dlrm")
+    p.add_argument("--model", default="criteo_terabyte")
+    args = p.parse_args()
+
+    cfg = D.config(args.model)
+    trainer = build_recipe_trainer(dlrm_spec(cfg), args)
+    data = synthetic_dlrm_batches(args.batch, cfg.num_dense,
+                                  cfg.num_tables, cfg.rows_per_table)
+    run_and_report(trainer, data, args.steps, args.batch, "examples")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Llama LoRA fine-tune recipe (BASELINE config #3, seq/sec/chip).
+
+Reference path: AI-runtime HuggingFace-style full fine-tune over DDP.
+Here: frozen base params (FSDP-sharded, no optimizer state), LoRA adapters
+trained via the standard sharded step (models/lora.py).
+"""
+
+import jax
+
+from cloudtik_tpu.models import transformer as T
+from cloudtik_tpu.models.lora import LoRAConfig, lora_spec
+from cloudtik_tpu.train.data import synthetic_lm_batches
+from common import build_recipe_trainer, recipe_argparser, run_and_report
+
+
+def main():
+    p = recipe_argparser("llama-lora")
+    p.add_argument("--model", default="tpu_1b",
+                   help="llama2_7b for the full-size run")
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--rank", type=int, default=16)
+    args = p.parse_args()
+
+    cfg = T.config(args.model, max_seq_len=args.seq_len)
+    # Base checkpoint would be restored here; synthetic init for the bench.
+    base = T.init_params(jax.random.PRNGKey(0), cfg)
+    spec = lora_spec(base, cfg, LoRAConfig(rank=args.rank))
+    trainer = build_recipe_trainer(spec, args, seq_len=args.seq_len)
+    data = synthetic_lm_batches(args.batch, args.seq_len, cfg.vocab_size)
+    run_and_report(trainer, data, args.steps, args.batch, "seq")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""ResNet-50 ImageNet training recipe (BASELINE config #1, img/sec).
+
+Reference recipe: applications/ai/quickstart/bin/resnet50/train*.sh
+(torch-DDP over cloudtik-run).  Here: one SPMD program, batch sharded over
+data×fsdp, conv channels over tensor.  Launch on a pod slice with
+`tik-run examples/recipes/resnet50_imagenet.py -- --batch 1024 --data 8`.
+"""
+
+from cloudtik_tpu.models import resnet as R
+from cloudtik_tpu.train.data import synthetic_image_batches
+from cloudtik_tpu.train.trainer import resnet_spec
+
+from common import build_recipe_trainer, recipe_argparser, run_and_report
+
+
+def main():
+    p = recipe_argparser("resnet50")
+    p.add_argument("--model", default="resnet50")
+    p.add_argument("--image-size", type=int, default=224)
+    args = p.parse_args()
+
+    cfg = R.config(args.model, image_size=args.image_size)
+    trainer = build_recipe_trainer(resnet_spec(cfg), args)
+    data = synthetic_image_batches(args.batch, cfg.image_size,
+                                   cfg.num_classes)
+    run_and_report(trainer, data, args.steps, args.batch, "img")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""Diffusion UNet FSDP fine-tune recipe (BASELINE config #5, img/sec+MFU).
+
+Net-new vs the reference (no diffusion recipe upstream).  FSDP: --fsdp N
+shards every conv/attention weight over the fsdp axis; attention at low
+resolutions runs through the shared flash kernel.
+"""
+
+from cloudtik_tpu.models import diffusion as U
+from cloudtik_tpu.train.data import synthetic_diffusion_batches
+from cloudtik_tpu.train.trainer import diffusion_spec
+
+from common import build_recipe_trainer, recipe_argparser, run_and_report
+
+
+def main():
+    p = recipe_argparser("sdxl")
+    p.add_argument("--model", default="sdxl_mini")
+    args = p.parse_args()
+
+    cfg = U.config(args.model)
+    trainer = build_recipe_trainer(diffusion_spec(cfg), args)
+    data = synthetic_diffusion_batches(args.batch, cfg.image_size,
+                                       cfg.in_channels)
+    run_and_report(trainer, data, args.steps, args.batch, "img")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,220 @@
+"""Tests for the model families: ResNet, BERT, DLRM, diffusion, LoRA.
+
+All run on the 8-device CPU mesh from conftest; tiny presets keep compile
+fast.  Each family is driven through the real Trainer (sharded step) at
+least once.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cloudtik_tpu.models import bert as B
+from cloudtik_tpu.models import diffusion as U
+from cloudtik_tpu.models import dlrm as D
+from cloudtik_tpu.models import resnet as R
+from cloudtik_tpu.models import transformer as T
+from cloudtik_tpu.models.lora import (
+    LoRAConfig, init_lora_params, lora_loss_fn, lora_spec, merge_lora)
+from cloudtik_tpu.parallel.mesh import MeshConfig, build_mesh
+from cloudtik_tpu.train.data import (
+    synthetic_diffusion_batches, synthetic_dlrm_batches,
+    synthetic_image_batches, synthetic_lm_batches, synthetic_mlm_batches)
+from cloudtik_tpu.train.trainer import (
+    Trainer, TrainerConfig, bert_spec, diffusion_spec, dlrm_spec,
+    resnet_spec)
+
+
+class TestResNet:
+    def test_forward_shape(self):
+        cfg = R.config("tiny")
+        params = R.init_params(jax.random.PRNGKey(0), cfg)
+        batch = next(synthetic_image_batches(2, cfg.image_size,
+                                             cfg.num_classes))
+        logits = R.forward(params, jnp.asarray(batch["images"]), cfg)
+        assert logits.shape == (2, cfg.num_classes)
+        assert logits.dtype == jnp.float32
+
+    def test_loss_decreases(self):
+        import itertools
+        cfg = R.config("tiny")
+        trainer = Trainer(resnet_spec(cfg),
+                          TrainerConfig(global_batch_size=8, seq_len=1,
+                                        log_every=1))
+        fixed = next(synthetic_image_batches(8, cfg.image_size,
+                                             cfg.num_classes))
+        out = trainer.fit(itertools.repeat(fixed), num_steps=8)
+        losses = [h["loss"] for h in out["history"]]
+        assert losses[-1] < losses[0]
+
+    def test_resnet50_flops_sane(self):
+        # ResNet-50 fwd ≈ 8.2 GFLOPs at 224px (2*MACs); train ≈ 3x.
+        fwd = R._forward_flops(R.config("resnet50"))
+        assert 6e9 < fwd < 12e9
+
+    def test_param_tree_matches_axes(self):
+        cfg = R.config("tiny")
+        params = R.init_params(jax.random.PRNGKey(0), cfg)
+        axes = R.param_logical_axes(cfg)
+        jax.tree.map(lambda p, a: None, params, axes,
+                     is_leaf=lambda x: isinstance(x, tuple) and all(
+                         e is None or isinstance(e, str) for e in x))
+
+
+class TestBert:
+    def test_mlm_loss_and_shapes(self):
+        cfg = B.config("tiny")
+        params = B.init_params(jax.random.PRNGKey(0), cfg)
+        batch = {k: jnp.asarray(v) for k, v in
+                 next(synthetic_mlm_batches(2, 64, cfg.vocab_size)).items()}
+        loss, metrics = B.loss_fn(params, batch, cfg)
+        assert jnp.isfinite(loss) and loss > 0
+        assert "mlm_accuracy" in metrics
+
+    def test_classification_head(self):
+        cfg = B.config("tiny", num_labels=3)
+        params = B.init_params(jax.random.PRNGKey(0), cfg)
+        batch = {
+            "tokens": jnp.ones((2, 32), jnp.int32),
+            "labels": jnp.asarray([0, 2], jnp.int32),
+        }
+        loss, metrics = B.classify_loss_fn(params, batch, cfg)
+        assert jnp.isfinite(loss)
+        assert 0.0 <= float(metrics["accuracy"]) <= 1.0
+
+    def test_trainer_integration(self):
+        cfg = B.config("tiny")
+        trainer = Trainer(bert_spec(cfg),
+                          TrainerConfig(global_batch_size=8, seq_len=64,
+                                        log_every=1))
+        data = synthetic_mlm_batches(8, 64, cfg.vocab_size)
+        out = trainer.fit(data, num_steps=3)
+        assert all(np.isfinite(h["loss"]) for h in out["history"])
+
+    def test_bert_large_params(self):
+        # BERT-Large ≈ 335M params
+        n = B.config("bert_large").num_params()
+        assert 300e6 < n < 360e6
+
+
+class TestDLRM:
+    def test_forward_and_loss(self):
+        cfg = D.config("tiny")
+        params = D.init_params(jax.random.PRNGKey(0), cfg)
+        batch = {k: jnp.asarray(v) for k, v in next(synthetic_dlrm_batches(
+            4, cfg.num_dense, cfg.num_tables, cfg.rows_per_table)).items()}
+        logits = D.forward(params, batch["dense"], batch["sparse_ids"], cfg)
+        assert logits.shape == (4,)
+        loss, metrics = D.loss_fn(params, batch, cfg)
+        assert jnp.isfinite(loss) and loss > 0
+
+    def test_embedding_gather_correct(self):
+        cfg = D.config("tiny")
+        params = D.init_params(jax.random.PRNGKey(0), cfg)
+        ids = jnp.asarray([[0, 1, 2, 3], [5, 5, 5, 5]], jnp.int32)
+        e = D._gather_embed(params["embeddings"].astype(jnp.float32), ids)
+        np.testing.assert_allclose(
+            e[0, 2], params["embeddings"][2, 2], rtol=1e-6)
+        np.testing.assert_allclose(
+            e[1, 0], params["embeddings"][0, 5], rtol=1e-6)
+
+    def test_trainer_sharded_embeddings(self):
+        """Embeddings shard over the mesh; loss decreases."""
+        mesh = build_mesh(MeshConfig(data=2, fsdp=2, expert=2))
+        cfg = D.config("tiny", rows_per_table=128)
+        trainer = Trainer(dlrm_spec(cfg),
+                          TrainerConfig(global_batch_size=8, seq_len=1,
+                                        log_every=1), mesh=mesh)
+        data = synthetic_dlrm_batches(8, cfg.num_dense, cfg.num_tables,
+                                      cfg.rows_per_table)
+        out = trainer.fit(data, num_steps=5)
+        losses = [h["loss"] for h in out["history"]]
+        assert np.isfinite(losses).all()
+        # table stack sharded on the expert axis (4 tables / expert=2)
+        emb_shard = trainer.param_shardings["embeddings"]
+        assert "expert" in str(emb_shard.spec)
+
+    def test_interaction_dim(self):
+        cfg = D.config("tiny")
+        f = cfg.num_tables + 1
+        assert cfg.interaction_dim() == cfg.bottom_mlp[-1] + f * (f - 1) // 2
+
+
+class TestDiffusion:
+    def test_forward_shape(self):
+        cfg = U.config("tiny")
+        params = U.init_params(jax.random.PRNGKey(0), cfg)
+        x = jnp.zeros((2, cfg.image_size, cfg.image_size,
+                       cfg.in_channels), jnp.float32)
+        t = jnp.asarray([0.0, 500.0])
+        eps = U.forward(params, x, t, cfg)
+        assert eps.shape == x.shape
+
+    def test_schedule_monotonic(self):
+        t = jnp.linspace(0, 1, 11)
+        ab = U.cosine_alpha_bar(t)
+        assert ab[0] > 0.99 and ab[-1] < 0.01
+        assert (jnp.diff(ab) < 0).all()
+
+    def test_trainer_integration(self):
+        cfg = U.config("tiny")
+        trainer = Trainer(diffusion_spec(cfg),
+                          TrainerConfig(global_batch_size=8, seq_len=1,
+                                        log_every=1))
+        data = synthetic_diffusion_batches(8, cfg.image_size,
+                                           cfg.in_channels)
+        out = trainer.fit(data, num_steps=3)
+        assert all(np.isfinite(h["loss"]) for h in out["history"])
+
+
+class TestLoRA:
+    def test_zero_init_is_identity(self):
+        cfg = T.config("tiny")
+        lcfg = LoRAConfig(rank=4)
+        base = T.init_params(jax.random.PRNGKey(0), cfg)
+        adapters = init_lora_params(jax.random.PRNGKey(1), cfg, lcfg)
+        merged = merge_lora(base["layers"], adapters, lcfg)
+        np.testing.assert_allclose(merged["wq"], base["layers"]["wq"])
+
+    def test_wo_target_layout(self):
+        cfg = T.config("tiny")
+        lcfg = LoRAConfig(rank=4, targets=("wq", "wo"))
+        base = T.init_params(jax.random.PRNGKey(0), cfg)
+        adapters = init_lora_params(jax.random.PRNGKey(1), cfg, lcfg)
+        merged = merge_lora(base["layers"], adapters, lcfg)
+        assert merged["wo"].shape == base["layers"]["wo"].shape
+        np.testing.assert_allclose(merged["wo"], base["layers"]["wo"])
+        batch = {k: jnp.asarray(v) for k, v in
+                 next(synthetic_lm_batches(2, 32, cfg.vocab_size)).items()}
+        loss, _ = lora_loss_fn(adapters, base, batch, cfg, lcfg)
+        assert jnp.isfinite(loss)
+
+    def test_grads_only_on_adapters(self):
+        cfg = T.config("tiny")
+        lcfg = LoRAConfig(rank=4)
+        base = T.init_params(jax.random.PRNGKey(0), cfg)
+        adapters = init_lora_params(jax.random.PRNGKey(1), cfg, lcfg)
+        batch = {k: jnp.asarray(v) for k, v in
+                 next(synthetic_lm_batches(2, 32, cfg.vocab_size)).items()}
+        grads = jax.grad(
+            lambda a: lora_loss_fn(a, base, batch, cfg, lcfg)[0])(adapters)
+        # b starts at zero but gets gradient through a
+        assert float(jnp.abs(grads["wq"]["b"]).sum()) > 0
+
+    def test_trainer_trains_adapters_only(self):
+        cfg = T.config("tiny")
+        lcfg = LoRAConfig(rank=4)
+        base = T.init_params(jax.random.PRNGKey(0), cfg)
+        trainer = Trainer(lora_spec(base, cfg, lcfg),
+                          TrainerConfig(global_batch_size=8, seq_len=32,
+                                        log_every=1))
+        data = synthetic_lm_batches(8, 32, cfg.vocab_size)
+        out = trainer.fit(data, num_steps=5)
+        losses = [h["loss"] for h in out["history"]]
+        assert losses[-1] < losses[0]
+        # trainable state is only the adapters (tiny fraction of base)
+        n_adapter = sum(x.size for x in jax.tree.leaves(
+            trainer.state["params"]))
+        n_base = sum(x.size for x in jax.tree.leaves(base))
+        assert n_adapter < n_base * 0.05
