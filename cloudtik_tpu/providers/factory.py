@@ -15,6 +15,12 @@ from cloudtik_tpu.core.workspace_provider import WorkspaceProvider
 _NODE_PROVIDERS: Dict[str, str] = {
     "virtual": "cloudtik_tpu.providers.virtual.node_provider:VirtualNodeProvider",
     "gcp": "cloudtik_tpu.providers.gcp.node_provider:GCPNodeProvider",
+    "aws": "cloudtik_tpu.providers.aws.node_provider:AWSNodeProvider",
+    "azure": "cloudtik_tpu.providers.azure.node_provider:AzureNodeProvider",
+    "aliyun": "cloudtik_tpu.providers.aliyun.node_provider:AliyunNodeProvider",
+    "huaweicloud": "cloudtik_tpu.providers.huaweicloud.node_provider:HuaweiCloudNodeProvider",
+    "kubernetes": "cloudtik_tpu.providers.kubernetes.node_provider:KubernetesNodeProvider",
+    "local": "cloudtik_tpu.providers.local.node_provider:LocalNodeProvider",
     "onpremise": "cloudtik_tpu.providers.onpremise.node_provider:OnPremiseNodeProvider",
     "mock": "tests.mock_infra:MockProvider",
 }
@@ -22,6 +28,7 @@ _NODE_PROVIDERS: Dict[str, str] = {
 _WORKSPACE_PROVIDERS: Dict[str, str] = {
     "virtual": "cloudtik_tpu.providers.virtual.workspace_provider:VirtualWorkspaceProvider",
     "gcp": "cloudtik_tpu.providers.gcp.workspace_provider:GCPWorkspaceProvider",
+    "aws": "cloudtik_tpu.providers.aws.workspace_provider:AWSWorkspaceProvider",
 }
 
 

@@ -1,0 +1,362 @@
+"""Tests for the provider batch: local, onpremise+simulator, AWS,
+Kubernetes, Azure/Aliyun/Huawei payload builders."""
+
+import json
+import threading
+
+import pytest
+
+from cloudtik_tpu.core.node_provider import NodeLaunchException
+from cloudtik_tpu.core.tags import (
+    NODE_KIND_WORKER, TAG_NODE_KIND, TAG_NODE_SEQ_ID)
+from cloudtik_tpu.providers.aliyun.node_provider import (
+    build_run_instances_request as ali_run_request)
+from cloudtik_tpu.providers.aws.config import (
+    build_run_instances_request, derive_network_layout, from_aws_tags,
+    head_iam_policy, security_group_rules, tag_filters_to_aws,
+    to_aws_tags, workspace_resource_names)
+from cloudtik_tpu.providers.aws.node_provider import AWSNodeProvider
+from cloudtik_tpu.providers.azure.node_provider import build_vm_parameters
+from cloudtik_tpu.providers.factory import create_node_provider
+from cloudtik_tpu.providers.huaweicloud.node_provider import (
+    build_create_servers_request)
+from cloudtik_tpu.providers.kubernetes.manifests import (
+    build_pod_manifest, build_service_manifest, label_selector,
+    labels_to_tags, tags_to_labels)
+from cloudtik_tpu.providers.kubernetes.node_provider import (
+    KubernetesNodeProvider)
+from cloudtik_tpu.providers.local.node_provider import LocalNodeProvider
+from cloudtik_tpu.providers.onpremise.node_provider import (
+    OnPremiseNodeProvider)
+from cloudtik_tpu.providers.onpremise.simulator import CloudSimulator
+
+
+class TestLocalProvider:
+    def _provider(self, tmp_path, cluster="c1", hosts=None):
+        return LocalNodeProvider(
+            {"hosts": hosts or ["10.0.0.1", "10.0.0.2", "10.0.0.3"],
+             "state_root": str(tmp_path)}, cluster)
+
+    def test_claim_release(self, tmp_path):
+        p = self._provider(tmp_path)
+        created = p.create_node({}, {TAG_NODE_KIND: NODE_KIND_WORKER}, 2)
+        assert len(created) == 2
+        assert len(p.non_terminated_nodes({})) == 2
+        assert p.non_terminated_nodes(
+            {TAG_NODE_KIND: NODE_KIND_WORKER}) == sorted(created)
+        node = sorted(created)[0]
+        assert p.internal_ip(node) == node
+        p.terminate_node(node)
+        assert len(p.non_terminated_nodes({})) == 1
+
+    def test_inventory_exhaustion(self, tmp_path):
+        p = self._provider(tmp_path)
+        p.create_node({}, {}, 3)
+        with pytest.raises(NodeLaunchException) as e:
+            p.create_node({}, {}, 1)
+        assert e.value.category == "inventory"
+
+    def test_two_clusters_share_inventory(self, tmp_path):
+        p1 = self._provider(tmp_path, "c1")
+        p2 = self._provider(tmp_path, "c2")
+        p1.create_node({}, {}, 2)
+        p2.create_node({}, {}, 1)
+        assert len(p1.non_terminated_nodes({})) == 2
+        assert len(p2.non_terminated_nodes({})) == 1
+        with pytest.raises(NodeLaunchException):
+            p2.create_node({}, {}, 1)
+
+    def test_set_tags(self, tmp_path):
+        p = self._provider(tmp_path)
+        node = sorted(p.create_node({}, {}, 1))[0]
+        p.set_node_tags(node, {TAG_NODE_SEQ_ID: "5"})
+        assert p.node_tags(node)[TAG_NODE_SEQ_ID] == "5"
+
+    def test_validate(self):
+        with pytest.raises(ValueError):
+            LocalNodeProvider.validate_config({})
+
+
+class TestOnPremise:
+    @pytest.fixture
+    def sim(self):
+        sim = CloudSimulator(
+            [{"ip": f"192.168.1.{i}", "instance_type":
+              "big" if i < 2 else "default"} for i in range(5)],
+            host="127.0.0.1", port=0)
+        sim.start()
+        yield sim
+        sim.stop()
+
+    def _provider(self, sim, cluster="c1"):
+        return OnPremiseNodeProvider(
+            {"cloud_simulator_address": f"127.0.0.1:{sim.port}"}, cluster)
+
+    def test_allocate_release_over_http(self, sim):
+        p = self._provider(sim)
+        created = p.create_node({}, {TAG_NODE_KIND: NODE_KIND_WORKER}, 2)
+        assert len(created) == 2
+        nodes = p.non_terminated_nodes({})
+        assert len(nodes) == 2
+        assert p.internal_ip(nodes[0]).startswith("192.168.1.")
+        assert p.is_running(nodes[0])
+        p.terminate_node(nodes[0])
+        assert len(p.non_terminated_nodes({})) == 1
+
+    def test_instance_type_filter(self, sim):
+        p = self._provider(sim)
+        created = p.create_node({"instance_type": "big"}, {}, 2)
+        assert len(created) == 2
+        with pytest.raises(NodeLaunchException):
+            p.create_node({"instance_type": "big"}, {}, 1)
+
+    def test_tags_survive(self, sim):
+        p = self._provider(sim)
+        node = sorted(p.create_node({}, {"k": "v"}, 1))[0]
+        assert p.node_tags(node)["k"] == "v"
+        p.set_node_tags(node, {TAG_NODE_SEQ_ID: "3"})
+        assert p.node_tags(node)[TAG_NODE_SEQ_ID] == "3"
+
+    def test_two_clusters_isolated(self, sim):
+        p1, p2 = self._provider(sim, "c1"), self._provider(sim, "c2")
+        p1.create_node({}, {}, 2)
+        p2.create_node({}, {}, 2)
+        assert len(p1.non_terminated_nodes({})) == 2
+        assert len(p2.non_terminated_nodes({})) == 2
+
+
+class TestAWSBuilders:
+    def test_tags_roundtrip(self):
+        tags = {"tik-cluster-name": "c1", TAG_NODE_KIND: "worker"}
+        aws = to_aws_tags(tags)
+        assert {"Key": "Name", "Value": "c1-worker"} in aws
+        assert from_aws_tags(aws) == tags
+
+    def test_run_request(self):
+        req = build_run_instances_request(
+            {"InstanceType": "p4d.24xlarge", "ImageId": "ami-123",
+             "SubnetId": "subnet-1", "spot": True},
+            {"tik-cluster-name": "c1"}, 3)
+        assert req["MinCount"] == req["MaxCount"] == 3
+        assert req["InstanceType"] == "p4d.24xlarge"
+        assert req["ImageId"] == "ami-123"
+        assert req["InstanceMarketOptions"]["MarketType"] == "spot"
+
+    def test_filters(self):
+        f = tag_filters_to_aws({TAG_NODE_KIND: "worker"}, "c1")
+        assert {"Name": "tag:tik-cluster-name", "Values": ["c1"]} in f
+        assert {"Name": "tag:tik-node-kind", "Values": ["worker"]} in f
+
+    def test_network_layout(self):
+        layout = derive_network_layout("10.0.0.0/16", num_azs=2)
+        assert len(layout["public"]) == 2
+        assert len(layout["private"]) == 2
+        all_subnets = layout["public"] + layout["private"]
+        assert len(set(all_subnets)) == 4
+
+    def test_iam_policy_scopes_bucket(self):
+        policy = head_iam_policy("w1", "tik-w1-data")
+        buckets = [s for s in policy["Statement"]
+                   if any("s3" in a for a in s["Action"])]
+        assert buckets and "arn:aws:s3:::tik-w1-data" in \
+            buckets[0]["Resource"]
+
+    def test_sg_rules(self):
+        rules = security_group_rules("10.0.0.0/16")
+        assert any(r.get("FromPort") == 22 for r in rules)
+
+
+class FakeEC2:
+    """Minimal EC2 double for the provider paths."""
+
+    def __init__(self):
+        self.instances = {}
+        self.counter = 0
+
+    def run_instances(self, **req):
+        out = []
+        for _ in range(req["MaxCount"]):
+            self.counter += 1
+            iid = f"i-{self.counter:08d}"
+            inst = {"InstanceId": iid,
+                    "State": {"Name": "running"},
+                    "PrivateIpAddress": f"10.0.0.{self.counter}",
+                    "Tags": req["TagSpecifications"][0]["Tags"]}
+            self.instances[iid] = inst
+            out.append(inst)
+        return {"Instances": out}
+
+    def describe_instances(self, InstanceIds=None, Filters=None):
+        insts = list(self.instances.values())
+        if InstanceIds:
+            insts = [i for i in insts if i["InstanceId"] in InstanceIds]
+        if Filters:
+            for f in Filters:
+                if f["Name"].startswith("tag:"):
+                    key = f["Name"][4:]
+                    insts = [i for i in insts
+                             if any(t["Key"] == key and
+                                    t["Value"] in f["Values"]
+                                    for t in i["Tags"])]
+                elif f["Name"] == "instance-state-name":
+                    insts = [i for i in insts
+                             if i["State"]["Name"] in f["Values"]]
+        return {"Reservations": [{"Instances": insts}]}
+
+    def get_paginator(self, op):
+        assert op == "describe_instances"
+        fake = self
+
+        class _P:
+            def paginate(self, **kw):
+                return [fake.describe_instances(**kw)]
+
+        return _P()
+
+    def create_tags(self, Resources, Tags):
+        for rid in Resources:
+            inst = self.instances[rid]
+            existing = {t["Key"]: t for t in inst["Tags"]}
+            for t in Tags:
+                existing[t["Key"]] = t
+            inst["Tags"] = list(existing.values())
+
+    def terminate_instances(self, InstanceIds):
+        for iid in InstanceIds:
+            self.instances[iid]["State"]["Name"] = "terminated"
+
+
+class TestAWSProvider:
+    def test_lifecycle_with_fake_client(self):
+        fake = FakeEC2()
+        p = AWSNodeProvider({"ec2_client": fake}, "c1")
+        created = p.create_node(
+            {"InstanceType": "m5.large"},
+            {"tik-cluster-name": "c1", TAG_NODE_KIND: "worker"}, 2)
+        assert len(created) == 2
+        nodes = p.non_terminated_nodes({TAG_NODE_KIND: "worker"})
+        assert len(nodes) == 2
+        assert p.is_running(nodes[0])
+        assert p.internal_ip(nodes[0]).startswith("10.0.0.")
+        p.set_node_tags(nodes[0], {TAG_NODE_SEQ_ID: "2"})
+        assert p.node_tags(nodes[0])[TAG_NODE_SEQ_ID] == "2"
+        p.terminate_node(nodes[0])
+        assert p.is_terminated(nodes[0])
+        assert len(p.non_terminated_nodes({})) == 1
+
+    def test_factory_wires_aws(self):
+        p = create_node_provider({"type": "aws",
+                                  "ec2_client": FakeEC2()}, "c1")
+        assert isinstance(p, AWSNodeProvider)
+
+
+class TestKubernetesManifests:
+    def test_labels_roundtrip(self):
+        tags = {"tik-cluster-name": "c1", TAG_NODE_KIND: "worker"}
+        labels = tags_to_labels(tags)
+        assert labels["tik.io/cluster-name"] == "c1"
+        assert labels_to_tags(labels) == tags
+
+    def test_pod_manifest(self):
+        pod = build_pod_manifest(
+            {"image": "myimg:1", "resources": {"cpu": "4",
+                                               "memory": "8Gi"}},
+            {TAG_NODE_KIND: "worker"}, "c1", namespace="tik")
+        assert pod["metadata"]["namespace"] == "tik"
+        assert pod["metadata"]["labels"]["tik.io/cluster-name"] == "c1"
+        c = pod["spec"]["containers"][0]
+        assert c["image"] == "myimg:1"
+        assert c["resources"]["requests"]["cpu"] == "4"
+
+    def test_selector(self):
+        sel = label_selector({TAG_NODE_KIND: "worker"}, "c1")
+        assert "tik.io/cluster-name=c1" in sel
+        assert "tik.io/node-kind=worker" in sel
+
+    def test_service_manifest(self):
+        svc = build_service_manifest("c1", 6879)
+        assert svc["spec"]["ports"][0]["port"] == 6879
+        assert svc["spec"]["selector"]["tik.io/node-kind"] == "head"
+
+
+class FakeCoreV1:
+    def __init__(self):
+        self.pods = {}
+        self.counter = 0
+
+    def create_namespaced_pod(self, namespace, manifest):
+        self.counter += 1
+        name = manifest["metadata"]["generateName"] + f"{self.counter}"
+        pod = {"metadata": {"name": name,
+                            "labels": manifest["metadata"]["labels"]},
+               "status": {"phase": "Running",
+                          "podIP": f"10.1.0.{self.counter}"}}
+        self.pods[name] = pod
+        return pod
+
+    def list_namespaced_pod(self, namespace, label_selector=""):
+        want = dict(p.split("=") for p in label_selector.split(",") if p)
+        items = [p for p in self.pods.values()
+                 if all(p["metadata"]["labels"].get(k) == v
+                        for k, v in want.items())]
+        return {"items": items}
+
+    def read_namespaced_pod(self, name, namespace):
+        pod = self.pods.get(name)
+        if pod is None:
+            raise KeyError(name)
+        return pod
+
+    def patch_namespaced_pod(self, name, namespace, patch):
+        self.pods[name]["metadata"]["labels"].update(
+            patch["metadata"]["labels"])
+
+    def delete_namespaced_pod(self, name, namespace):
+        self.pods.pop(name)
+
+
+class TestKubernetesProvider:
+    def test_lifecycle_with_fake_api(self):
+        p = KubernetesNodeProvider({"core_api": FakeCoreV1()}, "c1")
+        created = p.create_node({"image": "img"},
+                                {TAG_NODE_KIND: "worker"}, 2)
+        assert len(created) == 2
+        nodes = p.non_terminated_nodes({TAG_NODE_KIND: "worker"})
+        assert len(nodes) == 2
+        assert p.is_running(nodes[0])
+        assert p.internal_ip(nodes[0]).startswith("10.1.0.")
+        p.terminate_node(nodes[0])
+        assert len(p.non_terminated_nodes({})) == 1
+
+
+class TestCloudPayloadBuilders:
+    def test_azure_vm_params(self):
+        params = build_vm_parameters(
+            {"vm_size": "Standard_ND96asr_v4", "spot": True,
+             "ssh_public_key": "ssh-rsa AAA"},
+            {"tik-cluster-name": "c1"}, "vm-1", "eastus", "/nic/1")
+        assert params["hardware_profile"]["vm_size"] == \
+            "Standard_ND96asr_v4"
+        assert params["priority"] == "Spot"
+        assert params["tags"]["tik-cluster-name"] == "c1"
+        ssh = params["os_profile"]["linux_configuration"]["ssh"]
+        assert ssh["public_keys"][0]["key_data"] == "ssh-rsa AAA"
+
+    def test_aliyun_request(self):
+        req = ali_run_request(
+            {"instance_type": "ecs.g7.2xlarge", "v_switch_id": "vsw-1",
+             "spot": True}, {TAG_NODE_KIND: "worker"}, 2, "c1")
+        assert req["Amount"] == 2
+        assert req["VSwitchId"] == "vsw-1"
+        assert req["SpotStrategy"] == "SpotAsPriceGo"
+        assert {"Key": "tik-cluster-name", "Value": "c1"} in req["Tag"]
+
+    def test_huawei_request(self):
+        body = build_create_servers_request(
+            {"flavor": "c7.4xlarge.2", "subnet_id": "sub-1"},
+            {TAG_NODE_KIND: "worker"}, 3, "c1")
+        server = body["server"]
+        assert server["count"] == 3
+        assert server["flavorRef"] == "c7.4xlarge.2"
+        assert {"key": "tik-cluster-name", "value": "c1"} in \
+            server["server_tags"]
