@@ -1,0 +1,93 @@
+"""SOCKS5 proxy to the cluster via `ssh -D`.
+
+Reference parity: cluster_operator.py:2592 _start_proxy_process (`cloudtik
+enable-local-proxy` — a dynamic port forward through the head so local
+tools reach in-cluster services).  The process is tracked by a pid file so
+`tik disable-local-proxy` can stop it across CLI invocations.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+from typing import Any, Dict, List, Optional, Tuple
+
+from cloudtik_tpu.utils.constants import TIK_RUN_DIR
+
+DEFAULT_PROXY_PORT = 6860
+
+
+def _pid_file(cluster_name: str) -> str:
+    return os.path.join(os.path.expanduser(TIK_RUN_DIR),
+                        f"proxy-{cluster_name}.pid")
+
+
+def build_proxy_command(head_ip: str, auth_config: Dict[str, Any],
+                        port: int = DEFAULT_PROXY_PORT) -> List[str]:
+    """The `ssh -D` command line (pure, testable)."""
+    cmd = ["ssh", "-o", "StrictHostKeyChecking=no",
+           "-o", "ServerAliveInterval=30",
+           "-N", "-D", str(port)]
+    key = auth_config.get("ssh_private_key")
+    if key:
+        cmd += ["-i", os.path.expanduser(key)]
+    user = auth_config.get("ssh_user", "")
+    cmd.append(f"{user}@{head_ip}" if user else head_ip)
+    return cmd
+
+
+def start_proxy(cluster_name: str, head_ip: str,
+                auth_config: Dict[str, Any],
+                port: int = DEFAULT_PROXY_PORT,
+                process_runner=subprocess) -> Tuple[int, int]:
+    """Start (or return the running) proxy; -> (pid, port)."""
+    existing = proxy_status(cluster_name)
+    if existing is not None:
+        return existing
+    cmd = build_proxy_command(head_ip, auth_config, port)
+    proc = process_runner.Popen(
+        cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True)
+    pid_file = _pid_file(cluster_name)
+    os.makedirs(os.path.dirname(pid_file), exist_ok=True)
+    with open(pid_file, "w") as f:
+        f.write(f"{proc.pid} {port}")
+    return proc.pid, port
+
+
+def proxy_status(cluster_name: str) -> Optional[Tuple[int, int]]:
+    """(pid, port) when the proxy is alive, else None (stale pid files
+    are removed)."""
+    pid_file = _pid_file(cluster_name)
+    try:
+        with open(pid_file) as f:
+            pid_s, port_s = f.read().split()
+        pid, port = int(pid_s), int(port_s)
+    except (OSError, ValueError):
+        return None
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        try:
+            os.unlink(pid_file)
+        except OSError:
+            pass
+        return None
+    return pid, port
+
+
+def stop_proxy(cluster_name: str) -> bool:
+    status = proxy_status(cluster_name)
+    if status is None:
+        return False
+    pid, _port = status
+    try:
+        os.kill(pid, signal.SIGTERM)
+    except OSError:
+        return False
+    try:
+        os.unlink(_pid_file(cluster_name))
+    except OSError:
+        pass
+    return True

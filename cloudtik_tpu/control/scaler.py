@@ -103,10 +103,19 @@ class ClusterScaler:
 
         self.pending_launches = PendingLaunches()
         self.launch_queue: "queue.Queue" = queue.Queue()
+        # categorized launch-failure history surfaced in summary()
+        from cloudtik_tpu.control.node_availability import (
+            NodeAvailabilityTracker)
+        self.availability = NodeAvailabilityTracker()
+
+        def _on_launch_failure(node_type, count, exc):
+            self.availability.record_failure(node_type, exc)
+
         self.launchers = [
             NodeLauncher(provider, self.cluster_name, config,
                          self.launch_queue, self.pending_launches,
-                         self.launch_hashes, index=i)
+                         self.launch_hashes,
+                         failure_callback=_on_launch_failure, index=i)
             for i in range(num_launcher_threads)]
         for launcher in self.launchers:
             launcher.start()
