@@ -1,10 +1,19 @@
 """Headline benchmark: flagship transformer training throughput + MFU.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.  The metric
-is training MFU of the ~1B-param flagship transformer (bf16 compute, flash
-attention, remat, adamw) on the attached TPU.  vs_baseline is measured MFU
-over the BASELINE.json north-star target of 45% MFU (the reference publishes
-no numeric baselines — BASELINE.md).
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} and always
+exits 0.  The metric is training MFU of the ~1B-param flagship transformer
+(bf16 params/compute, Pallas flash attention, remat, sequence-chunked
+cross-entropy, adamw with bf16 first moment) on the attached TPU.
+vs_baseline is measured MFU over the BASELINE.json north-star target of 45%
+MFU (the reference publishes no numeric baselines — BASELINE.md).
+
+Memory fit (the round-1 failure): the attached chip is a v5e (~16 GB HBM).
+The bench model trains pure-bf16 (param_dtype=bf16): params 1.9 GB + adam
+moments 3.8 GB (both bf16) + grads 1.9 GB transient.  The "save_attn"
+remat policy keeps ~4.3 GB of attention residuals at batch 8 — measured
+peak leaves no room for batch 16 (compile-time OOM), so candidates start
+at 8.  The sequence-chunked loss keeps the [B, S, 32k] logits tensor off
+HBM entirely.
 """
 
 from __future__ import annotations
@@ -12,33 +21,40 @@ from __future__ import annotations
 import json
 import sys
 import time
+import traceback
+
+METRIC = "llama1b_train_mfu_bf16_seq2048"
 
 
 def run_bench(model: str = "tpu_1b", seq_len: int = 2048,
-              batch_candidates=(16, 8, 4, 2, 1),
+              batch_candidates=(8, 4, 2, 1),
               warmup_steps: int = 3, measure_steps: int = 20):
     import jax
+    import jax.numpy as jnp
 
     from cloudtik_tpu.models import transformer as T
     from cloudtik_tpu.train.data import synthetic_lm_batches
+    from cloudtik_tpu.train.optim import OptimizerConfig
     from cloudtik_tpu.train.trainer import (
         Trainer, TrainerConfig, device_peak_flops, transformer_spec)
 
-    cfg = T.config(model, max_seq_len=seq_len)
+    cfg = T.config(model, max_seq_len=seq_len, param_dtype=jnp.bfloat16)
     spec = transformer_spec(cfg)
 
     last_err = None
+    trainer = None
     for batch in batch_candidates:
         try:
             trainer = Trainer(
                 spec,
-                TrainerConfig(global_batch_size=batch, seq_len=seq_len,
-                              log_every=measure_steps))
+                TrainerConfig(
+                    global_batch_size=batch, seq_len=seq_len,
+                    optimizer=OptimizerConfig(moment_dtype="bfloat16"),
+                    log_every=measure_steps))
             data = synthetic_lm_batches(batch, seq_len, cfg.vocab_size)
             # Warmup (compile + first steps) outside the measured window.
             trainer.fit(data, num_steps=warmup_steps)
             t0 = time.perf_counter()
-            trainer.config.log_every = measure_steps
             out = trainer.fit(data, num_steps=measure_steps)
             dt = time.perf_counter() - t0
             tokens_per_sec = batch * seq_len * measure_steps / dt
@@ -54,18 +70,38 @@ def run_bench(model: str = "tpu_1b", seq_len: int = 2048,
                 "loss": out["history"][-1]["loss"] if out["history"] else None,
             }
         except Exception as e:  # OOM at this batch: halve and retry
-            last_err = e
+            # Keep only the message: the exception object pins the failed
+            # trainer's device buffers via its traceback frames, and a
+            # leaked ~6 GB state per retry turns one OOM into five.
             msg = str(e)
-            if "RESOURCE_EXHAUSTED" not in msg and "memory" not in msg.lower():
-                raise
+            retryable = ("RESOURCE_EXHAUSTED" in msg
+                         or "memory" in msg.lower()
+                         or "remote_compile" in msg)
+            last_err = msg
+            print(f"# batch={batch} failed: {msg[:300]}", file=sys.stderr)
+            e.__traceback__ = None
+            del e
+            trainer = None
+            import gc
+            gc.collect()
+            jax.clear_caches()
+            if not retryable:
+                raise RuntimeError(msg)
     raise RuntimeError(f"all batch sizes failed: {last_err}")
 
 
 def main():
-    result = run_bench()
+    try:
+        result = run_bench()
+    except Exception:
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": METRIC, "value": 0.0, "unit": "% MFU",
+            "vs_baseline": 0.0, "error": "bench failed; see stderr"}))
+        return 0
     mfu_pct = result["mfu"] * 100
     print(json.dumps({
-        "metric": "llama1b_train_mfu_bf16_seq2048",
+        "metric": METRIC,
         "value": round(mfu_pct, 2),
         "unit": "% MFU",
         "vs_baseline": round(result["mfu"] / 0.45, 3),
@@ -73,7 +109,8 @@ def main():
     print(f"# tokens/sec={result['tokens_per_sec']:.0f} "
           f"batch={result['batch']} seq={result['seq_len']} "
           f"loss={result['loss']:.3f}", file=sys.stderr)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

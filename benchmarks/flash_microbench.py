@@ -1,0 +1,94 @@
+"""Flash-attention kernel microbench: achieved FLOP/s vs the MXU roofline.
+
+Run directly on a TPU host (`python benchmarks/flash_microbench.py`).
+Prints one line per shape: fwd and fwd+bwd achieved TFLOP/s, % of the
+chip's bf16 peak, and the speedup over the einsum reference attention.
+
+FLOP accounting (per head): fwd = 2 matmuls of 2*S*Skv*D; bwd = 7 matmul
+equivalents (score recompute in both kernels + dq/dk/dv/dp twice); causal
+halves the live work.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sync(out):
+    """Force completion via a scalar host readback.
+
+    On the tunneled TPU platform `block_until_ready` can return before the
+    device work drains, producing fantasy timings; a host transfer of one
+    element cannot."""
+    leaf = jax.tree.leaves(out)[0]
+    np.asarray(leaf[(0,) * leaf.ndim])
+
+
+def _time(f, *args, iters=20):
+    _sync(f(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    from cloudtik_tpu.ops.attention import reference_attention
+    from cloudtik_tpu.ops.flash_attention import flash_attention
+    from cloudtik_tpu.train.trainer import device_peak_flops
+
+    peak = device_peak_flops() or 0
+    dev = jax.devices()[0]
+    print(f"# device={dev.device_kind} peak_bf16={peak/1e12:.0f} TF/s")
+
+    shapes = [
+        # (B, H, Hkv, S, D, causal)
+        (8, 16, 16, 2048, 128, True),     # bench.py flagship shape
+        (4, 16, 16, 4096, 128, True),
+        (1, 16, 16, 16384, 128, True),    # long context
+        (8, 16, 4, 2048, 128, True),      # GQA 4:1
+        (8, 16, 16, 2048, 128, False),
+    ]
+    for B, H, Hkv, S, D, causal in shapes:
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((B, Hkv, S, D)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((B, Hkv, S, D)), jnp.bfloat16)
+
+        matmul = 2 * B * H * S * S * D          # one S x S x D matmul set
+        frac = 0.5 if causal else 1.0
+        fwd_flops = 2 * matmul * frac
+        bwd_flops = 7 * matmul * frac
+
+        fwd = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=causal))
+        t_fwd = _time(fwd, q, k, v)
+
+        grad = jax.jit(jax.grad(
+            lambda q, k, v: (flash_attention(q, k, v, causal=causal)
+                             .astype(jnp.float32) ** 2).sum(),
+            argnums=(0, 1, 2)))
+        t_full = _time(grad, q, k, v)
+
+        try:
+            ref = jax.jit(
+                lambda q, k, v: reference_attention(q, k, v, causal=causal))
+            t_ref = _time(ref, q, k, v, iters=5)
+            speedup = f"{t_ref / t_fwd:5.2f}x"
+        except Exception:
+            speedup = "  oom"
+
+        fwd_tf = fwd_flops / t_fwd / 1e12
+        full_tf = (fwd_flops + bwd_flops) / t_full / 1e12
+        print(f"B{B} H{H}/{Hkv} S{S} D{D} causal={int(causal)}: "
+              f"fwd {t_fwd*1e3:7.2f} ms {fwd_tf:6.1f} TF/s "
+              f"({100*fwd_tf/(peak/1e12):4.1f}% peak) | fwd+bwd "
+              f"{t_full*1e3:7.2f} ms {full_tf:6.1f} TF/s | vs ref {speedup}")
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from cloudtik_tpu.ops.attention import attention
 from cloudtik_tpu.parallel.sharding import with_sharding_constraint
@@ -45,6 +46,13 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32     # master param dtype
     tie_embeddings: bool = False
     remat: bool = True                 # rematerialize each layer in backward
+    # What the remat'd layer may keep ("save_attn" is the v5e-fit default:
+    # keep post-rope q/k/v + attention out + lse so backward recomputes the
+    # cheap projections but never re-runs the flash forward kernel):
+    #   "save_attn" | "full" (keep nothing) | "dots" (keep every weight
+    #   matmul output — fastest, biggest)
+    remat_policy: str = "save_attn"
+    scan_unroll: int = 1               # lax.scan unroll factor over layers
     attention_impl: Optional[str] = None  # None=auto, "flash", "reference",
     #                                       "ring" (sequence parallel)
     # Mixture of experts: n_experts > 1 turns every MLP into an
@@ -68,8 +76,13 @@ class TransformerConfig:
                          capacity_factor=self.moe_capacity_factor)
 
     def flops_per_token(self) -> float:
-        """Approximate training FLOPs per token (fwd+bwd), 6N_active."""
+        """Approximate training FLOPs per token (fwd+bwd), 6N_active.
+
+        Counts matmul params (incl. the lm-head projection — real MXU work)
+        plus the attention score/value matmuls; embedding gather excluded.
+        """
         n_params = self.num_params(include_embed=False, active_only=True)
+        n_params += self.d_model * self.vocab_size  # lm head (tied or not)
         attn = 12 * self.n_layers * self.d_model * self.max_seq_len
         return 6 * n_params + attn
 
@@ -236,11 +249,17 @@ def _layer(cfg: TransformerConfig, x: jax.Array, layer: Params,
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     q = with_sharding_constraint(q, "batch", "seq", "heads", None)
+    q = checkpoint_name(q, "attn_qkv")
+    k = checkpoint_name(k, "attn_qkv")
+    v = checkpoint_name(v, "attn_qkv")
     # BHSD for the kernel.
-    o = attention(
+    o, lse = attention(
         q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
         v.transpose(0, 2, 1, 3), causal=True,
-        implementation=cfg.attention_impl)
+        implementation=cfg.attention_impl, return_residuals=True)
+    o = checkpoint_name(o, "attn_out")
+    if lse is not None:
+        lse = checkpoint_name(lse, "attn_lse")
     o = o.transpose(0, 2, 1, 3)  # back to [B, S, H, Dh]
     attn_out = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(cfg.dtype))
     x = x + attn_out
@@ -263,6 +282,50 @@ def _layer(cfg: TransformerConfig, x: jax.Array, layer: Params,
     return with_sharding_constraint(x, "batch", "seq", None), aux
 
 
+def _remat_policy(cfg: TransformerConfig):
+    """Checkpoint policy for the remat'd layer body (see remat_policy doc)."""
+    P = jax.checkpoint_policies
+    if cfg.remat_policy == "save_attn":
+        return P.save_only_these_names("attn_qkv", "attn_out", "attn_lse")
+    if cfg.remat_policy == "full":
+        return P.nothing_saveable
+    if cfg.remat_policy == "dots":
+        return P.dots_with_no_batch_dims_saveable
+    raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}")
+
+
+def hidden_states(
+    params: Params,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    positions: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """tokens [B, S] int32 -> final-norm hidden states [B, S, d] + MoE aux."""
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    x = with_sharding_constraint(x, "batch", "seq", None)
+
+    layer_fn = functools.partial(_layer, cfg)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(cfg))
+
+    def scan_body(carry, layer_params):
+        carry, aux = layer_fn(carry, layer_params, positions)
+        return carry, aux
+
+    x, aux_stacked = jax.lax.scan(scan_body, x, params["layers"],
+                                  unroll=cfg.scan_unroll)
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    aux = {k: v.mean() for k, v in aux_stacked.items()}
+    return x, aux
+
+
+def _lm_head(params: Params, cfg: TransformerConfig) -> jax.Array:
+    return (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+
+
 def forward(
     params: Params,
     tokens: jax.Array,
@@ -275,31 +338,29 @@ def forward(
     With return_aux=True also returns per-layer-averaged auxiliary metrics
     (MoE router losses) for the training objective.
     """
-    B, S = tokens.shape
-    if positions is None:
-        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
-    x = with_sharding_constraint(x, "batch", "seq", None)
-
-    layer_fn = functools.partial(_layer, cfg)
-    if cfg.remat:
-        layer_fn = jax.checkpoint(
-            layer_fn,
-            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
-
-    def scan_body(carry, layer_params):
-        carry, aux = layer_fn(carry, layer_params, positions)
-        return carry, aux
-
-    x, aux_stacked = jax.lax.scan(scan_body, x, params["layers"])
-    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    x, aux = hidden_states(params, tokens, cfg, positions)
+    # bf16 matmul on the MXU with f32 accumulation (an f32xf32 matmul runs
+    # at a fraction of MXU rate and doubles the logits footprint).
     logits = jnp.einsum(
-        "bsd,dv->bsv", x.astype(jnp.float32), head.astype(jnp.float32))
+        "bsd,dv->bsv", x, _lm_head(params, cfg).astype(cfg.dtype),
+        preferred_element_type=jnp.float32)
     if return_aux:
-        aux = {k: v.mean() for k, v in aux_stacked.items()}
         return logits, aux
     return logits
+
+
+def _chunk_size(S: int, target: int = 512) -> int:
+    """Largest divisor of S that is <= target (sequence-chunked loss).
+
+    Falls back to a single chunk (full logits, the pre-chunking behavior)
+    when S has no useful divisor — a tiny chunk would turn the loss into a
+    pathological per-token scan."""
+    if S <= target:
+        return S
+    for c in range(target, 63, -1):
+        if S % c == 0:
+            return c
+    return S
 
 
 def loss_fn(
@@ -307,20 +368,49 @@ def loss_fn(
     batch: Dict[str, jax.Array],
     cfg: TransformerConfig,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """Causal LM loss.  batch: tokens [B,S], labels [B,S] (-100 = ignore)."""
-    logits, aux = forward(params, batch["tokens"], cfg, return_aux=True)
+    """Causal LM loss.  batch: tokens [B,S], labels [B,S] (-100 = ignore).
+
+    The cross entropy is computed over sequence chunks inside a remat'd
+    `lax.scan`, so the full [B, S, vocab] logits tensor is never resident
+    (at B=8, S=2048, V=32k that tensor alone is 2 GB in f32 — the round-1
+    bench OOM).  Each chunk's logits are recomputed in the backward pass.
+    """
+    x, aux = hidden_states(params, batch["tokens"], cfg)
+    head = _lm_head(params, cfg).astype(cfg.dtype)
     labels = batch["labels"]
-    valid = labels != -100
-    safe_labels = jnp.where(valid, labels, 0)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    token_logp = jnp.take_along_axis(
-        logp, safe_labels[..., None], axis=-1)[..., 0]
-    n_valid = jnp.maximum(valid.sum(), 1)
-    loss = -(token_logp * valid).sum() / n_valid
+    B, S, d = x.shape
+
+    C = _chunk_size(S)
+    n_chunks = S // C
+    xc = x.reshape(B, n_chunks, C, d).transpose(1, 0, 2, 3)
+    lc = labels.reshape(B, n_chunks, C).transpose(1, 0, 2)
+
+    def chunk_stats(x_chunk, label_chunk):
+        logits = jnp.einsum("bcd,dv->bcv", x_chunk, head,
+                            preferred_element_type=jnp.float32)
+        valid = label_chunk != -100
+        safe = jnp.where(valid, label_chunk, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        token_logp = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        correct = (logits.argmax(-1) == label_chunk) & valid
+        return (-(token_logp * valid).sum(), valid.sum(), correct.sum())
+
+    def scan_body(carry, inp):
+        nll, nv, nc = jax.checkpoint(chunk_stats)(*inp)
+        loss_sum, n_valid, n_correct = carry
+        return (loss_sum + nll, n_valid + nv, n_correct + nc), None
+
+    init = (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32),
+            jnp.zeros((), jnp.int32))
+    (loss_sum, n_valid, n_correct), _ = jax.lax.scan(
+        scan_body, init, (xc, lc))
+
+    n_valid = jnp.maximum(n_valid, 1)
+    loss = loss_sum / n_valid
     metrics = {
         "loss": loss,
         "n_tokens": n_valid,
-        "accuracy": ((logits.argmax(-1) == labels) & valid).sum() / n_valid,
+        "accuracy": n_correct / n_valid,
     }
     if aux:
         metrics.update(aux)

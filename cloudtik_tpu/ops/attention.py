@@ -80,7 +80,9 @@ def _on_tpu() -> bool:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "sm_scale", "implementation"))
+    jax.jit,
+    static_argnames=("causal", "sm_scale", "implementation",
+                     "return_residuals"))
 def attention(
     q: jax.Array,
     k: jax.Array,
@@ -89,13 +91,18 @@ def attention(
     causal: bool = True,
     sm_scale: Optional[float] = None,
     implementation: Optional[str] = None,
-) -> jax.Array:
+    return_residuals: bool = False,
+):
     """Multi-head / grouped-query attention.
 
     implementation: None (auto), "flash" (Pallas), "reference" (XLA),
     "ring" (sequence-parallel ring attention).  Auto picks ring whenever the
     ambient mesh shards the `seq` axis — so the same model code scales to
     long context by mesh configuration alone.
+
+    return_residuals=True returns (out, lse_or_None): the flash path's
+    logsumexp, which remat policies name-save so the backward pass never
+    re-runs the forward kernel (models/transformer.py "save_attn" policy).
     """
     impl = implementation
     if impl is None:
@@ -106,13 +113,16 @@ def attention(
     if impl == "ring":
         from cloudtik_tpu.ops.ring_attention import ring_attention_sharded
 
-        return ring_attention_sharded(q, k, v, causal=causal,
-                                      sm_scale=sm_scale)
+        out = ring_attention_sharded(q, k, v, causal=causal,
+                                     sm_scale=sm_scale)
+        return (out, None) if return_residuals else out
     if impl == "flash":
         from cloudtik_tpu.ops.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
-    return reference_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                               return_lse=return_residuals)
+    out = reference_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    return (out, None) if return_residuals else out
 
 
 def _ambient_seq_size() -> int:

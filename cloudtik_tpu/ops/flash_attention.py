@@ -96,7 +96,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0, :, :] = lse
 
 
-def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
+def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret=False):
     B, H, S, D = q.shape
     _, Hkv, Skv, _ = k.shape
     group = H // Hkv
@@ -133,6 +133,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
+        interpret=interpret,
     )(q, k, v)
     return o, lse
 
@@ -230,7 +231,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0, :, :] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _bwd(causal, sm_scale, block_q, block_k, residuals, g):
+def _bwd(causal, sm_scale, block_q, block_k, interpret, residuals, g):
     q, k, v, o, lse = residuals
     do = g
     B, H, S, D = q.shape
@@ -263,6 +264,7 @@ def _bwd(causal, sm_scale, block_q, block_k, residuals, g):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
+        interpret=interpret,
     )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
@@ -304,23 +306,31 @@ def _bwd(causal, sm_scale, block_q, block_k, residuals, g):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
+        interpret=interpret,
     )(q, k, v, do, lse, delta)
 
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k):
-    o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k)
-    return o
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    """Returns (o, lse).  lse is exposed as a real OUTPUT (not just a saved
+    residual) so remat policies can name-save it: with (q, k, v, o, lse) all
+    policy-saved, the backward pass never re-runs the forward kernel."""
+    return _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
-    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return (o, lse), (q, k, v, o, lse)
 
 
-_flash.defvjp(_flash_fwd, _bwd)
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, residuals, g):
+    do, _ = g  # lse is a stop-gradient output
+    return _bwd(causal, sm_scale, block_q, block_k, interpret, residuals, do)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(
@@ -332,12 +342,21 @@ def flash_attention(
     sm_scale: Optional[float] = None,
     block_q: int = DEFAULT_BLOCK,
     block_k: int = DEFAULT_BLOCK,
-) -> jax.Array:
-    """Differentiable flash attention.  q [B,H,S,D], k/v [B,Hkv,Skv,D]."""
+    return_lse: bool = False,
+    interpret: bool = False,
+):
+    """Differentiable flash attention.  q [B,H,S,D], k/v [B,Hkv,Skv,D].
+
+    With return_lse=True also returns the per-row logsumexp [B, H, S, 1]
+    (f32), which remat policies name-save so the backward pass reuses the
+    forward kernel's outputs instead of re-running it.
+    """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     if q.shape[1] % k.shape[1]:
         raise ValueError(
             f"num_heads {q.shape[1]} must be divisible by num_kv_heads "
             f"{k.shape[1]}")
-    return _flash(q, k, v, causal, float(sm_scale), block_q, block_k)
+    o, lse = _flash(q, k, v, causal, float(sm_scale), block_q, block_k,
+                    interpret)
+    return (o, lse) if return_lse else o

@@ -5,6 +5,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+import jax.numpy as jnp
 import optax
 
 
@@ -21,6 +22,9 @@ class OptimizerConfig:
     grad_clip_norm: Optional[float] = 1.0
     min_lr_ratio: float = 0.1
     schedule: str = "cosine"  # "cosine" | "constant" | "linear"
+    # First-moment storage dtype ("bfloat16" halves Adam's mu memory — the
+    # HBM-bound knob for fitting large models on small-HBM chips like v5e).
+    moment_dtype: Optional[str] = None
 
 
 def make_schedule(cfg: OptimizerConfig) -> optax.Schedule:
@@ -43,16 +47,20 @@ def make_schedule(cfg: OptimizerConfig) -> optax.Schedule:
 
 def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
     schedule = make_schedule(cfg)
+    mu_dtype = cfg.moment_dtype
     if cfg.name == "adamw":
         opt = optax.adamw(
             schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
-            weight_decay=cfg.weight_decay)
+            weight_decay=cfg.weight_decay, mu_dtype=mu_dtype)
     elif cfg.name == "sgd":
-        opt = optax.sgd(schedule, momentum=0.9)
+        opt = optax.sgd(schedule, momentum=0.9, accumulator_dtype=mu_dtype)
     elif cfg.name == "adafactor":
-        opt = optax.adafactor(schedule)
+        opt = optax.adafactor(
+            schedule,
+            dtype_momentum=mu_dtype if mu_dtype else jnp.float32)
     elif cfg.name == "lion":
-        opt = optax.lion(schedule, weight_decay=cfg.weight_decay)
+        opt = optax.lion(schedule, weight_decay=cfg.weight_decay,
+                         mu_dtype=mu_dtype)
     else:
         raise ValueError(f"Unknown optimizer {cfg.name!r}")
     if cfg.grad_clip_norm:
