@@ -102,8 +102,9 @@ class ServiceRuntimeBase(Runtime):
 
     # -- node lifecycle helpers -------------------------------------------
     def conf_dir(self, node_context: Dict[str, Any]) -> str:
-        base = node_context.get("conf_dir",
-                                f"~/.tik/{self.SERVICE_NAME}")
+        from cloudtik_tpu.utils.constants import tik_home
+        base = node_context.get(
+            "conf_dir", os.path.join(tik_home(), self.SERVICE_NAME))
         path = os.path.expanduser(base)
         os.makedirs(path, exist_ok=True)
         return path
@@ -113,3 +114,119 @@ class ServiceRuntimeBase(Runtime):
             return True
         is_head = bool(node_context.get("is_head"))
         return is_head if self.NODE_KIND == HEAD else not is_head
+
+    # -- software delivery (runtimes/delivery.py drives these) -------------
+    # Executable the service needs on nodes ("" -> pure-Python service).
+    BINARY: str = ""
+
+    def find_binary(self) -> Optional[str]:
+        """Locate BINARY: explicit config > $TIK_RUNTIME_HOME/<svc>/bin >
+        $<SVC>_HOME/bin > PATH."""
+        import shutil
+        if not self.BINARY:
+            return None
+        explicit = self.runtime_config.get("binary_path")
+        if explicit:
+            path = os.path.expanduser(explicit)
+            return path if os.access(path, os.X_OK) else None
+        candidates = []
+        runtime_home = os.environ.get("TIK_RUNTIME_HOME")
+        if runtime_home:
+            candidates.append(os.path.join(
+                runtime_home, self.SERVICE_NAME, "bin", self.BINARY))
+        svc_home = os.environ.get(f"{self.SERVICE_NAME.upper()}_HOME")
+        if svc_home:
+            candidates.append(os.path.join(svc_home, "bin", self.BINARY))
+        for c in candidates:
+            if os.access(c, os.X_OK):
+                return c
+        return shutil.which(self.BINARY)
+
+    def node_install(self, node_context: Dict[str, Any]) -> None:
+        """Default install = verify the service's binary is present on a
+        node that runs it.  Raises so the delivery layer (and the node
+        updater driving `tik runtime install`) surfaces missing software at
+        bootstrap instead of at first use."""
+        if not self.BINARY or not self.runs_on(node_context):
+            return
+        if self.find_binary() is None:
+            raise RuntimeError(
+                f"{self.SERVICE_NAME}: binary {self.BINARY!r} not found "
+                f"(set {self.SERVICE_NAME.upper()}_HOME, TIK_RUNTIME_HOME, "
+                f"runtime_config.binary_path, or install it on PATH)")
+
+    def service_command(
+        self, node_context: Dict[str, Any]
+    ) -> Optional[List[str]]:
+        """argv for the long-running service process; None -> nothing to
+        spawn (config-only runtimes)."""
+        return None
+
+    def service_env(self, node_context: Dict[str, Any]) -> Dict[str, str]:
+        return {}
+
+    def service_ready_port(
+        self, node_context: Dict[str, Any]
+    ) -> Optional[int]:
+        """Port that must accept TCP before start is considered successful."""
+        return self.port or None
+
+    def node_services(self, node_context: Dict[str, Any],
+                      command: str) -> None:
+        """Spawn/stop the service process declared by service_command().
+
+        Start = detached spawn + wait-for-port + register in the discovery
+        table (when a state client is present).  Failures raise with the
+        service's log tail (round-1 review: silent start failures)."""
+        from cloudtik_tpu.runtimes.common import process_runner
+
+        if not self.runs_on(node_context):
+            return
+        name = self.SERVICE_NAME
+        if command == "stop":
+            process_runner.stop_service(name)
+            self._deregister(node_context)
+            return
+        if command != "start":
+            raise ValueError(f"unknown services command {command!r}")
+        cmd = self.service_command(node_context)
+        if cmd is None:
+            return
+        process_runner.spawn_service(
+            name, cmd, env=self.service_env(node_context))
+        ready_port = self.service_ready_port(node_context)
+        if ready_port:
+            process_runner.wait_for_port(
+                name, ready_port,
+                timeout_s=float(self.runtime_config.get(
+                    "start_timeout_s", 30)))
+        self._register(node_context)
+
+    def _register(self, node_context: Dict[str, Any]) -> None:
+        state_client = node_context.get("state_client")
+        if state_client is None:
+            return
+        from cloudtik_tpu.runtimes.discovery.runtime import ServiceRegistry
+        config = node_context.get("config", {})
+        registry = ServiceRegistry(
+            state_client, config.get("cluster_name", ""),
+            config.get("workspace_name", ""))
+        registry.register(
+            self.SERVICE_NAME, node_context.get("node_id", ""),
+            node_context.get("node_ip") or node_context.get("head_ip", ""),
+            self.port, protocol=self.PROTOCOL,
+            tags=dict(self.runtime_config.get("tags", {})))
+
+    def _deregister(self, node_context: Dict[str, Any]) -> None:
+        state_client = node_context.get("state_client")
+        if state_client is None:
+            return
+        from cloudtik_tpu.runtimes.discovery.runtime import ServiceRegistry
+        config = node_context.get("config", {})
+        try:
+            ServiceRegistry(
+                state_client, config.get("cluster_name", ""),
+                config.get("workspace_name", "")).deregister(
+                    self.SERVICE_NAME, node_context.get("node_id", ""))
+        except Exception:
+            pass

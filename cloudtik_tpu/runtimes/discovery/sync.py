@@ -1,0 +1,74 @@
+"""Discovery sync daemon: renders the live service registry to consumers.
+
+Reference parity: the consul fabric's downstream renderers — prometheus
+file-SD generation (runtime/prometheus/discovery.py:62) and DNS zone data
+(dnsmasq/bind/coredns runtimes).  This build's registry lives in the head
+state store (discovery/runtime.py ServiceRegistry); this daemon runs on
+the head and periodically renders it into:
+
+  * {TIK_HOME}/prometheus/targets.json  — prometheus file-SD target groups
+  * {TIK_HOME}/dns/hosts.tik            — `ip fqdn` lines (dnsmasq/hosts)
+  * {TIK_HOME}/dns/services.json        — full registry snapshot
+
+Run: `python -m cloudtik_tpu.runtimes.discovery.sync --head-ip 10.0.0.2
+      --cluster c --workspace w [--interval 5]`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from typing import Any, Dict
+
+from cloudtik_tpu.utils.constants import TIK_STATE_PORT_DEFAULT, tik_home
+
+
+def render_once(registry, home: str) -> Dict[str, Any]:
+    from cloudtik_tpu.runtimes.discovery.runtime import service_fqdn
+    from cloudtik_tpu.runtimes.prometheus.runtime import write_targets_file
+
+    services = registry.services_by_name()
+    write_targets_file(os.path.join(home, "prometheus"), services)
+
+    dns_dir = os.path.join(home, "dns")
+    os.makedirs(dns_dir, exist_ok=True)
+    lines = []
+    for name, svc in sorted(services.items()):
+        fqdn = service_fqdn(name, registry.cluster, registry.workspace)
+        for node in svc["nodes"]:
+            lines.append(f"{node['ip']} {fqdn}")
+    with open(os.path.join(dns_dir, "hosts.tik"), "w") as f:
+        f.write("\n".join(lines) + ("\n" if lines else ""))
+    with open(os.path.join(dns_dir, "services.json"), "w") as f:
+        json.dump(services, f, indent=1, default=str)
+    return services
+
+
+def main() -> None:
+    from cloudtik_tpu.control.state import StateClient, TcpStateBackend
+    from cloudtik_tpu.runtimes.discovery.runtime import ServiceRegistry
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--head-ip", default="127.0.0.1")
+    parser.add_argument("--state-port", type=int,
+                        default=TIK_STATE_PORT_DEFAULT)
+    parser.add_argument("--cluster", default="")
+    parser.add_argument("--workspace", default="")
+    parser.add_argument("--interval", type=float, default=5.0)
+    args = parser.parse_args()
+
+    client = StateClient(TcpStateBackend(args.head_ip, args.state_port))
+    registry = ServiceRegistry(client, args.cluster, args.workspace)
+    home = tik_home()
+    while True:
+        try:
+            render_once(registry, home)
+        except Exception as e:  # head store restarting: retry next tick
+            print(f"discovery-sync: render failed: {e}", flush=True)
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    main()

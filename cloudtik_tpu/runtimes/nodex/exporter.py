@@ -1,0 +1,51 @@
+"""Standalone node-metrics exporter process.
+
+Reference parity: runtime/nodex ran the prometheus node-exporter binary on
+every node (runtime/nodex/runtime.py:13).  This build's exporter is
+self-contained Python (psutil → prometheus_client) spawned by the delivery
+layer: `python -m cloudtik_tpu.runtimes.nodex.exporter --port 9100`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+
+
+def start_exporter(port: int) -> None:
+    import psutil
+    from prometheus_client import Gauge, start_http_server
+
+    start_http_server(port)
+    cpu = Gauge("tik_node_cpu_percent", "CPU utilization")
+    mem = Gauge("tik_node_memory_percent", "Memory utilization")
+    disk = Gauge("tik_node_disk_percent", "Disk utilization of /")
+    net_sent = Gauge("tik_node_net_sent_bytes", "Bytes sent")
+    net_recv = Gauge("tik_node_net_recv_bytes", "Bytes received")
+
+    def _collect():
+        while True:
+            cpu.set(psutil.cpu_percent(interval=None))
+            mem.set(psutil.virtual_memory().percent)
+            disk.set(psutil.disk_usage("/").percent)
+            io = psutil.net_io_counters()
+            net_sent.set(io.bytes_sent)
+            net_recv.set(io.bytes_recv)
+            time.sleep(10)
+
+    threading.Thread(target=_collect, daemon=True,
+                     name="tik-nodex-collect").start()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=9100)
+    args = parser.parse_args()
+    start_exporter(args.port)
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

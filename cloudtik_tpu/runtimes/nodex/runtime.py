@@ -1,63 +1,32 @@
 """Node exporter runtime: per-node machine metrics.
 
 Reference parity: runtime/nodex/runtime.py:13 (prometheus node-exporter on
-every node).  This build ships its own tiny Python exporter (psutil →
-prometheus_client) so no external binary is required.
+every node).  This build ships its own tiny Python exporter
+(nodex/exporter.py, psutil → prometheus_client) spawned as a real service
+process by the delivery layer, so no external binary is required.
 """
 
 from __future__ import annotations
 
-import threading
-from typing import Any, Dict, List, Optional, Tuple
+import sys
+from typing import Any, Dict, List, Optional
 
-from cloudtik_tpu.core.runtime import Runtime
+from cloudtik_tpu.runtimes.common.runtime_base import (
+    ALL_NODES, ServiceRuntimeBase)
 
 DEFAULT_PORT = 9100
 
 
-class NodexRuntime(Runtime):
-    def get_runtime_services(self, cluster_config, cluster_head_ip):
-        return {"nodex": {
-            "protocol": "http",
-            "port": self.runtime_config.get("port", DEFAULT_PORT),
-            "node_kind": "node",   # every node
-        }}
+class NodexRuntime(ServiceRuntimeBase):
+    SERVICE_NAME = "nodex"
+    DEFAULT_PORT = DEFAULT_PORT
+    PROTOCOL = "http"
+    NODE_KIND = ALL_NODES
+    PROCESS_KEYWORD = "cloudtik_tpu.runtimes.nodex.exporter"
+    ENDPOINT_NAME = None
 
-    def node_services(self, node_context: Dict[str, Any], command: str) -> None:
-        if command == "start":
-            start_exporter(self.runtime_config.get("port", DEFAULT_PORT))
-
-    def get_processes(self) -> Optional[List[Tuple[str, bool, str, str]]]:
-        return [("nodex-exporter", True, "NodeExporter", "node")]
-
-
-_started = threading.Event()
-
-
-def start_exporter(port: int = DEFAULT_PORT) -> bool:
-    """Serve machine metrics on :port (idempotent per process)."""
-    if _started.is_set():
-        return False
-    try:
-        import psutil
-        from prometheus_client import Gauge, start_http_server
-
-        start_http_server(port)
-        cpu = Gauge("tik_node_cpu_percent", "CPU utilization")
-        mem = Gauge("tik_node_memory_percent", "Memory utilization")
-        disk = Gauge("tik_node_disk_percent", "Disk utilization of /")
-
-        def _collect():
-            import time
-            while True:
-                cpu.set(psutil.cpu_percent(interval=None))
-                mem.set(psutil.virtual_memory().percent)
-                disk.set(psutil.disk_usage("/").percent)
-                time.sleep(10)
-
-        threading.Thread(target=_collect, daemon=True,
-                         name="tik-nodex").start()
-        _started.set()
-        return True
-    except OSError:
-        return False
+    def service_command(
+        self, node_context: Dict[str, Any]
+    ) -> Optional[List[str]]:
+        return [sys.executable, "-m", "cloudtik_tpu.runtimes.nodex.exporter",
+                "--port", str(self.port)]

@@ -1,0 +1,205 @@
+"""Built-in metrics collector: a Prometheus-compatible scrape server.
+
+Reference parity: runtime/prometheus (SURVEY.md §2.3) ran the stock
+prometheus binary with file-SD targets.  Zero-egress TPU images often have
+no binary to install, so this build ships its own collector speaking the
+core Prometheus HTTP surface:
+
+  * file-SD: watches the targets.json the runtime renders from discovery
+  * scrapes each target's /metrics on an interval (stdlib urllib)
+  * serves /metrics (aggregated + `up` series), /-/healthy, /-/ready,
+    /api/v1/targets, and /api/v1/query (exact metric-name instant lookup)
+
+When a real prometheus binary is present the runtime prefers it; this
+module is the fallback and the dev/test path.  Run:
+`python -m cloudtik_tpu.runtimes.prometheus.collector --port 9090
+ --conf-dir ~/.tik/prometheus`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+([^\s]+)")
+
+
+class ScrapeState:
+    """Latest scrape results per target."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.targets: Dict[str, Dict[str, Any]] = {}
+
+    def update(self, address: str, labels: Dict[str, str],
+               text: Optional[str], error: Optional[str]) -> None:
+        with self.lock:
+            self.targets[address] = {
+                "address": address,
+                "labels": labels,
+                "up": error is None,
+                "last_scrape": time.time(),
+                "error": error,
+                "text": text or "",
+            }
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self.lock:
+            return {k: dict(v) for k, v in self.targets.items()}
+
+
+class Collector:
+    def __init__(self, conf_dir: str, scrape_interval_s: float = 5.0):
+        self.conf_dir = os.path.expanduser(conf_dir)
+        self.scrape_interval_s = scrape_interval_s
+        self.state = ScrapeState()
+        self.started_at = time.time()
+        self._stop = threading.Event()
+
+    # -- target discovery (file-SD) ---------------------------------------
+    def load_targets(self) -> List[Dict[str, Any]]:
+        path = os.path.join(self.conf_dir, "targets.json")
+        try:
+            with open(path) as f:
+                groups = json.load(f)
+        except (OSError, ValueError):
+            return []
+        out = []
+        for group in groups:
+            for address in group.get("targets", []):
+                out.append({"address": address,
+                            "labels": dict(group.get("labels", {}))})
+        return out
+
+    # -- scraping ----------------------------------------------------------
+    def scrape_once(self) -> None:
+        for target in self.load_targets():
+            address = target["address"]
+            url = f"http://{address}/metrics"
+            try:
+                with urllib.request.urlopen(url, timeout=3) as resp:
+                    text = resp.read().decode(errors="replace")
+                self.state.update(address, target["labels"], text, None)
+            except Exception as e:
+                self.state.update(address, target["labels"], None, str(e))
+
+    def run_scraper(self) -> None:
+        while not self._stop.is_set():
+            self.scrape_once()
+            self._stop.wait(self.scrape_interval_s)
+
+    # -- query -------------------------------------------------------------
+    def instant_query(self, metric: str) -> List[Dict[str, Any]]:
+        results = []
+        for target in self.state.snapshot().values():
+            if not target["up"]:
+                continue
+            for line in target["text"].splitlines():
+                if line.startswith("#"):
+                    continue
+                m = _SAMPLE_RE.match(line)
+                if m and m.group(1) == metric:
+                    results.append({
+                        "metric": {"__name__": metric,
+                                   "instance": target["address"],
+                                   **target["labels"]},
+                        "value": [time.time(), m.group(3)],
+                    })
+        return results
+
+    def render_metrics(self) -> str:
+        lines = [
+            "# HELP tik_collector_uptime_seconds Collector uptime.",
+            "# TYPE tik_collector_uptime_seconds gauge",
+            f"tik_collector_uptime_seconds {time.time() - self.started_at}",
+        ]
+        for target in self.state.snapshot().values():
+            labels = "".join(
+                f',{k}="{v}"' for k, v in sorted(target["labels"].items()))
+            lines.append(
+                f'up{{instance="{target["address"]}"{labels}}} '
+                f'{1 if target["up"] else 0}')
+            if target["up"]:
+                lines.append(target["text"].rstrip("\n"))
+        return "\n".join(lines) + "\n"
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def make_handler(collector: Collector):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _send(self, code: int, body: str,
+                  content_type: str = "text/plain; charset=utf-8"):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            parsed = urlparse(self.path)
+            if parsed.path in ("/-/healthy", "/-/ready"):
+                self._send(200, "OK")
+            elif parsed.path == "/metrics":
+                self._send(200, collector.render_metrics())
+            elif parsed.path == "/api/v1/targets":
+                active = [{
+                    "scrapeUrl": f"http://{t['address']}/metrics",
+                    "labels": t["labels"],
+                    "health": "up" if t["up"] else "down",
+                    "lastError": t["error"] or "",
+                } for t in collector.state.snapshot().values()]
+                self._send(200, json.dumps({
+                    "status": "success",
+                    "data": {"activeTargets": active}}),
+                    "application/json")
+            elif parsed.path == "/api/v1/query":
+                query = parse_qs(parsed.query).get("query", [""])[0]
+                self._send(200, json.dumps({
+                    "status": "success",
+                    "data": {"resultType": "vector",
+                             "result": collector.instant_query(query)}}),
+                    "application/json")
+            else:
+                self._send(404, "not found")
+
+    return Handler
+
+
+def serve(port: int, conf_dir: str,
+          scrape_interval_s: float = 5.0) -> None:
+    collector = Collector(conf_dir, scrape_interval_s)
+    threading.Thread(target=collector.run_scraper, daemon=True,
+                     name="tik-prom-scraper").start()
+    server = ThreadingHTTPServer(("0.0.0.0", port), make_handler(collector))
+    try:
+        server.serve_forever()
+    finally:
+        collector.stop()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=9090)
+    parser.add_argument("--conf-dir", default="~/.tik/prometheus")
+    parser.add_argument("--scrape-interval", type=float, default=5.0)
+    args = parser.parse_args()
+    serve(args.port, args.conf_dir, args.scrape_interval)
+
+
+if __name__ == "__main__":
+    main()

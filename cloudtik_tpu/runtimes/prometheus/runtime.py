@@ -1,75 +1,99 @@
 """Prometheus runtime: metrics server on head, targets from discovery.
 
 Reference parity: runtime/prometheus (SURVEY.md §2.3 — file-SD target
-generation runtime/prometheus/discovery.py:62).  This build generates the
-scrape config from the cluster's service registrations at configure time
-and refreshes it from the head discovery table.
+generation runtime/prometheus/discovery.py:62; binary installed by
+scripts/install.sh).  This build renders the scrape config from the
+cluster's service registrations and runs either the real prometheus binary
+(when installed) or the built-in Python collector (collector.py) speaking
+the same HTTP surface — so metrics collection genuinely works on
+zero-egress TPU images.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, List, Optional, Tuple
+import sys
+from typing import Any, Dict, List, Optional
 
-from cloudtik_tpu.core.runtime import Runtime
+import yaml
+
+from cloudtik_tpu.runtimes.common.runtime_base import HEAD, ServiceRuntimeBase
 
 DEFAULT_PORT = 9090
 
 
-class PrometheusRuntime(Runtime):
-    def get_runtime_services(self, cluster_config, cluster_head_ip):
-        return {"prometheus": {
-            "protocol": "http",
-            "port": self.runtime_config.get("port", DEFAULT_PORT),
-            "node_kind": "head",
-        }}
+class PrometheusRuntime(ServiceRuntimeBase):
+    SERVICE_NAME = "prometheus"
+    DEFAULT_PORT = DEFAULT_PORT
+    PROTOCOL = "http"
+    NODE_KIND = HEAD
+    PROCESS_KEYWORD = "prometheus"
+    ENDPOINT_NAME = "Prometheus"
+    BINARY = "prometheus"
 
-    def get_runtime_endpoints(self, cluster_config, cluster_head_ip):
-        port = self.runtime_config.get("port", DEFAULT_PORT)
-        return {"prometheus": {
-            "name": "Prometheus",
-            "url": f"http://{cluster_head_ip}:{port}",
-        }}
-
-    def get_head_service_ports(self):
-        return {"prometheus": {
-            "protocol": "TCP",
-            "port": self.runtime_config.get("port", DEFAULT_PORT)}}
+    def node_install(self, node_context: Dict[str, Any]) -> None:
+        """Binary optional: the built-in collector is always available."""
+        return None
 
     def node_configure(self, node_context: Dict[str, Any]) -> None:
-        """Write prometheus.yml with file-SD pointing at the targets file the
-        discovery runtime maintains."""
-        if not node_context.get("is_head"):
+        """Write prometheus.yml + file-SD targets from the cluster's
+        declared runtime services."""
+        if not self.runs_on(node_context):
             return
-        conf_dir = os.path.expanduser(
-            node_context.get("conf_dir", "~/.tik/prometheus"))
-        os.makedirs(conf_dir, exist_ok=True)
+        conf_dir = self.conf_dir(node_context)
         targets_file = os.path.join(conf_dir, "targets.json")
-        if not os.path.exists(targets_file):
-            with open(targets_file, "w") as f:
-                json.dump([], f)
-        config = {
+        config = node_context.get("config", {})
+        head_ip = node_context.get("head_ip", "127.0.0.1")
+        services = _declared_http_services(config, head_ip)
+        if services or not os.path.exists(targets_file):
+            write_targets_file(conf_dir, services)
+        prom_config = {
             "global": {"scrape_interval": "15s"},
             "scrape_configs": [{
                 "job_name": "tik",
                 "file_sd_configs": [{"files": [targets_file]}],
             }],
         }
-        import yaml
         with open(os.path.join(conf_dir, "prometheus.yml"), "w") as f:
-            yaml.safe_dump(config, f)
+            yaml.safe_dump(prom_config, f)
 
-    def node_services(self, node_context: Dict[str, Any], command: str) -> None:
-        """Start/stop a prometheus binary if installed (gated: zero-egress
-        dev boxes have no binary; the scrape config is still maintained)."""
-        # Managed by the services supervisor when the binary exists.
+    def service_command(
+        self, node_context: Dict[str, Any]
+    ) -> Optional[List[str]]:
+        conf_dir = self.conf_dir(node_context)
+        binary = self.find_binary()
+        if binary:
+            return [
+                binary,
+                f"--config.file={os.path.join(conf_dir, 'prometheus.yml')}",
+                f"--web.listen-address=:{self.port}",
+                f"--storage.tsdb.path={os.path.join(conf_dir, 'data')}"]
+        return [sys.executable, "-m",
+                "cloudtik_tpu.runtimes.prometheus.collector",
+                "--port", str(self.port), "--conf-dir", conf_dir,
+                "--scrape-interval",
+                str(self.runtime_config.get("scrape_interval_s", 5.0))]
 
-    def get_logs(self) -> Dict[str, str]:
-        return {"prometheus": "~/.tik/logs/prometheus"}
 
-    def get_processes(self) -> Optional[List[Tuple[str, bool, str, str]]]:
-        return [("prometheus", False, "Prometheus", "head")]
+def _declared_http_services(config: Dict[str, Any],
+                            head_ip: str) -> Dict[str, Dict[str, Any]]:
+    """Scrapeable (http) services the cluster config declares."""
+    from cloudtik_tpu.runtimes.registry import iter_runtimes
+
+    out: Dict[str, Dict[str, Any]] = {}
+    for runtime in iter_runtimes(config):
+        services = runtime.get_runtime_services(config, head_ip) or {}
+        for name, svc in services.items():
+            if svc.get("protocol") != "http":
+                continue
+            out[name] = {
+                "port": svc["port"],
+                "protocol": svc["protocol"],
+                "cluster": config.get("cluster_name", ""),
+                "nodes": [{"node_id": "head", "ip": head_ip}],
+            }
+    return out
 
 
 def write_targets_file(conf_dir: str,

@@ -56,7 +56,12 @@ TIK_STATE_NAMESPACE_DEFAULT = "tik"
 TIK_METRICS_PORT_DEFAULT = env_integer("TIK_METRICS_PORT", 44217)
 
 # --- files on nodes ----------------------------------------------------------
-TIK_HOME = os.path.expanduser(os.environ.get("TIK_HOME", "~/.tik"))
+def tik_home() -> str:
+    """Dynamic TIK_HOME (tests point it at a temp dir after import)."""
+    return os.path.expanduser(os.environ.get("TIK_HOME", "~/.tik"))
+
+
+TIK_HOME = tik_home()
 TIK_BOOTSTRAP_CONFIG_FILE = os.path.join(TIK_HOME, "bootstrap-config.yaml")
 # Remote-relative form: used as rsync target / file-mount key so the REMOTE
 # user's home is expanded on the node, not the operator's local home.
