@@ -31,23 +31,27 @@ from cloudtik_tpu.control.state import (
 from cloudtik_tpu.providers.factory import create_node_provider
 from cloudtik_tpu.runtimes.registry import iter_runtimes
 from cloudtik_tpu.utils.constants import (
-    TIK_BOOTSTRAP_CONFIG_FILE, TIK_LOGS_DIR, TIK_RUN_DIR,
-    TIK_STATE_PORT_DEFAULT)
+    TIK_LOGS_DIR, TIK_RUN_DIR, TIK_STATE_PORT_DEFAULT)
 
 logger = logging.getLogger(__name__)
 
 
+def _bootstrap_config_path() -> str:
+    from cloudtik_tpu.utils.constants import tik_home
+    return os.path.join(tik_home(), "bootstrap-config.yaml")
+
+
 def write_bootstrap_config(config: Dict[str, Any],
-                           path: str = TIK_BOOTSTRAP_CONFIG_FILE) -> str:
+                           path: Optional[str] = None) -> str:
+    path = path or _bootstrap_config_path()
     os.makedirs(os.path.dirname(path), exist_ok=True)
     with open(path, "w") as f:
         yaml.safe_dump(config, f)
     return path
 
 
-def load_bootstrap_config(
-        path: str = TIK_BOOTSTRAP_CONFIG_FILE) -> Dict[str, Any]:
-    with open(path) as f:
+def load_bootstrap_config(path: Optional[str] = None) -> Dict[str, Any]:
+    with open(path or _bootstrap_config_path()) as f:
         return yaml.safe_load(f)
 
 
@@ -71,6 +75,7 @@ class NodeServicesStarter:
         self.node_agent: Optional[NodeAgent] = None
         self.log_agent: Optional[LogAgent] = None
         self.state_client: Optional[StateClient] = None
+        self.runtime_failures: Dict[str, str] = {}
 
     # ------------------------------------------------------------------
     def start_head_processes(self) -> None:
@@ -120,6 +125,8 @@ class NodeServicesStarter:
         self._start_common_agents()
 
     def _start_common_agents(self) -> None:
+        from cloudtik_tpu.runtimes import delivery
+
         runtimes = iter_runtimes(self.config)
         process_specs = []
         log_dirs: Dict[str, str] = {"tik": TIK_LOGS_DIR}
@@ -131,30 +138,38 @@ class NodeServicesStarter:
                                                   self.node_id) or {}
         except Exception:
             logger.warning("nodes table unavailable; using defaults")
-        node_context = {
-            "is_head": self.is_head,
-            "head_ip": self.head_ip,
-            "node_id": self.node_id,
-            "node_ip": my_info.get("ip") or (
+        node_context = delivery.build_node_context(
+            self.config,
+            is_head=self.is_head,
+            head_ip=self.head_ip,
+            node_id=self.node_id,
+            node_ip=my_info.get("ip") or (
                 self.head_ip if self.is_head else ""),
-            "seq_id": my_info.get("seq_id",
-                                  1 if self.is_head else 0),
-            "config": self.config,
+            seq_id=my_info.get("seq_id", 1 if self.is_head else 0),
             # stateful runtimes (etcd/zookeeper/kafka/...) resolve peer
             # identity + membership through the state client
-            "state_client": self.state_client,
-        }
+            state_client=self.state_client,
+        )
         for runtime in runtimes:
             specs = runtime.get_processes()
             if specs:
                 process_specs.extend(specs)
             log_dirs.update(runtime.get_logs())
+        # Delivery pipeline (reference: `cloudtik runtime install|configure|
+        # services` run by the node updater, runtime_scripts.py:338-343).
+        # Failures are recorded per-runtime in the runtime_status table AND
+        # in this node's status record — they are node state, not log noise.
+        self.runtime_failures: Dict[str, str] = {}
+        for phase_fn in (delivery.install_runtimes,
+                         delivery.configure_runtimes,
+                         delivery.start_runtime_services):
             try:
-                runtime.node_configure(node_context)
-                runtime.node_services(node_context, "start")
-            except Exception:
-                logger.exception("runtime %s start failed",
-                                 type(runtime).__name__)
+                phase_fn(self.config, node_context)
+            except delivery.RuntimeDeliveryError as e:
+                self.runtime_failures.update(e.failures)
+                logger.error("runtime %s failed: %s", e.phase, e.failures)
+                break  # don't start services on a broken install/configure
+        self._publish_node_status()
         self.node_agent = NodeAgent(
             self.state_client, self.node_id, node_ip=self.head_ip
             if self.is_head else None, process_specs=process_specs)
@@ -162,16 +177,28 @@ class NodeServicesStarter:
         self.log_agent = LogAgent(self.state_client, self.node_id, log_dirs)
         self.log_agent.start()
 
+    def _publish_node_status(self) -> None:
+        """Mirror runtime-delivery health into the head's node_status table
+        so `tik status` and the scaler see failed nodes (reference: the node
+        updater marking update-failed, node_updater.py:151)."""
+        try:
+            self.state_client.table_put("node_status", self.node_id, {
+                "node_id": self.node_id,
+                "is_head": self.is_head,
+                "runtime_failures": dict(self.runtime_failures),
+                "healthy": not self.runtime_failures,
+                "time": time.time(),
+            })
+        except Exception:
+            logger.warning("cannot publish node status", exc_info=True)
+
     # ------------------------------------------------------------------
     def stop(self) -> None:
-        runtimes = iter_runtimes(self.config)
-        node_context = {"is_head": self.is_head, "head_ip": self.head_ip,
-                        "config": self.config}
-        for runtime in runtimes:
-            try:
-                runtime.node_services(node_context, "stop")
-            except Exception:
-                pass
+        from cloudtik_tpu.runtimes import delivery
+        node_context = delivery.build_node_context(
+            self.config, is_head=self.is_head, head_ip=self.head_ip,
+            node_id=self.node_id, state_client=self.state_client)
+        delivery.stop_runtime_services(self.config, node_context)
         for svc in (self.node_agent, self.log_agent, self.controller):
             if svc:
                 svc.stop()

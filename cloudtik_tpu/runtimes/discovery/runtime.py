@@ -85,6 +85,12 @@ class ServiceRegistry:
 
 
 class DiscoveryRuntime(Runtime):
+    """Head runtime: the registry lives in the state server; this runtime's
+    service process is the *sync daemon* (discovery/sync.py) that renders
+    the live registry into prometheus file-SD targets + DNS host files —
+    the downstream consumers the reference fed from Consul
+    (runtime/prometheus/discovery.py:62)."""
+
     def get_runtime_services(self, cluster_config, cluster_head_ip):
         return {"discovery": {
             "protocol": "tcp",
@@ -96,4 +102,33 @@ class DiscoveryRuntime(Runtime):
         return {"discovery": "~/.tik/logs/discovery"}
 
     def get_processes(self) -> Optional[List[Tuple[str, bool, str, str]]]:
-        return [("tik-state-server", True, "StateServer", "head")]
+        return [("tik-state-server", True, "StateServer", "head"),
+                ("cloudtik_tpu.runtimes.discovery.sync", False,
+                 "DiscoverySync", "head")]
+
+    def node_services(self, node_context: Dict[str, Any],
+                      command: str) -> None:
+        """Spawn/stop the discovery-sync daemon on the head."""
+        import sys
+        from cloudtik_tpu.runtimes.common import process_runner
+        from cloudtik_tpu.utils.constants import TIK_STATE_PORT_DEFAULT
+
+        if not node_context.get("is_head"):
+            return
+        name = "discovery-sync"
+        if command == "stop":
+            process_runner.stop_service(name)
+            return
+        if command != "start":
+            raise ValueError(f"unknown services command {command!r}")
+        config = node_context.get("config", {})
+        cmd = [sys.executable, "-m",
+               "cloudtik_tpu.runtimes.discovery.sync",
+               "--head-ip", node_context.get("head_ip", "127.0.0.1"),
+               "--state-port",
+               str(config.get("state_port", TIK_STATE_PORT_DEFAULT)),
+               "--cluster", config.get("cluster_name", ""),
+               "--workspace", config.get("workspace_name", ""),
+               "--interval",
+               str(self.runtime_config.get("sync_interval_s", 2.0))]
+        process_runner.spawn_service(name, cmd)

@@ -30,7 +30,9 @@ def render_once(registry, home: str) -> Dict[str, Any]:
     from cloudtik_tpu.runtimes.prometheus.runtime import write_targets_file
 
     services = registry.services_by_name()
-    write_targets_file(os.path.join(home, "prometheus"), services)
+    scrapeable = {name: svc for name, svc in services.items()
+                  if svc.get("protocol") == "http"}
+    write_targets_file(os.path.join(home, "prometheus"), scrapeable)
 
     dns_dir = os.path.join(home, "dns")
     os.makedirs(dns_dir, exist_ok=True)

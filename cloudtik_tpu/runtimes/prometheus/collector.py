@@ -117,19 +117,46 @@ class Collector:
         return results
 
     def render_metrics(self) -> str:
+        """Aggregate scrapes into one valid exposition: every sample gets an
+        instance="<address>" label so identical metric names from multiple
+        targets (nodex on every node) stay distinct series, and HELP/TYPE
+        headers are emitted once per metric name."""
         lines = [
             "# HELP tik_collector_uptime_seconds Collector uptime.",
             "# TYPE tik_collector_uptime_seconds gauge",
             f"tik_collector_uptime_seconds {time.time() - self.started_at}",
         ]
+        seen_headers: set = set()
         for target in self.state.snapshot().values():
             labels = "".join(
                 f',{k}="{v}"' for k, v in sorted(target["labels"].items()))
             lines.append(
                 f'up{{instance="{target["address"]}"{labels}}} '
                 f'{1 if target["up"] else 0}')
-            if target["up"]:
-                lines.append(target["text"].rstrip("\n"))
+            if not target["up"]:
+                continue
+            for raw in target["text"].splitlines():
+                line = raw.rstrip()
+                if not line:
+                    continue
+                if line.startswith("#"):
+                    parts = line.split(None, 3)
+                    if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                        key = (parts[1], parts[2])
+                        if key in seen_headers:
+                            continue
+                        seen_headers.add(key)
+                    lines.append(line)
+                    continue
+                m = _SAMPLE_RE.match(line)
+                if not m:
+                    continue
+                name, label_blob = m.group(1), m.group(2)
+                inner = (label_blob or "{}")[1:-1]
+                inst = f'instance="{target["address"]}"'
+                merged = f"{inner},{inst}" if inner else inst
+                value_part = line[m.start(3):]
+                lines.append(f"{name}{{{merged}}} {value_part}")
         return "\n".join(lines) + "\n"
 
     def stop(self) -> None:

@@ -233,6 +233,85 @@ def workspace_status(config_file):
         _load_workspace(config_file)), indent=2, default=str))
 
 
+# ---------------------------------------------------------------- runtime --
+
+@cli.group()
+def runtime():
+    """On-node runtime lifecycle: install/configure/start/stop/status.
+
+    Reference parity: `cloudtik runtime` group
+    (scripts/runtime_scripts.py:338-343) run by the node updater on every
+    node; here the delivery layer (runtimes/delivery.py) executes the same
+    phases against the bootstrap config on this node."""
+
+
+def _delivery_context(head_ip: str):
+    from cloudtik_tpu.control.services import load_bootstrap_config
+    from cloudtik_tpu.runtimes import delivery
+    config = load_bootstrap_config()
+    node_context = delivery.build_node_context(
+        config,
+        is_head=os.environ.get("TIK_NODE_KIND", "head") == "head",
+        head_ip=head_ip,
+        node_id=os.environ.get("TIK_NODE_ID", ""))
+    return delivery, config, node_context
+
+
+_runtimes_opt = click.option(
+    "--runtimes", "-r", default=None,
+    help="Comma-separated runtime names (default: all configured).")
+_head_ip_opt = click.option("--head-ip", default="127.0.0.1")
+
+
+def _names(runtimes):
+    return [r.strip() for r in runtimes.split(",")] if runtimes else None
+
+
+@runtime.command(name="install")
+@_runtimes_opt
+@_head_ip_opt
+def runtime_install(runtimes, head_ip):
+    """Verify/install runtime software on this node."""
+    delivery, config, ctx = _delivery_context(head_ip)
+    delivery.install_runtimes(config, ctx, _names(runtimes))
+    cli_logger.success("Runtimes installed.")
+
+
+@runtime.command(name="configure")
+@_runtimes_opt
+@_head_ip_opt
+def runtime_configure(runtimes, head_ip):
+    """Render runtime configuration on this node."""
+    delivery, config, ctx = _delivery_context(head_ip)
+    delivery.configure_runtimes(config, ctx, _names(runtimes))
+    cli_logger.success("Runtimes configured.")
+
+
+@runtime.command(name="services")
+@click.argument("command", type=click.Choice(["start", "stop"]))
+@_runtimes_opt
+@_head_ip_opt
+def runtime_services(command, runtimes, head_ip):
+    """Start or stop runtime service processes on this node."""
+    delivery, config, ctx = _delivery_context(head_ip)
+    if command == "start":
+        delivery.start_runtime_services(config, ctx, _names(runtimes))
+        cli_logger.success("Runtime services started.")
+    else:
+        delivery.stop_runtime_services(config, ctx, _names(runtimes))
+        cli_logger.success("Runtime services stopped.")
+
+
+@runtime.command(name="status")
+@_runtimes_opt
+@_head_ip_opt
+def runtime_status_cmd(runtimes, head_ip):
+    """Show per-runtime delivery/health status on this node."""
+    delivery, config, ctx = _delivery_context(head_ip)
+    click.echo(json.dumps(delivery.runtime_status(
+        config, _names(runtimes)), indent=2, default=str))
+
+
 # ------------------------------------------------------------------- node --
 
 @cli.group()
