@@ -359,4 +359,8 @@ def flash_attention(
             f"{k.shape[1]}")
     o, lse = _flash(q, k, v, causal, float(sm_scale), block_q, block_k,
                     interpret)
-    return (o, lse) if return_lse else o
+    # lse is a statistic of the forward pass, not a differentiable output:
+    # the custom_vjp ignores its cotangent, so mark it stop_gradient —
+    # a caller differentiating through lse gets a loud zero-tangent
+    # semantic instead of silently dropped gradients.
+    return (o, jax.lax.stop_gradient(lse)) if return_lse else o

@@ -118,22 +118,26 @@ class ServiceRuntimeBase(Runtime):
     # -- software delivery (runtimes/delivery.py drives these) -------------
     # Executable the service needs on nodes ("" -> pure-Python service).
     BINARY: str = ""
+    # Default install spec (see runtimes/installer.py) used when BINARY is
+    # absent from the node; `runtime_config["install"]` overrides it.
+    # Reference parity: each runtime's scripts/install.sh download recipe
+    # (e.g. runtime/spark/scripts/install.sh:1) as declarative data.
+    INSTALL: Optional[Dict[str, Any]] = None
 
     def find_binary(self) -> Optional[str]:
-        """Locate BINARY: explicit config > $TIK_RUNTIME_HOME/<svc>/bin >
-        $<SVC>_HOME/bin > PATH."""
+        """Locate BINARY: explicit config > $TIK_RUNTIME_HOME/<svc>/bin
+        (and its bare root) > $<SVC>_HOME/bin > PATH."""
         import shutil
+        from cloudtik_tpu.runtimes import installer
         if not self.BINARY:
             return None
         explicit = self.runtime_config.get("binary_path")
         if explicit:
             path = os.path.expanduser(explicit)
             return path if os.access(path, os.X_OK) else None
-        candidates = []
-        runtime_home = os.environ.get("TIK_RUNTIME_HOME")
-        if runtime_home:
-            candidates.append(os.path.join(
-                runtime_home, self.SERVICE_NAME, "bin", self.BINARY))
+        home = installer.install_dir(self.SERVICE_NAME)
+        candidates = [os.path.join(home, "bin", self.BINARY),
+                      os.path.join(home, self.BINARY)]
         svc_home = os.environ.get(f"{self.SERVICE_NAME.upper()}_HOME")
         if svc_home:
             candidates.append(os.path.join(svc_home, "bin", self.BINARY))
@@ -142,18 +146,39 @@ class ServiceRuntimeBase(Runtime):
                 return c
         return shutil.which(self.BINARY)
 
+    def install_spec(self) -> Optional[Dict[str, Any]]:
+        spec = self.runtime_config.get("install")
+        if spec is not None:
+            return dict(spec) if spec else None
+        return dict(self.INSTALL) if self.INSTALL else None
+
     def node_install(self, node_context: Dict[str, Any]) -> None:
-        """Default install = verify the service's binary is present on a
-        node that runs it.  Raises so the delivery layer (and the node
-        updater driving `tik runtime install`) surfaces missing software at
-        bootstrap instead of at first use."""
+        """Install the service's software on a node that runs it.
+
+        Binary already present -> done (idempotent re-bootstrap).  Missing
+        -> run the install spec (download/unpack/pip into
+        $TIK_RUNTIME_HOME/<svc>, runtimes/installer.py) and re-check.
+        Still missing (or no spec) -> raise so the delivery layer surfaces
+        the failure at bootstrap instead of at first use."""
+        from cloudtik_tpu.runtimes import installer
         if not self.BINARY or not self.runs_on(node_context):
             return
-        if self.find_binary() is None:
+        if self.find_binary() is not None:
+            return
+        spec = self.install_spec()
+        if spec:
+            installer.install(self.SERVICE_NAME, spec)
+            if self.find_binary() is not None:
+                return
             raise RuntimeError(
-                f"{self.SERVICE_NAME}: binary {self.BINARY!r} not found "
-                f"(set {self.SERVICE_NAME.upper()}_HOME, TIK_RUNTIME_HOME, "
-                f"runtime_config.binary_path, or install it on PATH)")
+                f"{self.SERVICE_NAME}: install spec ran but binary "
+                f"{self.BINARY!r} still not found under "
+                f"{installer.install_dir(self.SERVICE_NAME)}")
+        raise RuntimeError(
+            f"{self.SERVICE_NAME}: binary {self.BINARY!r} not found "
+            f"(set {self.SERVICE_NAME.upper()}_HOME, TIK_RUNTIME_HOME, "
+            f"runtime_config.binary_path or .install, or install it "
+            f"on PATH)")
 
     def service_command(
         self, node_context: Dict[str, Any]

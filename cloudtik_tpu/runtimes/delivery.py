@@ -48,6 +48,12 @@ def _state_dir() -> str:
 
 
 def _runtime_name(runtime: Runtime) -> str:
+    # The name the runtime was registered under is the contract (the CLI,
+    # tests, and state tables all key on it); SERVICE_NAME / class name are
+    # fallbacks for runtimes instantiated outside the registry.
+    name = getattr(runtime, "registered_name", "") or ""
+    if name:
+        return name
     name = getattr(runtime, "SERVICE_NAME", "") or ""
     if name:
         return name

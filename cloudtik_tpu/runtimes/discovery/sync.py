@@ -48,6 +48,38 @@ def render_once(registry, home: str) -> Dict[str, Any]:
     return services
 
 
+def next_delay(interval: float, consecutive_failures: int,
+               max_backoff: float = 60.0, jitter: float = 0.1) -> float:
+    """Poll delay: base interval on success; exponential backoff with
+    jitter while the head store is unreachable so a restarting head isn't
+    hammered by every node's sync daemon at once."""
+    import random
+    if consecutive_failures <= 0:
+        delay = interval
+    else:
+        delay = min(interval * (2 ** consecutive_failures), max_backoff)
+    return delay * (1.0 + random.uniform(-jitter, jitter))
+
+
+def run_loop(registry, home: str, interval: float,
+             max_iterations: int = 0) -> None:
+    """Render loop with failure backoff; max_iterations>0 bounds it (tests)."""
+    failures = 0
+    iterations = 0
+    while True:
+        try:
+            render_once(registry, home)
+            failures = 0
+        except Exception as e:  # head store down/restarting: back off
+            failures += 1
+            print(f"discovery-sync: render failed ({failures}x): {e}",
+                  flush=True)
+        iterations += 1
+        if max_iterations and iterations >= max_iterations:
+            return
+        time.sleep(next_delay(interval, failures))
+
+
 def main() -> None:
     from cloudtik_tpu.control.state import StateClient, TcpStateBackend
     from cloudtik_tpu.runtimes.discovery.runtime import ServiceRegistry
@@ -63,13 +95,7 @@ def main() -> None:
 
     client = StateClient(TcpStateBackend(args.head_ip, args.state_port))
     registry = ServiceRegistry(client, args.cluster, args.workspace)
-    home = tik_home()
-    while True:
-        try:
-            render_once(registry, home)
-        except Exception as e:  # head store restarting: retry next tick
-            print(f"discovery-sync: render failed: {e}", flush=True)
-        time.sleep(args.interval)
+    run_loop(registry, tik_home(), args.interval)
 
 
 if __name__ == "__main__":

@@ -48,6 +48,24 @@ class EtcdRuntime(ServiceRuntimeBase):
     PROCESS_KEYWORD = "etcd"
     MINIMAL_NODES = 3
     QUORUM = True
+    BINARY = "etcd"
+    # Reference: runtime/etcd/scripts/install.sh download recipe as data.
+    INSTALL = {
+        "type": "archive",
+        "url": ("https://github.com/etcd-io/etcd/releases/download/"
+                "v3.5.12/etcd-v3.5.12-linux-amd64.tar.gz"),
+        "strip_components": 1,
+    }
+
+    def service_command(self, node_context: Dict[str, Any]):
+        import os
+        conf = os.path.join(self.conf_dir(node_context), "etcd.yaml")
+        if not os.path.exists(conf):
+            return None  # not a quorum member on this node
+        binary = self.find_binary()
+        if binary is None:
+            return None
+        return [binary, "--config-file", conf]
 
     def node_configure(self, node_context: Dict[str, Any]) -> None:
         if not self.runs_on(node_context):

@@ -95,7 +95,12 @@ def get_runtime_cls(name: str) -> Type[Runtime]:
 
 
 def create_runtime(name: str, runtime_config: Dict[str, Any]) -> Runtime:
-    return get_runtime_cls(name)(runtime_config)
+    runtime = get_runtime_cls(name)(runtime_config)
+    # The registered name is the contract the CLI, delivery status records,
+    # and state tables key on — stamp it so consumers never have to derive
+    # a second naming scheme from the class name.
+    runtime.registered_name = name
+    return runtime
 
 
 def runtime_types(config: Dict[str, Any]) -> List[str]:

@@ -1,0 +1,200 @@
+"""Runtime software installation: the delivery install phase actually
+installs software instead of only checking for it.
+
+Round-3 verdict item 1: a fresh VM could never be bootstrapped because
+`node_install` was a presence check.  These tests install from `file://`
+archive mirrors (the air-gap/test path of runtimes/installer.py) into a
+clean TIK_HOME and drive the full install → configure → start pipeline so
+a quorum service (etcd, via a fake binary) boots from nothing.
+Reference flow: runtime/spark/scripts/install.sh:1 + runtime_scripts.py:338.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+
+import pytest
+
+from cloudtik_tpu.control.state import InMemoryStateBackend, StateClient
+from cloudtik_tpu.runtimes import delivery, installer
+from cloudtik_tpu.runtimes.common.runtime_base import ServiceRuntimeBase
+
+FAKE_ETCD = """\
+#!/usr/bin/env python3
+import re, socket, sys
+conf = sys.argv[sys.argv.index("--config-file") + 1]
+m = re.search(r"127\\.0\\.0\\.1:(\\d+)", open(conf).read())
+s = socket.socket()
+s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+s.bind(("127.0.0.1", int(m.group(1))))
+s.listen(5)
+while True:
+    conn, _ = s.accept()
+    conn.close()
+"""
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def make_release_tarball(path: str, binary_name: str, script: str,
+                         top_dir: str = "etcd-v0.0-fake") -> str:
+    """GitHub-release-style tarball: <top>/<binary> with exec mode."""
+    data = script.encode()
+    with tarfile.open(path, "w:gz") as tf:
+        info = tarfile.TarInfo(f"{top_dir}/{binary_name}")
+        info.size = len(data)
+        info.mode = 0o755
+        tf.addfile(info, io.BytesIO(data))
+    return path
+
+
+@pytest.fixture
+def tik_home_tmp(tmp_path, monkeypatch):
+    monkeypatch.setenv("TIK_HOME", str(tmp_path))
+    monkeypatch.delenv("TIK_RUNTIME_HOME", raising=False)
+    return tmp_path
+
+
+class TestInstallerArchive:
+    def test_file_url_tarball_install(self, tik_home_tmp, tmp_path):
+        tarball = make_release_tarball(
+            str(tmp_path / "rel.tar.gz"), "mysvc", "#!/bin/sh\nexit 0\n",
+            top_dir="mysvc-1.0")
+        spec = {"type": "archive", "url": f"file://{tarball}"}
+        dest = installer.install("mysvc", spec)
+        binary = os.path.join(dest, "mysvc")
+        assert os.access(binary, os.X_OK)
+        assert installer.is_installed("mysvc", spec)
+
+    def test_idempotent_and_spec_change_reinstalls(
+            self, tik_home_tmp, tmp_path):
+        t1 = make_release_tarball(
+            str(tmp_path / "v1.tar.gz"), "svc", "#!/bin/sh\necho v1\n",
+            top_dir="svc-1")
+        spec1 = {"type": "archive", "url": f"file://{t1}"}
+        installer.install("svc", spec1)
+        marker = os.path.join(installer.install_dir("svc"),
+                              ".tik-installed")
+        mtime = os.path.getmtime(marker)
+        installer.install("svc", spec1)  # no-op
+        assert os.path.getmtime(marker) == mtime
+        t2 = make_release_tarball(
+            str(tmp_path / "v2.tar.gz"), "svc", "#!/bin/sh\necho v2\n",
+            top_dir="svc-2")
+        spec2 = {"type": "archive", "url": f"file://{t2}"}
+        installer.install("svc", spec2)
+        with open(os.path.join(installer.install_dir("svc"), "svc")) as f:
+            assert "v2" in f.read()
+
+    def test_sha256_mismatch_raises(self, tik_home_tmp, tmp_path):
+        tarball = make_release_tarball(
+            str(tmp_path / "rel.tar.gz"), "svc", "#!/bin/sh\n")
+        with pytest.raises(installer.InstallError, match="sha256"):
+            installer.install("svc", {
+                "type": "archive", "url": f"file://{tarball}",
+                "sha256": "0" * 64})
+
+    def test_traversal_members_skipped(self, tik_home_tmp, tmp_path):
+        evil = tmp_path / "evil.tar.gz"
+        with tarfile.open(evil, "w:gz") as tf:
+            info = tarfile.TarInfo("top/../../escape")
+            data = b"x"
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+        installer.install("evil", {
+            "type": "archive", "url": f"file://{evil}"})
+        assert not (tmp_path / "escape").exists()
+        assert not os.path.exists(
+            os.path.join(installer.runtime_home(), "..", "escape"))
+
+    def test_script_install(self, tik_home_tmp):
+        installer.install("scripted", {
+            "type": "script",
+            "script": "mkdir -p $TIK_RUNTIME_DIR/bin && "
+                      "printf '#!/bin/sh\\n' > $TIK_RUNTIME_DIR/bin/tool "
+                      "&& chmod +x $TIK_RUNTIME_DIR/bin/tool"})
+        assert os.access(os.path.join(
+            installer.install_dir("scripted"), "bin", "tool"), os.X_OK)
+
+
+class _NeedsBinaryRuntime(ServiceRuntimeBase):
+    SERVICE_NAME = "needsbin"
+    DEFAULT_PORT = 1
+    NODE_KIND = "node"
+    BINARY = "needsbin-tool"
+
+
+class TestNodeInstallRunsSpec:
+    def test_install_spec_fetches_missing_binary(
+            self, tik_home_tmp, tmp_path):
+        tarball = make_release_tarball(
+            str(tmp_path / "nb.tar.gz"), "needsbin-tool",
+            "#!/bin/sh\nexit 0\n", top_dir="needsbin-9.9")
+        rt = _NeedsBinaryRuntime(
+            {"install": {"type": "archive", "url": f"file://{tarball}"}})
+        ctx = delivery.build_node_context(
+            {"cluster_name": "c"}, is_head=True)
+        assert rt.find_binary() is None
+        rt.node_install(ctx)
+        assert rt.find_binary() is not None
+
+    def test_no_spec_still_raises(self, tik_home_tmp):
+        rt = _NeedsBinaryRuntime({})
+        ctx = delivery.build_node_context(
+            {"cluster_name": "c"}, is_head=True)
+        with pytest.raises(RuntimeError, match="not found"):
+            rt.node_install(ctx)
+
+
+class TestCleanHomeEtcdBoot:
+    """End-to-end: clean TIK_HOME, worker node context, etcd installed
+    from a file:// mirror, configured from quorum membership, and BOOTED
+    (real process listening on the client port)."""
+
+    def test_install_configure_start(self, tik_home_tmp, tmp_path):
+        from cloudtik_tpu.runtimes.common import process_runner
+
+        tarball = make_release_tarball(
+            str(tmp_path / "etcd.tar.gz"), "etcd", FAKE_ETCD)
+        client_port = _free_port()
+        config = {
+            "cluster_name": "c", "workspace_name": "w",
+            "provider": {"type": "virtual"},
+            "available_node_types": {},
+            "runtime": {
+                "types": ["etcd"],
+                "etcd": {
+                    "port": client_port,
+                    "minimal_nodes": 1,
+                    "install": {"type": "archive",
+                                "url": f"file://{tarball}"},
+                },
+            },
+        }
+        state = StateClient(InMemoryStateBackend())
+        state.table_put("nodes", "w-1",
+                        {"kind": "worker", "ip": "127.0.0.1"})
+        ctx = delivery.build_node_context(
+            config, is_head=False, head_ip="127.0.0.1", node_id="w-1",
+            node_ip="127.0.0.1", state_client=state)
+        try:
+            delivery.install_runtimes(config, ctx)
+            assert os.access(os.path.join(
+                installer.install_dir("etcd"), "etcd"), os.X_OK)
+            delivery.configure_runtimes(config, ctx)
+            delivery.start_runtime_services(config, ctx)
+            assert process_runner.service_running("etcd")
+            assert process_runner.port_open("127.0.0.1", client_port)
+            status = delivery.runtime_status(config)
+            assert status["etcd"]["installed"]
+            assert status["etcd"]["started"]
+        finally:
+            delivery.stop_runtime_services(config, ctx)
+        assert not process_runner.service_running("etcd")
