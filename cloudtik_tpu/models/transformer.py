@@ -26,7 +26,8 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
 from cloudtik_tpu.ops.attention import attention
-from cloudtik_tpu.parallel.sharding import with_sharding_constraint
+from cloudtik_tpu.parallel.sharding import (
+    logical_axis_size, with_sharding_constraint)
 
 Params = Dict[str, Any]
 
@@ -60,6 +61,10 @@ class TransformerConfig:
     n_experts: int = 1
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # Pipeline parallelism (parallel/pipeline.py): microbatches fed through
+    # the pipe-axis stage schedule; 0 = auto (the pipe axis size).  Only
+    # consulted when the ambient mesh has pipe > 1.
+    pipeline_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -238,6 +243,26 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return rotated.astype(x.dtype)
 
 
+def _embed_lookup(embed: jax.Array, tokens: jax.Array,
+                  cfg: TransformerConfig) -> jax.Array:
+    """Token embedding lookup, sharding-aware.
+
+    With vocab sharded (tensor parallelism) a `take` gather replicates the
+    whole table every step (the involuntary-full-remat warning from
+    MULTICHIP_r03), and under a pipe mesh the partitioner's
+    gather-resharding fallback hard-crashes XLA ("Invalid binary
+    instruction opcode copy").  A one-hot contraction partitions cleanly
+    in both cases — each shard contracts its vocab slice, psum over
+    `tensor` combines on the ICI, and the MXU eats the matmul.  Pure
+    data/fsdp meshes (and single-device traces) keep the cheap gather,
+    which partitions fine when only batch is sharded."""
+    from cloudtik_tpu.parallel.pipeline import pipe_axis_size
+    if logical_axis_size("vocab") > 1 or pipe_axis_size() > 1:
+        onehot = jax.nn.one_hot(tokens, embed.shape[0], dtype=cfg.dtype)
+        return jnp.einsum("bsv,vd->bsd", onehot, embed.astype(cfg.dtype))
+    return jnp.take(embed, tokens, axis=0).astype(cfg.dtype)
+
+
 def _layer(cfg: TransformerConfig, x: jax.Array, layer: Params,
            positions: jax.Array) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     B, S, d = x.shape
@@ -304,19 +329,43 @@ def hidden_states(
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    x = _embed_lookup(params["embed"], tokens, cfg)
     x = with_sharding_constraint(x, "batch", "seq", None)
 
     layer_fn = functools.partial(_layer, cfg)
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(cfg))
 
-    def scan_body(carry, layer_params):
-        carry, aux = layer_fn(carry, layer_params, positions)
-        return carry, aux
+    from cloudtik_tpu.parallel.pipeline import pipe_axis_size, pipeline_apply
+    n_stages = pipe_axis_size()
+    if n_stages > 1:
+        # GPipe over the pipe axis: each stage scans its local layer slice;
+        # positions ride the pipeline with each microbatch.
+        if cfg.is_moe:
+            raise NotImplementedError(
+                "MoE layers under pipeline parallelism are not supported "
+                "yet (router aux losses don't cross stages)")
 
-    x, aux_stacked = jax.lax.scan(scan_body, x, params["layers"],
+        def stage(stage_params, x_micro, pos_micro):
+            def body(carry, layer_params):
+                carry, _ = layer_fn(carry, layer_params, pos_micro)
+                return carry, None
+            out, _ = jax.lax.scan(body, x_micro, stage_params,
                                   unroll=cfg.scan_unroll)
+            return out
+
+        x = pipeline_apply(
+            stage, params["layers"], x,
+            n_microbatches=cfg.pipeline_microbatches or n_stages,
+            extras=positions)
+        aux_stacked: Dict[str, jax.Array] = {}
+    else:
+        def scan_body(carry, layer_params):
+            carry, aux = layer_fn(carry, layer_params, positions)
+            return carry, aux
+
+        x, aux_stacked = jax.lax.scan(scan_body, x, params["layers"],
+                                      unroll=cfg.scan_unroll)
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     aux = {k: v.mean() for k, v in aux_stacked.items()}
     return x, aux
@@ -391,7 +440,14 @@ def loss_fn(
         valid = label_chunk != -100
         safe = jnp.where(valid, label_chunk, 0)
         logp = jax.nn.log_softmax(logits, axis=-1)
-        token_logp = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        if logical_axis_size("vocab") > 1:
+            # sharded vocab: one-hot contraction partitions (psum over
+            # `tensor`) where take_along_axis would replicate the logits
+            onehot = jax.nn.one_hot(safe, logp.shape[-1], dtype=logp.dtype)
+            token_logp = jnp.einsum("bcv,bcv->bc", logp, onehot)
+        else:
+            token_logp = jnp.take_along_axis(
+                logp, safe[..., None], axis=-1)[..., 0]
         correct = (logits.argmax(-1) == label_chunk) & valid
         return (-(token_logp * valid).sum(), valid.sum(), correct.sum())
 

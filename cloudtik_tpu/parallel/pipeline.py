@@ -1,0 +1,139 @@
+"""Pipeline parallelism over the `pipe` mesh axis (GPipe schedule).
+
+The reference has no pipeline parallelism at all (SURVEY.md §2.4: DP-only
+data plane); `pipe` is part of this framework's first-class parallelism
+vocabulary (parallel/mesh.py:33).  The TPU-native formulation: the layer
+stack [L, ...] is sharded over `pipe` so each device group holds L/P
+contiguous layers, microbatches flow stage-to-stage over the ICI via
+`lax.ppermute` inside a `lax.scan` of M + P - 1 ticks (fill + steady state
++ drain), and everything lives inside ONE jit program — XLA overlaps each
+tick's compute with the neighbor permute.  Autodiff runs through the scan
+and transposes the ppermute, giving the backward pipeline for free; the
+other mesh axes (data/fsdp/tensor/seq) stay GSPMD-managed via shard_map's
+partial-auto mode (`axis_names={"pipe"}`).
+
+Bubble fraction is the GPipe (P-1)/(M+P-1); pick n_microbatches a few
+multiples of the stage count to amortize.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def pipe_axis_size(axis: str = "pipe") -> int:
+    """Size of the pipe axis on the ambient mesh (1 = no pipelining)."""
+    from cloudtik_tpu.parallel.sharding import mesh_axis_size
+    return mesh_axis_size(axis)
+
+
+def pipeline_apply(
+    stage_fn: Callable[..., jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    *,
+    n_microbatches: int,
+    extras: Any = None,
+    axis: str = "pipe",
+) -> jax.Array:
+    """Apply a pipe-sharded layer stack to x with a GPipe schedule.
+
+    stage_fn(stage_params, x_micro, extras_micro) -> y_micro applies one
+    stage's local slice of the layer stack; y must have x's shape/dtype
+    (residual-stream semantics).  stacked_params is a pytree whose leaves
+    have leading dim L, sharded over `axis` (rule "layers" -> "pipe").
+    x: [B, ...] with B divisible by n_microbatches.  extras: optional
+    pytree of per-example arrays ([B, ...]) each stage needs for its
+    current microbatch (e.g. positions); they ride the pipeline alongside
+    the activations.  With no `pipe` axis on the mesh (or size 1) this
+    reduces to running all layers locally — same code, any mesh.
+    """
+    n_stages = pipe_axis_size(axis)
+    M = n_microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(
+            f"batch {B} not divisible by n_microbatches {M}")
+    if n_stages == 1:
+        return stage_fn(stacked_params, x, extras)
+
+    # The activation boundary crosses in f32 both directions: a replicated
+    # (P()) shard_map input transposes to a psum of cotangents, and bf16
+    # reduce collectives under partial-auto shard_map hard-crash XLA's
+    # SPMD partitioner ("Invalid binary instruction opcode copy").  Compute
+    # inside the stages stays in x.dtype.
+    xs = x.reshape(M, B // M, *x.shape[1:]).astype(jnp.float32)
+    extras_s = jax.tree.map(
+        lambda e: e.reshape(M, B // M, *e.shape[1:]), extras)
+
+    inner = functools.partial(
+        _staged, stage_fn, n_stages=n_stages, n_micro=M, axis=axis,
+        dtype=x.dtype)
+    # Manual over `pipe` only: params enter stage-sliced on the stacked
+    # layer dim; activations replicated across pipe (other axes stay auto).
+    return jax.shard_map(
+        inner,
+        in_specs=(jax.tree.map(lambda _: P(axis), stacked_params),
+                  P(), jax.tree.map(lambda _: P(), extras_s)),
+        out_specs=P(),
+        axis_names={axis},
+        check_vma=False,
+    )(stacked_params, xs, extras_s).astype(x.dtype).reshape(
+        B, *x.shape[1:])
+
+
+def _staged(stage_fn, params_local, xs, extras_s, *, n_stages, n_micro,
+            axis, dtype):
+    """Body run per pipe group: M + P - 1 ticks of compute + ppermute."""
+    xs = xs.astype(dtype)  # back to compute dtype past the f32 boundary
+    idx = lax.axis_index(axis)
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    x_shape = xs.shape[1:]
+
+    def tick(carry, t):
+        state, state_extras, outputs = carry
+        mb = jnp.clip(t, 0, n_micro - 1)
+        inp = lax.dynamic_index_in_dim(xs, mb, 0, keepdims=False)
+        inp_extras = jax.tree.map(
+            lambda e: lax.dynamic_index_in_dim(e, mb, 0, keepdims=False),
+            extras_s)
+        # Stage 0 consumes a fresh microbatch; later stages consume what
+        # the previous stage permuted to them last tick.
+        x_in = jnp.where(idx == 0, inp, state)
+        e_in = jax.tree.map(
+            lambda fresh, held: jnp.where(idx == 0, fresh, held),
+            inp_extras, state_extras)
+        y = stage_fn(params_local, x_in, e_in)
+        # Last stage emits finished microbatch t - (P-1).
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        cur = lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+        emit = jnp.where((idx == n_stages - 1) & (t >= n_stages - 1),
+                         y, cur)
+        outputs = lax.dynamic_update_index_in_dim(outputs, emit, out_idx, 0)
+        state = lax.ppermute(y, axis, perm)
+        state_extras = jax.tree.map(
+            lambda e: lax.ppermute(e, axis, perm), e_in)
+        return (state, state_extras, outputs), None
+
+    carry0 = (
+        jnp.zeros(x_shape, xs.dtype),
+        jax.tree.map(
+            lambda e: jnp.zeros(e.shape[1:], e.dtype), extras_s),
+        jnp.zeros_like(xs),
+    )
+    (_, _, outputs), _ = lax.scan(
+        tick, carry0, jnp.arange(n_micro + n_stages - 1))
+    # Only the last stage holds real outputs; all_gather + index broadcasts
+    # them so the (replicated-over-pipe) caller continues identically
+    # everywhere.  The f32 round-trip matters: bf16 reduce collectives
+    # (psum forward, psum-scatter as this gather's transpose) under
+    # partial-auto shard_map hard-crash XLA's SPMD partitioner ("Invalid
+    # binary instruction opcode copy"), so both directions must ride f32.
+    return lax.all_gather(
+        outputs.astype(jnp.float32), axis)[n_stages - 1]

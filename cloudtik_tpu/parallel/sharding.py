@@ -30,7 +30,8 @@ DEFAULT_RULES: AxisRules = (
     ("kv", None),
     ("vocab", "tensor"),
     ("expert", "expert"),
-    ("layers", None),           # scanned layer stack axis stays replicated
+    ("layers", "pipe"),         # layer stack staged over pipeline axis
+    #                             (replicated when the mesh has no pipe)
     ("norm", None),
     ("conv_in", "fsdp"),        # conv kernels: rows FSDP, cols TP
     ("conv_out", "tensor"),
@@ -139,6 +140,43 @@ def batch_sharding(mesh: Mesh, rules: AxisRules = DEFAULT_RULES) -> NamedShardin
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def mesh_axis_size(axis: str) -> int:
+    """Size of a named axis on the ambient mesh (1 = absent or no mesh).
+
+    The single probe every mesh-aware code path shares (pipeline stage
+    count, sharded-vocab dispatch, ring-attention seq size)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty or axis not in mesh.axis_names:
+        return 1
+    return mesh.shape[axis]
+
+
+def mesh_is_sharded() -> bool:
+    """True when the ambient mesh has any nontrivial axis (i.e. the trace
+    is a real SPMD program, not single-device)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return False
+    return any(mesh.shape[a] > 1 for a in mesh.axis_names)
+
+
+def logical_axis_size(
+    name: str, rules: AxisRules = DEFAULT_RULES
+) -> int:
+    """Product of the ambient-mesh sizes a logical axis maps onto (1 when
+    tracing without a mesh).  Lets model code pick sharding-friendly
+    formulations (e.g. one-hot contraction vs gather over a sharded vocab)
+    without threading the mesh through every call."""
+    import math
+
+    axes = dict(rules).get(name)
+    if axes is None:
+        return 1
+    if isinstance(axes, str):
+        axes = (axes,)
+    return math.prod(mesh_axis_size(a) for a in axes)
 
 
 def with_sharding_constraint(

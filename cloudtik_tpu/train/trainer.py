@@ -283,15 +283,34 @@ class Trainer:
         num_steps: int,
         rng: Optional[jax.Array] = None,
         callbacks: Optional[list] = None,
+        profile_dir: Optional[str] = None,
     ) -> Dict[str, Any]:
+        """Run `num_steps` training steps.
+
+        profile_dir: when set, capture a JAX profiler (xprof) trace of the
+        whole window into that directory — the diagnosis tool the round-3
+        bench regressions lacked (SURVEY.md §5 tracing directive).  View
+        with tensorboard or xprof.
+        """
         if self.state is None:
             self.init_state(rng if rng is not None else jax.random.PRNGKey(0))
         jitted = self.compile_step()
-        callbacks = callbacks or []
+        if profile_dir:
+            jax.profiler.start_trace(profile_dir)
+        try:
+            return self._fit_loop(data_iter, num_steps, jitted,
+                                  callbacks or [])
+        finally:
+            if profile_dir:
+                jax.block_until_ready(
+                    jax.tree.leaves(self.state)[0])
+                jax.profiler.stop_trace()
+
+    def _fit_loop(self, data_iter, num_steps, jitted,
+                  callbacks) -> Dict[str, Any]:
         tokens_per_step = self.config.global_batch_size * self.config.seq_len
         peak = device_peak_flops()
         n_devices = self.mesh.devices.size
-
         history = []
         t_window = time.perf_counter()
         window_steps = 0
