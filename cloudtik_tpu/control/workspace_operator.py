@@ -22,10 +22,34 @@ def create_workspace(config: Dict[str, Any], yes: bool = False) -> None:
     if existence == Existence.COMPLETED:
         cli_logger.info("Workspace {} already exists.",
                         config["workspace_name"])
+        # Managed infra may have been added to the config after the
+        # workspace was created; provider create() calls are idempotent.
+        _create_managed_infra(config)
         return
     cli_logger.confirm(yes, "Create workspace {}?", config["workspace_name"])
     provider.create_workspace(config)
+    _create_managed_infra(config)
     cli_logger.success("Workspace {} created.", config["workspace_name"])
+
+
+def _create_managed_infra(config: Dict[str, Any]) -> None:
+    """Provision managed storage/database declared in the workspace config
+    (reference: gcp/config.py optional managed GCS bucket / Cloud SQL,
+    SURVEY.md §3.5)."""
+    from cloudtik_tpu.providers.factory import (
+        create_database_provider, create_storage_provider)
+
+    for name, storage_config in (config.get("managed_storage")
+                                 or {}).items():
+        sp = create_storage_provider(
+            config["provider"], config["workspace_name"], name)
+        sp.create(dict(config, storage=storage_config or {}))
+        cli_logger.info("Managed storage {} provisioned.", name)
+    for name, db_config in (config.get("managed_database") or {}).items():
+        dp = create_database_provider(
+            config["provider"], config["workspace_name"], name)
+        dp.create(dict(config, database=db_config or {}))
+        cli_logger.info("Managed database {} provisioned.", name)
 
 
 def delete_workspace(
@@ -41,6 +65,18 @@ def delete_workspace(
                         config["workspace_name"])
         return
     cli_logger.confirm(yes, "Delete workspace {}?", config["workspace_name"])
+    from cloudtik_tpu.providers.factory import (
+        create_database_provider, create_storage_provider)
+    if delete_managed_storage:
+        for name in (config.get("managed_storage") or {}):
+            create_storage_provider(
+                config["provider"], config["workspace_name"],
+                name).delete(config)
+    if delete_managed_database:
+        for name in (config.get("managed_database") or {}):
+            create_database_provider(
+                config["provider"], config["workspace_name"],
+                name).delete(config)
     provider.delete_workspace(
         config, delete_managed_storage=delete_managed_storage,
         delete_managed_database=delete_managed_database)
@@ -52,6 +88,7 @@ def update_workspace(config: Dict[str, Any], yes: bool = False) -> None:
         config["provider"], config["workspace_name"])
     cli_logger.confirm(yes, "Update workspace {}?", config["workspace_name"])
     provider.update_workspace(config)
+    _create_managed_infra(config)
     cli_logger.success("Workspace {} updated.", config["workspace_name"])
 
 

@@ -31,6 +31,21 @@ _WORKSPACE_PROVIDERS: Dict[str, str] = {
     "aws": "cloudtik_tpu.providers.aws.workspace_provider:AWSWorkspaceProvider",
 }
 
+_STORAGE_PROVIDERS: Dict[str, str] = {
+    "gcp": "cloudtik_tpu.providers.gcp.storage_provider:GCSStorageProvider",
+    "aws": "cloudtik_tpu.providers.aws.storage_provider:S3StorageProvider",
+}
+
+_DATABASE_PROVIDERS: Dict[str, str] = {
+    "gcp": "cloudtik_tpu.providers.gcp.database_provider:CloudSQLDatabaseProvider",
+    "aws": "cloudtik_tpu.providers.aws.database_provider:RDSDatabaseProvider",
+}
+
+_LOAD_BALANCER_PROVIDERS: Dict[str, str] = {
+    "gcp": "cloudtik_tpu.providers.gcp.load_balancer_provider:GCPLoadBalancerProvider",
+    "aws": "cloudtik_tpu.providers.aws.load_balancer_provider:AWSLoadBalancerProvider",
+}
+
 
 def _load(spec: str):
     module_name, _, cls_name = spec.partition(":")
@@ -77,3 +92,41 @@ def create_workspace_provider(provider_config: Dict[str, Any],
                               workspace_name: str) -> WorkspaceProvider:
     return get_workspace_provider_cls(provider_config)(
         provider_config, workspace_name)
+
+
+def _shared_infra_cls(registry: Dict[str, str], module_key: str,
+                      provider_config: Dict[str, Any], kind: str):
+    if provider_config.get(module_key):
+        return _load(provider_config[module_key])
+    ptype = provider_config.get("type")
+    spec = registry.get(ptype)
+    if spec is None:
+        raise ValueError(
+            f"No {kind} provider for type {ptype!r}; known: "
+            f"{sorted(registry)}")
+    return _load(spec)
+
+
+def create_storage_provider(provider_config: Dict[str, Any],
+                            workspace_name: str, storage_name: str):
+    """Reference parity: core/storage_provider.py:10 + provider factory."""
+    cls = _shared_infra_cls(_STORAGE_PROVIDERS, "storage_module",
+                            provider_config, "storage")
+    return cls(provider_config, workspace_name, storage_name)
+
+
+def create_database_provider(provider_config: Dict[str, Any],
+                             workspace_name: str, database_name: str):
+    """Reference parity: core/database_provider.py:10 + provider factory."""
+    cls = _shared_infra_cls(_DATABASE_PROVIDERS, "database_module",
+                            provider_config, "database")
+    return cls(provider_config, workspace_name, database_name)
+
+
+def create_load_balancer_provider(provider_config: Dict[str, Any],
+                                  workspace_name: str):
+    """Reference parity: core/load_balancer_provider.py:27 + factory."""
+    cls = _shared_infra_cls(_LOAD_BALANCER_PROVIDERS,
+                            "load_balancer_module",
+                            provider_config, "load balancer")
+    return cls(provider_config, workspace_name)

@@ -121,7 +121,7 @@ class LoadBalancerRuntime(ServiceRuntimeBase):
     SERVICE_NAME = "loadbalancer"
     DEFAULT_PORT = 0
     NODE_KIND = HEAD
-    PROCESS_KEYWORD = "tik-lb-controller"
+    PROCESS_KEYWORD = "cloudtik_tpu.runtimes.loadbalancer.sync"
 
     def get_runtime_services(self, cluster_config, cluster_head_ip):
         return None  # controller only; exposes nothing itself
@@ -131,3 +131,35 @@ class LoadBalancerRuntime(ServiceRuntimeBase):
 
     def get_health_check(self, cluster_config):
         return None
+
+    def node_services(self, node_context: Dict[str, Any],
+                      command: str) -> None:
+        """Spawn/stop the LB reconcile daemon on the head (reference:
+        scripting.py:108 start_controller)."""
+        import json
+        import sys
+
+        from cloudtik_tpu.runtimes.common import process_runner
+        from cloudtik_tpu.utils.constants import TIK_STATE_PORT_DEFAULT
+
+        if not node_context.get("is_head"):
+            return
+        name = "lb-controller"
+        if command == "stop":
+            process_runner.stop_service(name)
+            return
+        if command != "start":
+            raise ValueError(f"unknown services command {command!r}")
+        config = node_context.get("config", {})
+        cmd = [sys.executable, "-m",
+               "cloudtik_tpu.runtimes.loadbalancer.sync",
+               "--head-ip", node_context.get("head_ip", "127.0.0.1"),
+               "--state-port",
+               str(config.get("state_port", TIK_STATE_PORT_DEFAULT)),
+               "--cluster", config.get("cluster_name", ""),
+               "--workspace", config.get("workspace_name", ""),
+               "--interval",
+               str(self.runtime_config.get("reconcile_interval_s", 15.0)),
+               "--provider-config",
+               json.dumps(config.get("provider", {}))]
+        process_runner.spawn_service(name, cmd)

