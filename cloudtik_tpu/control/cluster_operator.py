@@ -584,3 +584,39 @@ def monitor_cluster(config: Dict[str, Any], follow: bool = False) -> str:
         return json.dumps(status, indent=2, default=str)
     finally:
         provider.cleanup()
+
+
+def dump_cluster(
+    config: Dict[str, Any],
+    output_path: Optional[str] = None,
+    include_nodes: bool = True,
+) -> str:
+    """Collect a debug archive: local artifacts + every node's logs.
+
+    Reference parity: cluster_operator.dump_cluster:2026 +
+    cluster_dump.py:783 (`cloudtik cluster-dump`).
+    """
+    from cloudtik_tpu.control import cluster_dump
+
+    config = bootstrap_config(config)
+    provider = create_node_provider(
+        config["provider"], config["cluster_name"])
+
+    def collect(staging: str) -> None:
+        cluster_dump.collect_local(staging)
+        if not include_nodes:
+            return
+        for node_id in provider.non_terminated_nodes({}):
+            executor = make_command_executor(
+                CallContext(), f"[{node_id}] ", node_id, provider,
+                config.get("auth", {}), config["cluster_name"],
+                docker_config=config.get("docker"))
+            cluster_dump.collect_from_node(node_id, executor, staging)
+
+    try:
+        path = cluster_dump.create_archive(
+            output_path, config["cluster_name"], collect)
+    finally:
+        provider.cleanup()
+    cli_logger.success("Cluster dump written to {}.", path)
+    return path

@@ -1,0 +1,307 @@
+"""Detection ops for TPU: NMS, ROIAlign, sigmoid focal loss.
+
+Reference parity: the maskrcnn-benchmark custom C++/CUDA kernel set the
+reference vendors (applications/.../maskrcnn_benchmark/csrc/vision.cpp —
+nms_cpu.cpp, ROIAlign_cpu.cpp, SigmoidFocalLoss; SURVEY.md §2.5 requires
+TPU-native equivalents, not omission).  These are NOT ports of those
+scalar loops — each op is re-derived for the TPU's units:
+
+* NMS — one Pallas program holding boxes/scores in VMEM; a fori_loop of
+  (argmax -> IoU row against ALL boxes -> mask) steps.  The O(N) IoU row
+  per selection is pure vector-unit work, replacing the reference's
+  O(N^2) scalar triangle walk.
+* ROIAlign — bilinear sampling recast as two small matmuls per ROI:
+  out = Wy @ F @ Wx^T, where Wy/Wx are interpolation-weight matrices
+  (hat-function rows built from iota, no gathers — TPU VMEM has no cheap
+  dynamic gather, the MXU eats structured matmuls).  Sample-grid
+  averaging folds into the weight rows.
+* sigmoid focal loss — elementwise; XLA fuses it, no kernel needed.
+
+Each Pallas op has a jnp reference (`*_reference`) used by interpret-mode
+parity tests and as the CPU fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+# --------------------------------------------------------------------------
+# IoU (shared)
+# --------------------------------------------------------------------------
+
+def box_iou(boxes_a: jax.Array, boxes_b: jax.Array) -> jax.Array:
+    """Pairwise IoU.  boxes [*, 4] as (x1, y1, x2, y2)."""
+    area_a = ((boxes_a[..., 2] - boxes_a[..., 0])
+              * (boxes_a[..., 3] - boxes_a[..., 1]))
+    area_b = ((boxes_b[..., 2] - boxes_b[..., 0])
+              * (boxes_b[..., 3] - boxes_b[..., 1]))
+    lt = jnp.maximum(boxes_a[..., None, :2], boxes_b[None, :, :2])
+    rb = jnp.minimum(boxes_a[..., None, 2:], boxes_b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[..., None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+# --------------------------------------------------------------------------
+# NMS
+# --------------------------------------------------------------------------
+
+def _nms_select_rows(xyxy: jax.Array, scores: jax.Array,
+                     iou_threshold: float, max_output: int) -> jax.Array:
+    """Selection loop in mask/reduction form: xyxy [4, N], scores [1, N]
+    -> keep [1, K].  No dynamic slicing anywhere — the winner's scalars
+    are extracted with one-hot masked reductions and the keep vector is
+    written with an iota==k mask, which is what the TPU vector unit can
+    lower (Mosaic has no dynamic_slice on VMEM vectors)."""
+    n = scores.shape[1]
+    x1, y1 = xyxy[0:1, :], xyxy[1:2, :]
+    x2, y2 = xyxy[2:3, :], xyxy[3:4, :]
+    areas = (x2 - x1) * (y2 - y1)
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
+    kcol = jax.lax.broadcasted_iota(jnp.int32, (1, max_output), 1)
+
+    def pick(onehot, row):
+        return jnp.sum(jnp.where(onehot, row, 0.0))
+
+    def body(k, carry):
+        live, keep = carry
+        m = jnp.max(live)
+        valid = m > _NEG_INF / 2
+        best = jnp.min(jnp.where(live == m, col, n))  # first argmax
+        onehot = col == best
+        bx1, by1 = pick(onehot, x1), pick(onehot, y1)
+        bx2, by2 = pick(onehot, x2), pick(onehot, y2)
+        barea = pick(onehot, areas)
+        inter = (jnp.clip(jnp.minimum(bx2, x2) - jnp.maximum(bx1, x1), 0)
+                 * jnp.clip(jnp.minimum(by2, y2)
+                            - jnp.maximum(by1, y1), 0))
+        iou = inter / jnp.maximum(barea + areas - inter, 1e-9)
+        suppress = (iou > iou_threshold) | onehot
+        live = jnp.where(valid & suppress, _NEG_INF, live)
+        keep = jnp.where((kcol == k) & valid, best, keep)
+        return live, keep
+
+    _, keep = jax.lax.fori_loop(
+        0, max_output, body,
+        (scores, jnp.full((1, max_output), -1, jnp.int32)))
+    return keep
+
+
+def _nms_kernel(xyxy_ref, scores_ref, keep_ref, *, iou_threshold: float,
+                max_output: int):
+    keep_ref[...] = _nms_select_rows(
+        xyxy_ref[...], scores_ref[...], iou_threshold, max_output)
+
+
+def nms(boxes: jax.Array, scores: jax.Array, *,
+        iou_threshold: float = 0.5, max_output: int = 100,
+        interpret: bool = False) -> jax.Array:
+    """Non-maximum suppression.  boxes [N, 4], scores [N] ->
+    keep indices [max_output] int32, -1-padded, in descending score
+    order.  Reference parity: nms_cpu.cpp (maskrcnn csrc)."""
+    n = boxes.shape[0]
+    if scores.shape != (n,):
+        raise ValueError(f"scores {scores.shape} vs boxes {boxes.shape}")
+    keep = pl.pallas_call(
+        functools.partial(_nms_kernel, iou_threshold=float(iou_threshold),
+                          max_output=int(max_output)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, max_output), jnp.int32),
+        interpret=interpret,
+    )(boxes.astype(jnp.float32).T, scores.astype(jnp.float32)[None, :])
+    return keep[0]
+
+
+def nms_reference(boxes: jax.Array, scores: jax.Array, *,
+                  iou_threshold: float = 0.5,
+                  max_output: int = 100) -> jax.Array:
+    """Pure-jnp NMS with identical semantics (test oracle/CPU path)."""
+    keep = _nms_select_rows(
+        boxes.astype(jnp.float32).T, scores.astype(jnp.float32)[None, :],
+        float(iou_threshold), int(max_output))
+    return keep[0]
+
+
+# --------------------------------------------------------------------------
+# ROIAlign
+# --------------------------------------------------------------------------
+
+def _axis_weights(start: jax.Array, bin_size: jax.Array, sampling: int,
+                  pooled: int, size: int) -> jax.Array:
+    """Pooled bilinear weight matrix [pooled, size]: row p is the MEAN of
+    its `sampling` samples' hat weights max(0, 1 - |coord - q|), with
+    coord = start + (p*sampling + j + 0.5) * bin/sampling - 0.5 (clipped).
+    Folding the sample average into the weights makes the whole ROIAlign
+    one Wy @ F @ Wx^T per ROI — no post-matmul reshape/mean (Mosaic
+    rejects non-tile reshapes) and no gathers.  2-D int iota only (Mosaic
+    has neither 1-D nor float iota)."""
+    p = jax.lax.broadcasted_iota(
+        jnp.int32, (pooled, size), 0).astype(jnp.float32)
+    grid = jax.lax.broadcasted_iota(
+        jnp.int32, (pooled, size), 1).astype(jnp.float32)
+    acc = jnp.zeros((pooled, size), jnp.float32)
+    for j in range(sampling):  # static, tiny (typically 1-2)
+        coords = start + (p * sampling + j + 0.5) * bin_size / sampling - 0.5
+        coords = jnp.clip(coords, 0.0, size - 1.0)
+        acc = acc + jnp.maximum(0.0, 1.0 - jnp.abs(coords - grid))
+    return acc / sampling
+
+
+def _roi_sample_coords(roi: jax.Array, pooled: int, sampling: int,
+                       spatial_scale: float) -> Tuple[jax.Array, jax.Array]:
+    """Per-axis sample coordinates ([pooled*sampling] each) for one ROI
+    (x1, y1, x2, y2), matching ROIAlign's aligned=False convention."""
+    x1, y1, x2, y2 = roi[0], roi[1], roi[2], roi[3]
+    w = jnp.maximum((x2 - x1) * spatial_scale, 1.0)
+    h = jnp.maximum((y2 - y1) * spatial_scale, 1.0)
+    bin_w = w / pooled
+    bin_h = h / pooled
+    s = jnp.arange(pooled * sampling, dtype=jnp.float32)
+    xs = (x1 * spatial_scale + (s + 0.5) * bin_w / sampling)
+    ys = (y1 * spatial_scale + (s + 0.5) * bin_h / sampling)
+    return ys - 0.5, xs - 0.5  # pixel-center convention
+
+
+def _roi_align_one(features: jax.Array, roi: jax.Array, *, pooled: int,
+                   sampling: int, spatial_scale: float) -> jax.Array:
+    """[C, H, W] x roi[4] -> [C, pooled, pooled] via Wy @ F @ Wx^T."""
+    C, H, W = features.shape
+    x1, y1, x2, y2 = roi[0], roi[1], roi[2], roi[3]
+    w = jnp.maximum((x2 - x1) * spatial_scale, 1.0)
+    h = jnp.maximum((y2 - y1) * spatial_scale, 1.0)
+    wy = _axis_weights(y1 * spatial_scale, h / pooled, sampling,
+                       pooled, H)
+    wx = _axis_weights(x1 * spatial_scale, w / pooled, sampling,
+                       pooled, W)
+    # Two separate contractions: a single 3-operand einsum makes XLA
+    # collapse (c, h) into a non-tile reshape Mosaic cannot lay out.
+    # The second contraction batches over c explicitly (broadcast wy) —
+    # an unbatched chq,ph einsum also triggers the collapse-reshape.
+    # precision=HIGHEST: the MXU's default bf16 multiplies cost ~1e-2
+    # absolute error on interpolation weights
+    t = jnp.einsum("chw,qw->chq", features, wx,
+                   preferred_element_type=jnp.float32,
+                   precision=jax.lax.Precision.HIGHEST)
+    wy_b = jnp.broadcast_to(wy, (C,) + wy.shape)
+    return jnp.einsum("cph,chq->cpq", wy_b, t,
+                      preferred_element_type=jnp.float32,
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+def _roi_align_kernel(rois_ref, features_ref, out_ref, *, pooled: int,
+                      sampling: int, spatial_scale: float):
+    r = pl.program_id(0)
+    # rois ride SMEM via scalar prefetch: per-ROI scalars support the
+    # dynamic row index (VMEM vectors would not, and a (1, 4) VMEM block
+    # violates the TPU's (8, 128) tiling anyway).
+    roi = jnp.stack([rois_ref[r, 0], rois_ref[r, 1],
+                     rois_ref[r, 2], rois_ref[r, 3]])
+    out_ref[0] = _roi_align_one(
+        features_ref[...], roi, pooled=pooled, sampling=sampling,
+        spatial_scale=spatial_scale)
+
+
+def roi_align(features: jax.Array, rois: jax.Array, *,
+              pooled_size: int = 7, sampling_ratio: int = 2,
+              spatial_scale: float = 1.0,
+              interpret: bool = False) -> jax.Array:
+    """ROIAlign.  features [C, H, W], rois [R, 4] (x1,y1,x2,y2 in input
+    coordinates) -> [R, C, pooled, pooled].  Reference parity:
+    ROIAlign_cpu.cpp — re-derived as interpolation-weight matmuls (the
+    MXU path) instead of per-sample gathers."""
+    C, H, W = features.shape
+    R = rois.shape[0]
+    return pl.pallas_call(
+        functools.partial(
+            _roi_align_kernel, pooled=int(pooled_size),
+            sampling=int(sampling_ratio),
+            spatial_scale=float(spatial_scale)),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(R,),
+            in_specs=[
+                pl.BlockSpec((C, H, W), lambda r, *_: (0, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, C, pooled_size, pooled_size),
+                lambda r, *_: (r, 0, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (R, C, pooled_size, pooled_size), jnp.float32),
+        interpret=interpret,
+    )(rois.astype(jnp.float32), features.astype(jnp.float32))
+
+
+def roi_align_reference(features: jax.Array, rois: jax.Array, *,
+                        pooled_size: int = 7, sampling_ratio: int = 2,
+                        spatial_scale: float = 1.0) -> jax.Array:
+    """Gather-based bilinear ROIAlign (independent math; test oracle)."""
+    C, H, W = features.shape
+
+    def one(roi):
+        ys, xs = _roi_sample_coords(
+            roi, pooled_size, sampling_ratio, spatial_scale)
+        ys = jnp.clip(ys, 0.0, H - 1.0)
+        xs = jnp.clip(xs, 0.0, W - 1.0)
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, W - 1)
+        y1 = jnp.clip(y0 + 1, 0, H - 1)
+        x1 = jnp.clip(x0 + 1, 0, W - 1)
+        wy1 = ys - y0
+        wx1 = xs - x0
+
+        def sample(yi, xi):
+            return features[:, yi, :][:, :, xi]  # [C, S, S]
+
+        val = (sample(y0, x0) * ((1 - wy1)[:, None] * (1 - wx1)[None, :])
+               + sample(y0, x1) * ((1 - wy1)[:, None] * wx1[None, :])
+               + sample(y1, x0) * (wy1[:, None] * (1 - wx1)[None, :])
+               + sample(y1, x1) * (wy1[:, None] * wx1[None, :]))
+        val = val.reshape(C, pooled_size, sampling_ratio,
+                          pooled_size, sampling_ratio)
+        return val.mean(axis=(2, 4))
+
+    return jax.vmap(one)(rois.astype(jnp.float32))
+
+
+# --------------------------------------------------------------------------
+# Sigmoid focal loss
+# --------------------------------------------------------------------------
+
+def sigmoid_focal_loss(logits: jax.Array, targets: jax.Array, *,
+                       alpha: float = 0.25, gamma: float = 2.0,
+                       reduction: str = "sum") -> jax.Array:
+    """Focal loss for dense detection (reference: SigmoidFocalLoss csrc).
+
+    logits [*, K], targets [*, K] in {0, 1}.  Elementwise — XLA fuses the
+    whole thing; a kernel would only add launch overhead."""
+    p = jax.nn.sigmoid(logits)
+    ce = optax_sigmoid_ce(logits, targets)
+    p_t = p * targets + (1 - p) * (1 - targets)
+    loss = ce * ((1 - p_t) ** gamma)
+    if alpha >= 0:
+        alpha_t = alpha * targets + (1 - alpha) * (1 - targets)
+        loss = alpha_t * loss
+    if reduction == "sum":
+        return loss.sum()
+    if reduction == "mean":
+        return loss.mean()
+    return loss
+
+
+def optax_sigmoid_ce(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Numerically-stable sigmoid cross entropy."""
+    return jnp.maximum(logits, 0) - logits * labels + jnp.log1p(
+        jnp.exp(-jnp.abs(logits)))

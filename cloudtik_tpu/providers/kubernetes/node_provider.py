@@ -127,6 +127,27 @@ class KubernetesNodeProvider(NodeProvider):
             return None
         return {node_id: "deleting"}
 
+    # -- wiring ------------------------------------------------------------
+    def get_command_executor(
+        self, call_context, log_prefix, node_id, auth_config,
+        cluster_name, process_runner=None, use_internal_ip=False,
+        docker_config=None,
+    ):
+        """Pods are reached with kubectl exec/cp, not SSH (reference:
+        kubernetes_command_executor.py:27)."""
+        from cloudtik_tpu.control.executor.kubernetes import (
+            KubernetesCommandExecutor)
+
+        return KubernetesCommandExecutor(
+            call_context=call_context,
+            node_id=node_id,
+            namespace=self.namespace,
+            container=self.provider_config.get("container"),
+            process_runner=process_runner,
+            log_prefix=log_prefix,
+            kubectl=self.provider_config.get("kubectl", "kubectl"),
+        )
+
     @staticmethod
     def validate_config(provider_config: Dict[str, Any]) -> None:
         return None

