@@ -29,6 +29,9 @@ _WORKSPACE_PROVIDERS: Dict[str, str] = {
     "virtual": "cloudtik_tpu.providers.virtual.workspace_provider:VirtualWorkspaceProvider",
     "gcp": "cloudtik_tpu.providers.gcp.workspace_provider:GCPWorkspaceProvider",
     "aws": "cloudtik_tpu.providers.aws.workspace_provider:AWSWorkspaceProvider",
+    "azure": "cloudtik_tpu.providers.azure.workspace_provider:AzureWorkspaceProvider",
+    "aliyun": "cloudtik_tpu.providers.aliyun.workspace_provider:AliyunWorkspaceProvider",
+    "huaweicloud": "cloudtik_tpu.providers.huaweicloud.workspace_provider:HuaweiCloudWorkspaceProvider",
 }
 
 _STORAGE_PROVIDERS: Dict[str, str] = {

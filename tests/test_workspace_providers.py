@@ -1,0 +1,277 @@
+"""Azure / Aliyun / Huawei workspace providers against fake SDK clients.
+
+Round-3 verdict item 6: only GCP/AWS/virtual had workspace bootstrap.
+Each fake implements the injectable client surface its provider declares
+(snake_case methods mirroring the node providers' client convention);
+tests run create -> COMPLETED -> idempotent re-create -> delete ->
+NOT_EXIST.  Reference: providers/_private/_azure/workspace_provider.py,
+aliyun/config.py, huaweicloud/config.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import pytest
+
+from cloudtik_tpu.core.workspace_provider import Existence
+from cloudtik_tpu.providers.factory import create_workspace_provider
+
+
+# ---------------------------------------------------------------- azure --
+
+class _Poller:
+    def __init__(self, value=None):
+        self._value = value
+
+    def result(self):
+        return self._value
+
+
+class FakeAzureResourceGroups:
+    def __init__(self):
+        self.groups: Dict[str, Dict[str, Any]] = {}
+
+    def create_or_update(self, name, params):
+        self.groups[name] = params
+        return params
+
+    def get(self, name):
+        return self.groups[name]
+
+    def begin_delete(self, name):
+        self.groups.pop(name)
+        return _Poller()
+
+
+class _AzureCollection:
+    """create_or_update/get keyed by the full arg tuple minus params.
+    Models resource-group containment: once the group is deleted, gets
+    404 like real ARM."""
+
+    def __init__(self, groups: FakeAzureResourceGroups):
+        self._groups = groups
+        self.items: Dict[tuple, Dict[str, Any]] = {}
+
+    def begin_create_or_update(self, *args):
+        *key, params = args
+        self.items[tuple(key)] = params
+        return _Poller(params)
+
+    def get(self, *key):
+        if key[0] not in self._groups.groups:
+            raise KeyError(key[0])  # resource group gone -> 404
+        return self.items[tuple(key)]
+
+
+class FakeAzureResourceClient:
+    def __init__(self):
+        self.resource_groups = FakeAzureResourceGroups()
+
+
+class FakeAzureNetworkClient:
+    def __init__(self, resource_client: FakeAzureResourceClient):
+        groups = resource_client.resource_groups
+        self.virtual_networks = _AzureCollection(groups)
+        self.subnets = _AzureCollection(groups)
+        self.network_security_groups = _AzureCollection(groups)
+
+
+class TestAzureWorkspace:
+    def _provider(self):
+        resource = FakeAzureResourceClient()
+        return create_workspace_provider(
+            {"type": "azure", "subscription_id": "sub",
+             "location": "eastus",
+             "resource_client": resource,
+             "network_client": FakeAzureNetworkClient(resource)}, "ws")
+
+    def test_create_check_delete_cycle(self):
+        p = self._provider()
+        assert p.check_workspace_existence({}) == Existence.NOT_EXIST
+        p.create_workspace({})
+        assert p.check_workspace_existence({}) == Existence.COMPLETED
+        # both subnets + nsg rendered
+        net = p._network
+        assert ("tik-ws-rg", "tik-ws-vnet",
+                "tik-ws-private") in net.subnets.items
+        nsg = net.network_security_groups.items[("tik-ws-rg",
+                                                 "tik-ws-nsg")]
+        rules = {r["name"] for r in nsg["security_rules"]}
+        assert rules == {"tik-allow-ssh", "tik-allow-internal"}
+        p.create_workspace({})  # idempotent
+        p.delete_workspace({})
+        assert p.check_workspace_existence({}) == Existence.NOT_EXIST
+
+
+# --------------------------------------------------------------- aliyun --
+
+class FakeAliyunVpc:
+    def __init__(self):
+        self.vpcs: Dict[str, Dict[str, Any]] = {}
+        self.vswitches: Dict[str, Dict[str, Any]] = {}
+        self.groups: Dict[str, Dict[str, Any]] = {}
+        self.rules = []
+        self.nats: Dict[str, Dict[str, Any]] = {}
+        self._n = 0
+
+    def _id(self, prefix):
+        self._n += 1
+        return f"{prefix}-{self._n}"
+
+    def create_vpc(self, vpc_name, cidr_block):
+        vid = self._id("vpc")
+        self.vpcs[vid] = {"VpcId": vid, "VpcName": vpc_name,
+                          "CidrBlock": cidr_block}
+        return {"VpcId": vid}
+
+    def describe_vpcs(self, vpc_name=None):
+        vpcs = [v for v in self.vpcs.values()
+                if vpc_name is None or v["VpcName"] == vpc_name]
+        return {"Vpcs": {"Vpc": vpcs}}
+
+    def delete_vpc(self, vpc_id):
+        del self.vpcs[vpc_id]
+
+    def create_vswitch(self, vpc_id, zone_id, v_switch_name, cidr_block):
+        sid = self._id("vsw")
+        self.vswitches[sid] = {"VSwitchId": sid, "VpcId": vpc_id,
+                               "VSwitchName": v_switch_name}
+        return {"VSwitchId": sid}
+
+    def describe_vswitches(self, vpc_id):
+        return {"VSwitches": {"VSwitch": [
+            v for v in self.vswitches.values()
+            if v["VpcId"] == vpc_id]}}
+
+    def delete_vswitch(self, v_switch_id):
+        del self.vswitches[v_switch_id]
+
+    def create_security_group(self, vpc_id, security_group_name):
+        gid = self._id("sg")
+        self.groups[gid] = {"SecurityGroupId": gid, "VpcId": vpc_id,
+                            "SecurityGroupName": security_group_name}
+        return {"SecurityGroupId": gid}
+
+    def describe_security_groups(self, vpc_id):
+        return {"SecurityGroups": {"SecurityGroup": [
+            g for g in self.groups.values() if g["VpcId"] == vpc_id]}}
+
+    def authorize_security_group(self, **kwargs):
+        self.rules.append(kwargs)
+
+    def delete_security_group(self, security_group_id):
+        del self.groups[security_group_id]
+
+    def create_nat_gateway(self, vpc_id, name):
+        nid = self._id("nat")
+        self.nats[nid] = {"NatGatewayId": nid, "VpcId": vpc_id,
+                          "Name": name}
+        return {"NatGatewayId": nid}
+
+    def describe_nat_gateways(self, vpc_id):
+        return {"NatGateways": {"NatGateway": [
+            n for n in self.nats.values() if n["VpcId"] == vpc_id]}}
+
+    def delete_nat_gateway(self, nat_gateway_id):
+        del self.nats[nat_gateway_id]
+
+
+class TestAliyunWorkspace:
+    def test_create_check_delete_cycle(self):
+        fake = FakeAliyunVpc()
+        p = create_workspace_provider(
+            {"type": "aliyun", "region": "cn-hangzhou",
+             "vpc_client": fake}, "ws")
+        assert p.check_workspace_existence({}) == Existence.NOT_EXIST
+        p.create_workspace({})
+        assert p.check_workspace_existence({}) == Existence.COMPLETED
+        assert len(fake.rules) == 2  # ssh + internal
+        assert len(fake.nats) == 1
+        before = (len(fake.vpcs), len(fake.vswitches), len(fake.groups))
+        p.create_workspace({})  # idempotent: nothing duplicated
+        assert (len(fake.vpcs), len(fake.vswitches),
+                len(fake.groups)) == before
+        p.delete_workspace({})
+        assert p.check_workspace_existence({}) == Existence.NOT_EXIST
+        assert not fake.vpcs and not fake.nats
+
+
+# --------------------------------------------------------------- huawei --
+
+class FakeHuaweiVpc:
+    def __init__(self):
+        self.vpcs: Dict[str, Dict[str, Any]] = {}
+        self.subnets: Dict[str, Dict[str, Any]] = {}
+        self.groups: Dict[str, Dict[str, Any]] = {}
+        self.rules = []
+        self.nats: Dict[str, Dict[str, Any]] = {}
+        self._n = 0
+
+    def _id(self, prefix):
+        self._n += 1
+        return f"{prefix}-{self._n}"
+
+    def create_vpc(self, name, cidr):
+        vid = self._id("vpc")
+        self.vpcs[vid] = {"id": vid, "name": name, "cidr": cidr}
+        return {"vpc": self.vpcs[vid]}
+
+    def list_vpcs(self):
+        return {"vpcs": list(self.vpcs.values())}
+
+    def delete_vpc(self, vpc_id):
+        del self.vpcs[vpc_id]
+
+    def create_subnet(self, vpc_id, name, cidr, gateway_ip):
+        sid = self._id("subnet")
+        self.subnets[sid] = {"id": sid, "vpc_id": vpc_id, "name": name}
+        return {"subnet": self.subnets[sid]}
+
+    def list_subnets(self):
+        return {"subnets": list(self.subnets.values())}
+
+    def delete_subnet(self, vpc_id, subnet_id):
+        del self.subnets[subnet_id]
+
+    def create_security_group(self, name):
+        gid = self._id("sg")
+        self.groups[gid] = {"id": gid, "name": name}
+        return {"security_group": self.groups[gid]}
+
+    def list_security_groups(self):
+        return {"security_groups": list(self.groups.values())}
+
+    def create_security_group_rule(self, **kwargs):
+        self.rules.append(kwargs)
+
+    def delete_security_group(self, security_group_id):
+        del self.groups[security_group_id]
+
+    def create_nat_gateway(self, name, router_id, internal_network_id):
+        nid = self._id("nat")
+        self.nats[nid] = {"id": nid, "name": name}
+        return {"nat_gateway": self.nats[nid]}
+
+    def list_nat_gateways(self):
+        return {"nat_gateways": list(self.nats.values())}
+
+    def delete_nat_gateway(self, nat_gateway_id):
+        del self.nats[nat_gateway_id]
+
+
+class TestHuaweiWorkspace:
+    def test_create_check_delete_cycle(self):
+        fake = FakeHuaweiVpc()
+        p = create_workspace_provider(
+            {"type": "huaweicloud", "region": "cn-north-4",
+             "vpc_client": fake}, "ws")
+        assert p.check_workspace_existence({}) == Existence.NOT_EXIST
+        p.create_workspace({})
+        assert p.check_workspace_existence({}) == Existence.COMPLETED
+        assert len(fake.rules) == 2
+        p.create_workspace({})  # idempotent
+        assert len(fake.vpcs) == 1 and len(fake.subnets) == 1
+        p.delete_workspace({})
+        assert p.check_workspace_existence({}) == Existence.NOT_EXIST
+        assert not fake.nats and not fake.groups
