@@ -323,36 +323,71 @@ def exec_on_cluster(
                     break
             if not targets:
                 raise ValueError(f"no node with ip {node_ip}")
+        def _await_then_teardown(node_id, executor):
+            # "stop after the command completes": a detached tmux session
+            # returns immediately, so wait for completion first — via the
+            # pluggable job waiter when one is named (reference
+            # job_waiter gating --stop, cluster_operator.py:1343-1351),
+            # else the built-in tmux session poll.
+            waiter = _completion_waiter(config, provider, job_waiter_name)
+            if waiter is not None:
+                waiter.wait_for_completion(node_id, cmd, session or "")
+            elif session and executor is not None:
+                _wait_for_tmux_session(executor, session)
+            teardown_cluster(config)
+
         if targets:
             output = None
-            last_executor = None
+            last = (None, None)
             for node_id in targets:
-                last_executor = make_command_executor(
+                executor = make_command_executor(
                     CallContext(), f"[{node_id}] ", node_id, provider,
                     config.get("auth", {}), config["cluster_name"],
                     docker_config=config.get("docker"))
-                output = last_executor.run(
+                last = (node_id, executor)
+                output = executor.run(
                     cmd, with_output=with_output,
                     environment_variables=_runtime_env(
                         config, provider, node_id))
             if stop:
-                if session and last_executor:
-                    _wait_for_tmux_session(last_executor, session)
-                teardown_cluster(config)
+                _await_then_teardown(*last)
             return output
         head_id, executor = head_executor(config, provider)
         result = executor.run(cmd, with_output=with_output,
                               environment_variables=_runtime_env(
                                   config, provider, head_id))
         if stop:
-            # "stop after the command completes": a detached tmux session
-            # returns immediately, so wait for it to end before teardown.
-            if session:
-                _wait_for_tmux_session(executor, session)
-            teardown_cluster(config)
+            _await_then_teardown(head_id, executor)
         return result
     finally:
         provider.cleanup()
+
+
+def _completion_waiter(config: Dict[str, Any], provider,
+                       job_waiter_name: Optional[str]):
+    """Build the named JobWaiter (runtime-provided waiters included).
+
+    Reference parity: job_waiter_factory.py resolving built-ins, runtime
+    get_job_waiter hooks (core/runtime.py:229), and chain: syntax."""
+    if not job_waiter_name:
+        return None
+    from cloudtik_tpu.control.job_waiters import create_job_waiter
+    from cloudtik_tpu.runtimes.delivery import _runtime_name
+
+    runtime_waiters = {}
+    for runtime in iter_runtimes(config):
+        waiter = runtime.get_job_waiter(config)
+        if waiter is not None:
+            runtime_waiters[_runtime_name(runtime)] = waiter
+
+    def executor_factory(node_id: str):
+        return make_command_executor(
+            CallContext(), f"[{node_id}] ", node_id, provider,
+            config.get("auth", {}), config["cluster_name"],
+            docker_config=config.get("docker"))
+
+    return create_job_waiter(job_waiter_name, config, executor_factory,
+                             runtime_waiters)
 
 
 def _wait_for_tmux_session(executor, session: str,

@@ -45,6 +45,26 @@ class ElasticsearchRuntime(ServiceRuntimeBase):
     NODE_KIND = ALL_NODES
     PROCESS_KEYWORD = "org.elasticsearch.bootstrap"
     ENDPOINT_NAME = "Elasticsearch"
+    BINARY = "elasticsearch"
+    # Reference: runtime/elasticsearch install recipe (release tarball).
+    INSTALL = {
+        "type": "archive",
+        "url": ("https://artifacts.elastic.co/downloads/elasticsearch/"
+                "elasticsearch-8.13.2-linux-x86_64.tar.gz"),
+        "strip_components": 1,
+    }
+
+    def service_command(self, node_context: Dict[str, Any]):
+        import os
+        conf = os.path.join(self.conf_dir(node_context),
+                            "elasticsearch.yml")
+        binary = self.find_binary()
+        if binary is None or not os.path.exists(conf):
+            return None
+        return [binary]
+
+    def service_env(self, node_context: Dict[str, Any]):
+        return {"ES_PATH_CONF": self.conf_dir(node_context)}
 
     def node_configure(self, node_context: Dict[str, Any]) -> None:
         import os

@@ -51,6 +51,25 @@ class HDFSRuntime(ServiceRuntimeBase):
     NODE_KIND = ALL_NODES
     PROCESS_KEYWORD = "NameNode"
     ENDPOINT_NAME = "HDFS NameNode UI"
+    BINARY = "hdfs"
+    # Reference: runtime/hdfs install recipe (hadoop release tarball).
+    INSTALL = {
+        "type": "archive",
+        "url": ("https://archive.apache.org/dist/hadoop/common/"
+                "hadoop-3.3.6/hadoop-3.3.6.tar.gz"),
+        "strip_components": 1,
+    }
+
+    def service_command(self, node_context: Dict[str, Any]):
+        binary = self.find_binary()
+        if binary is None:
+            return None
+        role = "namenode" if node_context.get("is_head") else "datanode"
+        return [binary, "--config", self.conf_dir(node_context), role]
+
+    def service_ready_port(self, node_context: Dict[str, Any]):
+        # only the head's namenode listens on the NN RPC port
+        return self.port if node_context.get("is_head") else None
 
     def node_configure(self, node_context: Dict[str, Any]) -> None:
         import os

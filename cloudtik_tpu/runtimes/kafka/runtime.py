@@ -61,6 +61,23 @@ class KafkaRuntime(ServiceRuntimeBase):
     PROCESS_KEYWORD = "kafka.Kafka"
     MINIMAL_NODES = 3
     QUORUM = True
+    BINARY = "kafka-server-start.sh"
+    # Reference: runtime/kafka/scripts/install.sh download recipe as data.
+    INSTALL = {
+        "type": "archive",
+        "url": ("https://archive.apache.org/dist/kafka/3.7.0/"
+                "kafka_2.13-3.7.0.tgz"),
+        "strip_components": 1,
+    }
+
+    def service_command(self, node_context: Dict[str, Any]):
+        import os
+        conf = os.path.join(self.conf_dir(node_context),
+                            "server.properties")
+        binary = self.find_binary()
+        if binary is None or not os.path.exists(conf):
+            return None  # not a quorum member on this node
+        return [binary, conf]
 
     def node_configure(self, node_context: Dict[str, Any]) -> None:
         if not self.runs_on(node_context):

@@ -44,6 +44,31 @@ class MinIORuntime(ServiceRuntimeBase):
     NODE_KIND = ALL_NODES
     PROCESS_KEYWORD = "minio server"
     ENDPOINT_NAME = "MinIO"
+    BINARY = "minio"
+    # Reference: runtime/minio install recipe (single static binary).
+    INSTALL = {
+        "type": "archive",
+        "url": "https://dl.min.io/server/minio/release/linux-amd64/minio",
+        "binary": "minio",
+    }
+
+    def service_command(self, node_context: Dict[str, Any]):
+        import os
+        binary = self.find_binary()
+        if binary is None:
+            return None
+        data_dir = os.path.expanduser(
+            self.runtime_config.get("data_dir", "~/.tik/minio/data"))
+        os.makedirs(data_dir, exist_ok=True)
+        return [binary, "server", data_dir, "--address", f":{self.port}"]
+
+    def service_env(self, node_context: Dict[str, Any]):
+        return {
+            "MINIO_ROOT_USER": self.runtime_config.get(
+                "root_user", "tikadmin"),
+            "MINIO_ROOT_PASSWORD": self.runtime_config.get(
+                "root_password", "tikadmin"),
+        }
 
     def node_configure(self, node_context: Dict[str, Any]) -> None:
         import os

@@ -48,6 +48,22 @@ class MongoDBRuntime(ServiceRuntimeBase):
     DEFAULT_PORT = MONGO_PORT
     NODE_KIND = ALL_NODES
     PROCESS_KEYWORD = "mongod"
+    BINARY = "mongod"
+    # Reference: runtime/mongodb install recipe (community release tgz).
+    INSTALL = {
+        "type": "archive",
+        "url": ("https://fastdl.mongodb.org/linux/"
+                "mongodb-linux-x86_64-ubuntu2204-7.0.8.tgz"),
+        "strip_components": 1,
+    }
+
+    def service_command(self, node_context: Dict[str, Any]):
+        import os
+        conf = os.path.join(self.conf_dir(node_context), "mongod.conf")
+        binary = self.find_binary()
+        if binary is None or not os.path.exists(conf):
+            return None
+        return [binary, "--config", conf]
 
     def node_configure(self, node_context: Dict[str, Any]) -> None:
         import os

@@ -47,6 +47,17 @@ class RedisRuntime(ServiceRuntimeBase):
     DEFAULT_PORT = REDIS_PORT
     NODE_KIND = ALL_NODES
     PROCESS_KEYWORD = "redis-server"
+    BINARY = "redis-server"
+    # No default INSTALL: upstream ships source only; configs point
+    # install at a prebuilt mirror or put redis-server on PATH.
+
+    def service_command(self, node_context: Dict[str, Any]):
+        import os
+        conf = os.path.join(self.conf_dir(node_context), "redis.conf")
+        binary = self.find_binary()
+        if binary is None or not os.path.exists(conf):
+            return None
+        return [binary, conf]
 
     def node_configure(self, node_context: Dict[str, Any]) -> None:
         import os

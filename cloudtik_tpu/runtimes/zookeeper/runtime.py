@@ -47,6 +47,25 @@ class ZooKeeperRuntime(ServiceRuntimeBase):
     PROCESS_KEYWORD = "QuorumPeerMain"
     MINIMAL_NODES = 3
     QUORUM = True
+    BINARY = "zkServer.sh"
+    # Reference: runtime/zookeeper/scripts/install.sh download recipe.
+    INSTALL = {
+        "type": "archive",
+        "url": ("https://archive.apache.org/dist/zookeeper/"
+                "zookeeper-3.9.2/apache-zookeeper-3.9.2-bin.tar.gz"),
+        "strip_components": 1,
+    }
+
+    def service_command(self, node_context: Dict[str, Any]):
+        import os
+        conf = os.path.join(self.conf_dir(node_context), "zoo.cfg")
+        binary = self.find_binary()
+        if binary is None or not os.path.exists(conf):
+            return None  # not a quorum member on this node
+        return [binary, "start-foreground", conf]
+
+    def service_env(self, node_context: Dict[str, Any]):
+        return {"ZOOCFGDIR": self.conf_dir(node_context)}
 
     def node_configure(self, node_context: Dict[str, Any]) -> None:
         if not self.runs_on(node_context):

@@ -74,12 +74,16 @@ def stop(config_file, workers_only, keep_min_workers, hard, yes):
 @click.option("--all-nodes", is_flag=True)
 @click.option("--tmux", is_flag=True)
 @click.option("--stop", is_flag=True, help="Tear down after the command.")
-def exec_cmd(config_file, cmd, node_ip, all_nodes, tmux, stop):
+@click.option("--job-waiter", default=None,
+              help="Completion waiter gating --stop: tmux, screen, a "
+                   "runtime name, or chain:a,b.")
+def exec_cmd(config_file, cmd, node_ip, all_nodes, tmux, stop, job_waiter):
     """Run a shell command on the cluster."""
     from cloudtik_tpu.control import cluster_operator
     out = cluster_operator.exec_on_cluster(
         _load(config_file), cmd, node_ip=node_ip, all_nodes=all_nodes,
-        tmux=tmux, stop=stop, with_output=True)
+        tmux=tmux, stop=stop, with_output=True,
+        job_waiter_name=job_waiter)
     if out:
         click.echo(out)
 
@@ -90,11 +94,15 @@ def exec_cmd(config_file, cmd, node_ip, all_nodes, tmux, stop):
 @click.argument("script_args", nargs=-1)
 @click.option("--tmux", is_flag=True)
 @click.option("--stop", is_flag=True)
-def submit(config_file, script, script_args, tmux, stop):
+@click.option("--job-waiter", default=None,
+              help="Completion waiter gating --stop: tmux, screen, a "
+                   "runtime name, or chain:a,b.")
+def submit(config_file, script, script_args, tmux, stop, job_waiter):
     """Upload and run a job file via the matching runtime."""
     from cloudtik_tpu.control import cluster_operator
     out = cluster_operator.submit_to_cluster(
-        _load(config_file), script, list(script_args), tmux=tmux, stop=stop)
+        _load(config_file), script, list(script_args), tmux=tmux,
+        stop=stop, job_waiter_name=job_waiter)
     if out:
         click.echo(out)
 

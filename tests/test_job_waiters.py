@@ -1,0 +1,108 @@
+"""Pluggable job waiters gating `--stop` teardown.
+
+Round-3 verdict weak item 8: completion waiting was tmux-session-only.
+Now `exec/submit --stop --job-waiter=<name>` resolves built-ins (tmux/
+screen), runtime-provided waiters (Runtime.get_job_waiter), and chains.
+Reference: core/_private/job_waiter/ (factory, chain, session waiter).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import pytest
+
+from cloudtik_tpu.control.cluster_operator import _completion_waiter
+from cloudtik_tpu.control.job_waiters import (
+    SessionJobWaiter, create_job_waiter)
+from cloudtik_tpu.core.job_waiter import JobWaiter, JobWaiterChain
+from cloudtik_tpu.core.runtime import Runtime
+from cloudtik_tpu.runtimes.registry import register_runtime
+
+
+class _RecordingWaiter(JobWaiter):
+    def __init__(self, config=None, log=None, tag=""):
+        super().__init__(config or {})
+        self.log = log if log is not None else []
+        self.tag = tag
+
+    def wait_for_completion(self, node_id, cmd, session_name,
+                            timeout=None):
+        self.log.append((self.tag, node_id, session_name))
+
+
+class _FakeExecutor:
+    """tmux has-session succeeds `alive_polls` times, then fails."""
+
+    def __init__(self, alive_polls: int):
+        self.remaining = alive_polls
+        self.commands: List[str] = []
+
+    def run(self, cmd, **kwargs):
+        self.commands.append(cmd)
+        if self.remaining <= 0:
+            raise RuntimeError("no such session")
+        self.remaining -= 1
+
+
+class TestSessionJobWaiter:
+    def test_polls_until_session_gone(self):
+        executor = _FakeExecutor(alive_polls=3)
+        waiter = SessionJobWaiter(
+            {}, lambda node_id: executor, poll_interval_s=0.0)
+        waiter.wait_for_completion("head", "train.py", "tik-job-1")
+        assert len(executor.commands) == 4
+        assert all("tmux has-session" in c for c in executor.commands)
+
+    def test_timeout_raises(self):
+        executor = _FakeExecutor(alive_polls=10**6)
+        waiter = SessionJobWaiter(
+            {}, lambda node_id: executor, poll_interval_s=0.0)
+        with pytest.raises(TimeoutError):
+            waiter.wait_for_completion("head", "x", "s", timeout=0)
+
+
+class TestFactory:
+    def test_chain_resolves_members_in_order(self):
+        log: List = []
+        runtime_waiters = {
+            "ai": _RecordingWaiter(log=log, tag="ai"),
+            "spark": _RecordingWaiter(log=log, tag="spark"),
+        }
+        waiter = create_job_waiter(
+            "chain:ai,spark", {}, lambda n: None, runtime_waiters)
+        assert isinstance(waiter, JobWaiterChain)
+        waiter.wait_for_completion("head", "cmd", "sess")
+        assert [entry[0] for entry in log] == ["ai", "spark"]
+
+    def test_unknown_name_raises(self):
+        with pytest.raises(ValueError, match="unknown job waiter"):
+            create_job_waiter("nope", {}, lambda n: None, {})
+
+
+class _WaiterRuntime(Runtime):
+    """Runtime exposing a job waiter under its registered name."""
+
+    LOG: List = []
+
+    def get_job_waiter(self, cluster_config) -> Optional[JobWaiter]:
+        return _RecordingWaiter(log=self.LOG, tag="waiterrt")
+
+
+class TestOperatorWiring:
+    def test_runtime_waiter_resolved_by_registered_name(self):
+        register_runtime("waiterrt", _WaiterRuntime)
+        config: Dict[str, Any] = {
+            "cluster_name": "c", "workspace_name": "w",
+            "provider": {"type": "virtual"},
+            "auth": {"executor": "local"},
+            "runtime": {"types": ["waiterrt"]},
+        }
+        _WaiterRuntime.LOG.clear()
+        waiter = _completion_waiter(config, provider=None,
+                                    job_waiter_name="waiterrt")
+        waiter.wait_for_completion("head", "cmd", "sess")
+        assert _WaiterRuntime.LOG == [("waiterrt", "head", "sess")]
+
+    def test_none_when_unnamed(self):
+        assert _completion_waiter({}, None, None) is None

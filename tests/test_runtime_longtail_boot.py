@@ -1,0 +1,215 @@
+"""Long-tail runtimes actually boot: install -> configure -> start -> stop.
+
+Round-3 verdict item 9: kafka/zookeeper/hdfs/mongodb/elasticsearch/minio/
+redis/mount counted in the "36 runtimes" headline but had never started a
+process.  Each case installs a fake release archive from a file:// mirror
+into a clean TIK_HOME, renders real config, spawns the (fake) binary via
+the delivery pipeline, and asserts the service listens on its configured
+port — the same lifecycle a real node runs (runtime_scripts.py:338).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import socket
+import stat
+import tarfile
+
+import pytest
+
+from cloudtik_tpu.control.state import InMemoryStateBackend, StateClient
+from cloudtik_tpu.runtimes import delivery, installer
+from cloudtik_tpu.runtimes.common import process_runner
+
+FAKE_SERVER = """\
+#!/usr/bin/env python3
+# fake service binary: listens on the baked-in port until killed
+import socket
+s = socket.socket()
+s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+s.bind(("127.0.0.1", {port}))
+s.listen(5)
+while True:
+    conn, _ = s.accept()
+    conn.close()
+"""
+
+# runtime -> (binary name, node is head?, needs quorum row?)
+CASES = {
+    "kafka": ("kafka-server-start.sh", False, True),
+    "zookeeper": ("zkServer.sh", False, True),
+    "hdfs": ("hdfs", True, False),
+    "mongodb": ("mongod", True, False),
+    "elasticsearch": ("elasticsearch", True, False),
+    "minio": ("minio", True, False),
+    "redis": ("redis-server", True, False),
+}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _tarball(path: str, binary: str, port: int) -> str:
+    data = FAKE_SERVER.format(port=port).encode()
+    with tarfile.open(path, "w:gz") as tf:
+        info = tarfile.TarInfo(f"release-0.0/bin/{binary}")
+        info.size = len(data)
+        info.mode = 0o755
+        tf.addfile(info, io.BytesIO(data))
+    return path
+
+
+@pytest.fixture
+def tik_home_tmp(tmp_path, monkeypatch):
+    monkeypatch.setenv("TIK_HOME", str(tmp_path))
+    monkeypatch.delenv("TIK_RUNTIME_HOME", raising=False)
+    return tmp_path
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_runtime_boots_from_clean_home(name, tik_home_tmp, tmp_path):
+    binary, is_head, quorum = CASES[name]
+    port = _free_port()
+    tarball = _tarball(str(tmp_path / f"{name}.tar.gz"), binary, port)
+    runtime_config = {
+        "port": port,
+        "minimal_nodes": 1,
+        "install": {"type": "archive", "url": f"file://{tarball}"},
+        "data_dir": str(tmp_path / "data"),
+    }
+    config = {
+        "cluster_name": "lt", "workspace_name": "w",
+        "provider": {"type": "virtual"},
+        "available_node_types": {},
+        "runtime": {"types": [name], name: runtime_config},
+    }
+    state = StateClient(InMemoryStateBackend())
+    node_id = "head" if is_head else "w-1"
+    if quorum or not is_head:
+        state.table_put("nodes", node_id,
+                        {"kind": "worker", "ip": "127.0.0.1"})
+    ctx = delivery.build_node_context(
+        config, is_head=is_head, head_ip="127.0.0.1", node_id=node_id,
+        node_ip="127.0.0.1", state_client=state)
+    try:
+        delivery.install_runtimes(config, ctx)
+        assert os.access(os.path.join(
+            installer.install_dir(name), "bin", binary), os.X_OK)
+        delivery.configure_runtimes(config, ctx)
+        delivery.start_runtime_services(config, ctx)
+        assert process_runner.service_running(name), \
+            process_runner.tail_log(name)
+        if not (name == "hdfs" and not is_head):
+            assert process_runner.port_open("127.0.0.1", port)
+        status = delivery.runtime_status(config)
+        assert status[name]["installed"] and status[name]["started"]
+    finally:
+        delivery.stop_runtime_services(config, ctx)
+    assert not process_runner.service_running(name)
+
+
+def test_mount_runtime_drives_fuse_binary(tik_home_tmp, tmp_path,
+                                          monkeypatch):
+    """The mount runtime execs the FUSE binary with bucket+path (a PATH
+    stub records the call; no real FUSE in the test environment)."""
+    marker = tmp_path / "gcsfuse-called"
+    stub_dir = tmp_path / "bin"
+    stub_dir.mkdir()
+    stub = stub_dir / "gcsfuse"
+    stub.write_text(f"#!/bin/sh\necho \"$@\" > {marker}\n")
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH",
+                       f"{stub_dir}:{os.environ.get('PATH', '')}")
+
+    from cloudtik_tpu.runtimes.mount.runtime import MountRuntime
+    mount_path = tmp_path / "mnt"
+    rt = MountRuntime({"mounts": [{
+        "kind": "gcs", "bucket": "tik-ws-data",
+        "path": str(mount_path)}]})
+    rt.validate_config({})
+    ctx = delivery.build_node_context(
+        {"cluster_name": "c"}, is_head=True)
+    rt.node_services(ctx, "start")
+    assert marker.exists()
+    recorded = marker.read_text()
+    assert "tik-ws-data" in recorded and str(mount_path) in recorded
+
+    with pytest.raises(ValueError, match="not supported"):
+        MountRuntime({"mounts": [{"kind": "nfs", "bucket": "b",
+                                  "path": "/m"}]}).validate_config({})
+
+
+class TestSparkRuntime:
+    """Spark gained an install path + service spawn + master-JSON scaling
+    (round-3 coverage table: 'no install path, no YARN-metrics scaling')."""
+
+    def test_boots_master_from_clean_home(self, tik_home_tmp, tmp_path):
+        port = _free_port()
+        tarball = _tarball(str(tmp_path / "spark.tar.gz"),
+                           "spark-class", port)
+        config = {
+            "cluster_name": "s", "workspace_name": "w",
+            "provider": {"type": "virtual"},
+            "available_node_types": {},
+            "runtime": {"types": ["spark"],
+                        "spark": {"port": port,
+                                  "install": {"type": "archive",
+                                              "url": f"file://{tarball}"}}},
+        }
+        ctx = delivery.build_node_context(
+            config, is_head=True, head_ip="127.0.0.1", node_id="head",
+            node_ip="127.0.0.1")
+        try:
+            delivery.install_runtimes(config, ctx)
+            delivery.configure_runtimes(config, ctx)
+            delivery.start_runtime_services(config, ctx)
+            assert process_runner.service_running("spark")
+            assert process_runner.port_open("127.0.0.1", port)
+        finally:
+            delivery.stop_runtime_services(config, ctx)
+
+    def test_scaling_policy_counts_pending_cores(self):
+        from cloudtik_tpu.runtimes.spark.runtime import (
+            SparkScalingPolicy, pending_cores_from_master_json)
+
+        status = {"activeapps": [
+            {"name": "a", "cores": 8, "coresgranted": 8,
+             "state": "RUNNING"},
+            {"name": "b", "cores": 8, "coresgranted": 2,
+             "state": "RUNNING"},
+            {"name": "c", "cores": 4, "state": "WAITING"},
+        ]}
+        assert pending_cores_from_master_json(status) == 10
+        policy = SparkScalingPolicy({}, "127.0.0.1",
+                                    fetcher=lambda: status)
+        state = policy.get_scaling_state()
+        demands = state.autoscaling_instructions["resource_demands"]
+        assert demands == [{"CPU": 1.0}] * 10
+
+    def test_scaling_policy_silent_when_master_down(self):
+        from cloudtik_tpu.runtimes.spark.runtime import SparkScalingPolicy
+
+        def boom():
+            raise OSError("refused")
+        assert SparkScalingPolicy(
+            {}, "127.0.0.1", fetcher=boom).get_scaling_state() is None
+
+    def test_runnable_command_uses_installed_submit(self, tik_home_tmp,
+                                                    tmp_path):
+        from cloudtik_tpu.runtimes import installer
+        from cloudtik_tpu.runtimes.spark.runtime import SparkRuntime
+        bin_dir = os.path.join(installer.install_dir("spark"), "bin")
+        os.makedirs(bin_dir)
+        for name in ("spark-class", "spark-submit"):
+            path = os.path.join(bin_dir, name)
+            with open(path, "w") as f:
+                f.write("#!/bin/sh\n")
+            os.chmod(path, 0o755)
+        cmd = SparkRuntime({}).get_runnable_command("etl.py")
+        assert cmd[0] == os.path.join(bin_dir, "spark-submit")
+        assert cmd[-1] == "etl.py"
+        assert SparkRuntime({}).get_runnable_command("train.sh") is None
