@@ -80,12 +80,28 @@ class NodeServicesStarter:
     # ------------------------------------------------------------------
     def start_head_processes(self) -> None:
         os.makedirs(os.path.expanduser(TIK_RUN_DIR), exist_ok=True)
-        backend = FileStateBackend(
-            os.path.join(os.path.expanduser(TIK_RUN_DIR), "state"))
-        self.state_server = StateServer(
-            port=self.state_port, backend=backend)
-        self.state_server.start()
-        self.state_client = StateClient(backend)
+        from cloudtik_tpu.utils.constants import env_bool
+        if env_bool("TIK_NATIVE_STATE", False):
+            # Native C++ state server (native/state_server.cpp) — the
+            # reference ran Redis (native C) here; same wire protocol as
+            # the Python server, so every client is unchanged.
+            from cloudtik_tpu import native
+            if native.compiler() is not None:
+                server = native.NativeStateServer(port=self.state_port)
+                server.start()
+                self.state_server = server  # type: ignore[assignment]
+                self.state_client = StateClient(
+                    TcpStateBackend("127.0.0.1", server.port))
+            else:
+                logger.warning("TIK_NATIVE_STATE set but no C++ "
+                               "compiler; using the Python server")
+        if self.state_client is None:
+            backend = FileStateBackend(
+                os.path.join(os.path.expanduser(TIK_RUN_DIR), "state"))
+            self.state_server = StateServer(
+                port=self.state_port, backend=backend)
+            self.state_server.start()
+            self.state_client = StateClient(backend)
 
         # cluster info into KV (reference node_services.py:641)
         self.state_client.table_put("cluster", "info", {

@@ -1,0 +1,97 @@
+"""Native components: build + spawn helpers.
+
+Reference parity: the reference's head state store is a native C server
+(Redis) booted by services.py:512; here `state_server.cpp` is the
+equivalent, byte-compatible with the Python StateServer's wire protocol
+(control/state.py).  The Python implementation stays the dev/test
+default; heads opt into the native server with TIK_NATIVE_STATE=1 (built
+on first use with the toolchain's g++).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import subprocess
+import time
+from typing import Optional
+
+from cloudtik_tpu.utils.constants import tik_home
+
+_SRC = os.path.join(os.path.dirname(__file__), "state_server.cpp")
+
+
+def binary_path() -> str:
+    return os.path.join(tik_home(), "native", "tik-state-server")
+
+
+def compiler() -> Optional[str]:
+    return shutil.which("g++") or shutil.which("clang++")
+
+
+def ensure_built(force: bool = False) -> Optional[str]:
+    """Compile the state server if needed; None when no C++ compiler."""
+    out = binary_path()
+    if not force and os.path.exists(out) and \
+            os.path.getmtime(out) >= os.path.getmtime(_SRC):
+        return out
+    cxx = compiler()
+    if cxx is None:
+        return None
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    proc = subprocess.run(
+        [cxx, "-O2", "-std=c++17", "-pthread", "-o", out, _SRC],
+        capture_output=True, text=True, timeout=300)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native state server build failed:\n{proc.stderr[-2000:]}")
+    return out
+
+
+class NativeStateServer:
+    """Spawns the native binary; same surface as control.state.StateServer
+    (.port / .start() / .stop())."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 auth_token: Optional[str] = None):
+        self.host = host
+        self.port = port
+        self.auth_token = auth_token
+        self._proc: Optional[subprocess.Popen] = None
+
+    def start(self, timeout_s: float = 10.0) -> None:
+        binary = ensure_built()
+        if binary is None:
+            raise RuntimeError("no C++ compiler available to build the "
+                               "native state server")
+        bind_host = "127.0.0.1" if self.host in ("localhost",
+                                                 "127.0.0.1") else "0.0.0.0"
+        cmd = [binary, "--host", bind_host, "--port", str(self.port)]
+        if self.auth_token:
+            cmd += ["--token", self.auth_token]
+        self._proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        # the binary reports its bound port (supports --port 0)
+        deadline = time.time() + timeout_s
+        line = ""
+        while time.time() < deadline:
+            line = self._proc.stdout.readline()  # type: ignore[union-attr]
+            if "listening on" in line:
+                break
+        match = re.search(r":(\d+)\s*$", line.strip())
+        if not match:
+            self.stop()
+            raise RuntimeError(
+                f"native state server did not report a port: {line!r}")
+        self.port = int(match.group(1))
+
+    def stop(self) -> None:
+        if self._proc is not None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+            self._proc = None

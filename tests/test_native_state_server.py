@@ -1,0 +1,128 @@
+"""Native C++ state server: wire-compatibility with the Python client.
+
+The reference's head state store is a native C server (Redis,
+services.py:512); `native/state_server.cpp` is this build's equivalent.
+These tests compile it with the toolchain g++, boot it on an ephemeral
+port, and drive the UNMODIFIED Python TcpStateBackend/StateClient through
+every op — plus a concurrency hammer on CAS (the primitive locks and
+leader election build on).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from cloudtik_tpu import native
+from cloudtik_tpu.control.state import StateClient, TcpStateBackend
+
+pytestmark = pytest.mark.skipif(
+    native.compiler() is None, reason="no C++ compiler")
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    import os
+    os.environ.setdefault("TIK_HOME",
+                          str(tmp_path_factory.mktemp("tikhome")))
+    srv = native.NativeStateServer(host="127.0.0.1", port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def client(server):
+    backend = TcpStateBackend("127.0.0.1", server.port)
+    yield StateClient(backend)
+    backend.close()
+
+
+class TestWireCompatibility:
+    def test_kv_roundtrip(self, client):
+        client.kv_put("a", b"hello")
+        assert client.kv_get("a") == b"hello"
+        assert client.kv_get("missing") is None
+        assert client.kv_delete("a") is True
+        assert client.kv_delete("a") is False
+
+    def test_tables_and_sorted_keys(self, client):
+        client.table_put("nodes", "w-2", {"ip": "10.0.0.2"})
+        client.table_put("nodes", "w-1", {"ip": "10.0.0.1"})
+        client.table_put("nodes", "h-0", {"ip": "10.0.0.0"})
+        rows = client.table_list("nodes")
+        assert list(rows) == sorted(rows)
+        assert rows["w-1"]["ip"] == "10.0.0.1"
+        assert client.table_get("nodes", "w-2")["ip"] == "10.0.0.2"
+
+    def test_prefix_keys(self, client):
+        for k in ("svc:a", "svc:b", "other"):
+            client.kv_put(k, b"x")
+        backend = client.backend if hasattr(client, "backend") else None
+        keys = client.kv_keys(prefix="svc:")
+        assert keys == ["svc:a", "svc:b"]
+
+    def test_binary_values(self, client):
+        blob = bytes(range(256)) * 300  # > bin8, exercises bin16
+        client.kv_put("blob", blob)
+        assert client.kv_get("blob") == blob
+
+    def test_ping(self, server):
+        backend = TcpStateBackend("127.0.0.1", server.port)
+        assert backend.ping() is True
+        backend.close()
+
+    def test_unknown_op_is_error_not_crash(self, server, client):
+        from cloudtik_tpu.control.state import _recv_msg, _send_msg
+        import socket
+        with socket.create_connection(("127.0.0.1", server.port)) as s:
+            _send_msg(s, {"op": "explode"})
+            resp = _recv_msg(s)
+        assert resp["ok"] is False and "bad op" in resp["error"]
+        # server still healthy
+        client.kv_put("after", b"1")
+        assert client.kv_get("after") == b"1"
+
+
+class TestCASAtomicity:
+    def test_cas_semantics(self, client):
+        backend = TcpStateBackend("127.0.0.1", client_port(client))
+        assert backend.cas("ns", "k", None, b"v1") is True
+        assert backend.cas("ns", "k", None, b"v2") is False
+        assert backend.cas("ns", "k", b"v1", b"v2") is True
+        assert backend.get("ns", "k") == b"v2"
+        backend.close()
+
+    def test_concurrent_cas_counter_loses_no_increment(self, server):
+        """8 clients CAS-increment one counter; a non-atomic server
+        would lose updates."""
+        increments = 25
+        contenders = 8
+
+        def run():
+            backend = TcpStateBackend("127.0.0.1", server.port)
+            for _ in range(increments):
+                while True:
+                    current = backend.get("race", "counter")
+                    nxt = str(int(current or b"0") + 1).encode()
+                    if backend.cas("race", "counter", current, nxt):
+                        break
+            backend.close()
+
+        threads = [threading.Thread(target=run)
+                   for _ in range(contenders)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        final = TcpStateBackend("127.0.0.1", server.port)
+        assert int(final.get("race", "counter")) == \
+            increments * contenders
+        final.close()
+
+
+def client_port(client) -> int:
+    backend = getattr(client, "backend", None) or \
+        getattr(client, "_backend", None)
+    return backend.port
