@@ -1,0 +1,73 @@
+"""Ring-attention scaling bench: long-context throughput vs flash.
+
+Run on a TPU host (`python benchmarks/ring_attention_bench.py`).  Single
+chip: measures the ring kernel at seq lengths a monolithic flash call can
+also handle, reporting tokens/s and achieved TFLOP/s side by side — the
+overhead of ring orchestration at shard-count 1.  On a CPU host it falls
+back to a virtual 8-device mesh (JAX_PLATFORMS=cpu) to demonstrate
+sequence-parallel scaling shape, not absolute numbers.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sync(out):
+    leaf = jax.tree.leaves(out)[0]
+    np.asarray(leaf[(0,) * leaf.ndim])
+
+
+def _time(fn, *args, iters=10):
+    fn(*args)  # compile
+    _sync(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def attention_flops(B, H, S, D, causal=True):
+    per_head = 4.0 * S * S * D  # qk^T + pv
+    total = B * H * per_head
+    return total / 2 if causal else total
+
+
+def main():
+    from jax.sharding import Mesh
+    from cloudtik_tpu.ops.flash_attention import flash_attention
+    from cloudtik_tpu.ops.ring_attention import ring_attention_sharded
+
+    B, H, D = 1, 8, 128
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices).reshape(len(devices)), ("seq",))
+    print(f"devices={devices} mesh seq={len(devices)}")
+    jax.sharding.set_mesh(mesh).__enter__()
+    for S in (2048, 4096, 8192, 16384):
+        q, k, v = (jax.random.normal(
+            jax.random.PRNGKey(i), (B, H, S, D)).astype(jnp.bfloat16)
+            for i in range(3))
+
+        flash = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=True))
+        t_flash = _time(flash, q, k, v)
+
+        ring = jax.jit(lambda q, k, v: ring_attention_sharded(
+            q, k, v, causal=True))
+        t_ring = _time(ring, q, k, v)
+
+        flops = attention_flops(B, H, S, D)
+        print(f"S={S:6d}  flash {t_flash*1e3:8.2f} ms "
+              f"({flops/t_flash/1e12:6.2f} TF/s)   "
+              f"ring {t_ring*1e3:8.2f} ms "
+              f"({flops/t_ring/1e12:6.2f} TF/s)   "
+              f"ring/flash {t_ring/t_flash:5.2f}x")
+
+
+if __name__ == "__main__":
+    main()

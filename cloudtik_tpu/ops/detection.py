@@ -201,42 +201,82 @@ def _roi_align_one(features: jax.Array, roi: jax.Array, *, pooled: int,
 
 
 def _roi_align_kernel(rois_ref, features_ref, out_ref, *, pooled: int,
-                      sampling: int, spatial_scale: float):
-    r = pl.program_id(0)
+                      sampling: int, spatial_scale: float,
+                      roi_block: int):
+    rb = pl.program_id(1)
+    features = features_ref[...]
     # rois ride SMEM via scalar prefetch: per-ROI scalars support the
     # dynamic row index (VMEM vectors would not, and a (1, 4) VMEM block
-    # violates the TPU's (8, 128) tiling anyway).
-    roi = jnp.stack([rois_ref[r, 0], rois_ref[r, 1],
-                     rois_ref[r, 2], rois_ref[r, 3]])
-    out_ref[0] = _roi_align_one(
-        features_ref[...], roi, pooled=pooled, sampling=sampling,
-        spatial_scale=spatial_scale)
+    # violates the TPU's (8, 128) tiling anyway).  A static block of ROIs
+    # per invocation amortizes the grid/DMA overhead of tiny outputs.
+    for i in range(roi_block):
+        r = rb * roi_block + i
+        roi = jnp.stack([rois_ref[r, 0], rois_ref[r, 1],
+                         rois_ref[r, 2], rois_ref[r, 3]])
+        out_ref[i] = _roi_align_one(
+            features, roi, pooled=pooled, sampling=sampling,
+            spatial_scale=spatial_scale)
+
+
+def _channel_block(C: int, H: int, W: int,
+                   budget_bytes: int = 1 << 20) -> int:
+    """Largest divisor of C whose feature block fits the VMEM budget.
+    The block is double-buffered and the kernel's intermediates
+    (broadcast wy, the chq tensor) scale with it too, so the budget is a
+    small fraction of the 16 MB VMEM."""
+    per_channel = H * W * 4
+    cap = max(1, budget_bytes // per_channel)
+    for cb in range(min(C, cap), 0, -1):
+        if C % cb == 0:
+            return cb
+    return 1
 
 
 def roi_align(features: jax.Array, rois: jax.Array, *,
               pooled_size: int = 7, sampling_ratio: int = 2,
               spatial_scale: float = 1.0,
+              implementation: Optional[str] = None,
               interpret: bool = False) -> jax.Array:
     """ROIAlign.  features [C, H, W], rois [R, 4] (x1,y1,x2,y2 in input
     coordinates) -> [R, C, pooled, pooled].  Reference parity:
     ROIAlign_cpu.cpp — re-derived as interpolation-weight matmuls (the
-    MXU path) instead of per-sample gathers."""
+    MXU path) instead of per-sample gathers.
+
+    implementation: "xla" (default — the weight-matmul math vmapped over
+    ROIs, which XLA batches into large MXU ops; measured fastest),
+    "pallas" (explicit kernel: channel-blocked VMEM residency, ROI
+    batches per invocation — the formulation reference for the
+    memory-hierarchy mapping)."""
+    if implementation is None:
+        implementation = "xla"
+    if implementation == "xla":
+        one = functools.partial(
+            _roi_align_one, features.astype(jnp.float32),
+            pooled=pooled_size, sampling=sampling_ratio,
+            spatial_scale=spatial_scale)
+        return jax.vmap(one)(rois.astype(jnp.float32))
+    if implementation != "pallas":
+        raise ValueError(f"unknown implementation {implementation!r}")
     C, H, W = features.shape
     R = rois.shape[0]
+    CB = _channel_block(C, H, W)
+    RB = next(rb for rb in (8, 4, 2, 1) if R % rb == 0)
     return pl.pallas_call(
         functools.partial(
             _roi_align_kernel, pooled=int(pooled_size),
             sampling=int(sampling_ratio),
-            spatial_scale=float(spatial_scale)),
+            spatial_scale=float(spatial_scale), roi_block=RB),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(R,),
+            # channel block outermost: its feature DMA is skipped across
+            # all inner (per-ROI-block) steps instead of re-streamed
+            grid=(C // CB, R // RB),
             in_specs=[
-                pl.BlockSpec((C, H, W), lambda r, *_: (0, 0, 0)),
+                pl.BlockSpec((CB, H, W), lambda cb, r, *_: (cb, 0, 0)),
             ],
             out_specs=pl.BlockSpec(
-                (1, C, pooled_size, pooled_size),
-                lambda r, *_: (r, 0, 0, 0)),
+                (RB, CB, pooled_size, pooled_size),
+                lambda cb, r, *_: (r, cb, 0, 0)),
         ),
         out_shape=jax.ShapeDtypeStruct(
             (R, C, pooled_size, pooled_size), jnp.float32),

@@ -27,11 +27,33 @@ RUNNABLE_SUFFIXES = (".py",)
 
 
 class AIRuntime(Runtime):
+    # The JAX training stack installed on TPU hosts (reference:
+    # runtime/ai/scripts/install.sh:48-101 pip-installing torch/TF/
+    # horovod; the TPU-native stack is jax[tpu] + the ecosystem this
+    # framework builds on).  Overridable per-cluster via
+    # runtime.ai.install; skipped when jax is already importable.
+    DEFAULT_PACKAGES = [
+        "jax[tpu]", "flax", "optax", "orbax-checkpoint", "chex",
+        "einops", "transformers", "grain",
+    ]
+
     def prepare_config(self, cluster_config: Dict[str, Any]) -> Dict[str, Any]:
         return cluster_config
 
     def validate_config(self, cluster_config: Dict[str, Any]) -> None:
         return None
+
+    def node_install(self, node_context: Dict[str, Any]) -> None:
+        """Install the JAX stack on nodes that don't already have it."""
+        try:
+            import jax  # noqa: F401
+            return  # environment already provisioned (dev images, tests)
+        except ImportError:
+            pass
+        from cloudtik_tpu.runtimes import installer
+        spec = self.runtime_config.get("install") or {
+            "type": "pip", "packages": list(self.DEFAULT_PACKAGES)}
+        installer.install("ai", spec)
 
     def with_environment_variables(
         self, config: Dict[str, Any], provider: Any, node_id: str

@@ -48,6 +48,9 @@ def render_zone_file(zone: str, records: List[Tuple[str, str]],
 
 class BindRuntime(ServiceRuntimeBase):
     SERVICE_NAME = "bind"
+    BINARY = "named"
+    CONF_FILE = "named.conf"
+    SERVICE_ARGS = ("{binary}", "-g", "-c", "{conf}")
     DEFAULT_PORT = DNS_PORT
     PROTOCOL = "udp"
     NODE_KIND = HEAD

@@ -180,12 +180,30 @@ class ServiceRuntimeBase(Runtime):
             f"runtime_config.binary_path or .install, or install it "
             f"on PATH)")
 
+    # Declarative service argv: "{binary}" / "{conf}" / "{conf_dir}" /
+    # "{port}" placeholders; CONF_FILE names the rendered config the
+    # command consumes (command withheld until node_configure wrote it).
+    CONF_FILE: str = ""
+    SERVICE_ARGS: Tuple[str, ...] = ()
+
     def service_command(
         self, node_context: Dict[str, Any]
     ) -> Optional[List[str]]:
         """argv for the long-running service process; None -> nothing to
-        spawn (config-only runtimes)."""
-        return None
+        spawn (config-only runtimes).  Default renders SERVICE_ARGS."""
+        if not self.SERVICE_ARGS:
+            return None
+        binary = self.find_binary()
+        if binary is None:
+            return None
+        conf_dir = self.conf_dir(node_context)
+        conf = os.path.join(conf_dir, self.CONF_FILE) \
+            if self.CONF_FILE else ""
+        if self.CONF_FILE and not os.path.exists(conf):
+            return None  # node_configure skipped this node
+        return [a.format(binary=binary, conf=conf, conf_dir=conf_dir,
+                         port=self.port)
+                for a in self.SERVICE_ARGS]
 
     def service_env(self, node_context: Dict[str, Any]) -> Dict[str, str]:
         return {}

@@ -61,6 +61,16 @@ def render_service_registrations(
 
 class ConsulRuntime(ServiceRuntimeBase):
     SERVICE_NAME = "consul"
+    BINARY = "consul"
+    CONF_FILE = "consul.json"
+    SERVICE_ARGS = ("{binary}", "agent", "-config-file", "{conf}")
+    # Reference: runtime/consul install recipe (single static binary zip).
+    INSTALL = {
+        "type": "archive",
+        "url": ("https://releases.hashicorp.com/consul/1.18.1/"
+                "consul_1.18.1_linux_amd64.zip"),
+        "strip_components": 0,
+    }
     DEFAULT_PORT = CONSUL_HTTP_PORT
     PROTOCOL = "http"
     NODE_KIND = ALL_NODES

@@ -36,6 +36,16 @@ def render_corefile(hosts_file: str, port: int = DNS_PORT,
 
 class CoreDNSRuntime(ServiceRuntimeBase):
     SERVICE_NAME = "coredns"
+    BINARY = "coredns"
+    CONF_FILE = "Corefile"
+    SERVICE_ARGS = ("{binary}", "-conf", "{conf}")
+    # Reference: runtime/coredns install recipe (single static binary).
+    INSTALL = {
+        "type": "archive",
+        "url": ("https://github.com/coredns/coredns/releases/download/"
+                "v1.11.3/coredns_1.11.3_linux_amd64.tgz"),
+        "strip_components": 0,
+    }
     DEFAULT_PORT = DNS_PORT
     PROTOCOL = "udp"
     NODE_KIND = HEAD

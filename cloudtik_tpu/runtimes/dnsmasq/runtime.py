@@ -36,6 +36,9 @@ def render_hosts_file(records: List[Tuple[str, str]]) -> str:
 
 class DnsmasqRuntime(ServiceRuntimeBase):
     SERVICE_NAME = "dnsmasq"
+    BINARY = "dnsmasq"
+    CONF_FILE = "dnsmasq.conf"
+    SERVICE_ARGS = ("{binary}", "-k", "-C", "{conf}")
     DEFAULT_PORT = DNS_PORT
     PROTOCOL = "udp"
     NODE_KIND = HEAD

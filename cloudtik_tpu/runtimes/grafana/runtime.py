@@ -1,6 +1,7 @@
 """Grafana runtime: dashboards with prometheus datasource via discovery.
 
-Reference parity: runtime/grafana (SURVEY.md §2.3).
+Reference parity: runtime/grafana (SURVEY.md §2.3 — install.sh release
+tarball + provisioned prometheus datasource).
 """
 
 from __future__ import annotations
@@ -8,37 +9,42 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, List, Optional, Tuple
 
-from cloudtik_tpu.core.runtime import Runtime
+from cloudtik_tpu.runtimes.common.runtime_base import (
+    HEAD, ServiceRuntimeBase)
 
 DEFAULT_PORT = 3000
 
 
-class GrafanaRuntime(Runtime):
-    def get_runtime_services(self, cluster_config, cluster_head_ip):
-        return {"grafana": {
-            "protocol": "http",
-            "port": self.runtime_config.get("port", DEFAULT_PORT),
-            "node_kind": "head"}}
-
-    def get_runtime_endpoints(self, cluster_config, cluster_head_ip):
-        port = self.runtime_config.get("port", DEFAULT_PORT)
-        return {"grafana": {"name": "Grafana",
-                            "url": f"http://{cluster_head_ip}:{port}"}}
-
-    def get_head_service_ports(self):
-        return {"grafana": {"protocol": "TCP",
-                            "port": self.runtime_config.get(
-                                "port", DEFAULT_PORT)}}
+class GrafanaRuntime(ServiceRuntimeBase):
+    SERVICE_NAME = "grafana"
+    DEFAULT_PORT = DEFAULT_PORT
+    PROTOCOL = "http"
+    NODE_KIND = HEAD
+    PROCESS_KEYWORD = "grafana"
+    ENDPOINT_NAME = "Grafana"
+    BINARY = "grafana"
+    CONF_FILE = "grafana.ini"
+    SERVICE_ARGS = ("{binary}", "server", "--config", "{conf}",
+                    "--homepath", "{conf_dir}")
+    # Reference: runtime/grafana/scripts/install.sh download recipe.
+    INSTALL = {
+        "type": "archive",
+        "url": ("https://dl.grafana.com/oss/release/"
+                "grafana-10.4.2.linux-amd64.tar.gz"),
+        "strip_components": 1,
+    }
 
     def node_configure(self, node_context: Dict[str, Any]) -> None:
-        if not node_context.get("is_head"):
+        if not self.runs_on(node_context):
             return
-        conf_dir = os.path.expanduser("~/.tik/grafana/provisioning/datasources")
-        os.makedirs(conf_dir, exist_ok=True)
+        conf_dir = self.conf_dir(node_context)
+        provisioning = os.path.join(conf_dir, "provisioning",
+                                    "datasources")
+        os.makedirs(provisioning, exist_ok=True)
         prometheus_url = node_context.get(
             "prometheus_url", "http://localhost:9090")
         import yaml
-        with open(os.path.join(conf_dir, "tik.yaml"), "w") as f:
+        with open(os.path.join(provisioning, "tik.yaml"), "w") as f:
             yaml.safe_dump({
                 "apiVersion": 1,
                 "datasources": [{
@@ -48,6 +54,11 @@ class GrafanaRuntime(Runtime):
                     "isDefault": True,
                 }],
             }, f)
+        with open(os.path.join(conf_dir, "grafana.ini"), "w") as f:
+            f.write("[server]\n"
+                    f"http_port = {self.port}\n"
+                    "[paths]\n"
+                    f"provisioning = {os.path.join(conf_dir, 'provisioning')}\n")
 
     def get_processes(self) -> Optional[List[Tuple[str, bool, str, str]]]:
         return [("grafana", False, "Grafana", "head")]

@@ -86,6 +86,9 @@ def backends_from_registry(registry, service_names: List[str],
 
 class HAProxyRuntime(ServiceRuntimeBase):
     SERVICE_NAME = "haproxy"
+    BINARY = "haproxy"
+    CONF_FILE = "haproxy.cfg"
+    SERVICE_ARGS = ("{binary}", "-f", "{conf}", "-db")
     DEFAULT_PORT = HAPROXY_PORT
     NODE_KIND = HEAD
     PROCESS_KEYWORD = "haproxy"

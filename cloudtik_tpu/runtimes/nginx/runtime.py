@@ -41,6 +41,9 @@ def render_nginx_conf(upstreams: List[Dict[str, Any]],
 
 class NginxRuntime(ServiceRuntimeBase):
     SERVICE_NAME = "nginx"
+    BINARY = "nginx"
+    CONF_FILE = "nginx.conf"
+    SERVICE_ARGS = ("{binary}", "-c", "{conf}", "-g", "daemon off;")
     DEFAULT_PORT = NGINX_PORT
     PROTOCOL = "http"
     NODE_KIND = HEAD

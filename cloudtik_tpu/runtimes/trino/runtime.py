@@ -48,6 +48,15 @@ def render_hive_catalog(metastore_host: str,
 
 class TrinoRuntime(ServiceRuntimeBase):
     SERVICE_NAME = "trino"
+    BINARY = "launcher"
+    SERVICE_ARGS = ("{binary}", "run", "--etc-dir", "{conf_dir}")
+    # Reference: runtime/trino install recipe (server release tarball).
+    INSTALL = {
+        "type": "archive",
+        "url": ("https://repo1.maven.org/maven2/io/trino/trino-server/"
+                "443/trino-server-443.tar.gz"),
+        "strip_components": 1,
+    }
     DEFAULT_PORT = TRINO_PORT
     PROTOCOL = "http"
     NODE_KIND = ALL_NODES

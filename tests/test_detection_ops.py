@@ -91,7 +91,7 @@ class TestROIAlign:
             jnp.arange(W, dtype=jnp.float32), (1, H, W))
         rois = jnp.asarray([[0.0, 0.0, 8.0, 8.0]], jnp.float32)
         out = roi_align(ramp, rois, pooled_size=4, sampling_ratio=2,
-                        interpret=True)
+                        implementation="pallas", interpret=True)
         # each pooled column averages its two sample columns of the ramp
         expect = roi_align_reference(ramp, rois, pooled_size=4,
                                      sampling_ratio=2)
@@ -101,9 +101,11 @@ class TestROIAlign:
         col = np.asarray(out)[0, 0, 0]
         assert (np.diff(col) > 0).all()
 
+    @pytest.mark.parametrize("implementation", ["xla", "pallas"])
     @pytest.mark.parametrize("pooled,sampling,scale", [
         (7, 2, 1.0), (7, 2, 0.25), (14, 1, 0.5)])
-    def test_parity_with_reference(self, pooled, sampling, scale):
+    def test_parity_with_reference(self, pooled, sampling, scale,
+                                   implementation):
         rng = np.random.default_rng(7)
         features = jnp.asarray(
             rng.normal(size=(8, 16, 24)).astype(np.float32))
@@ -113,7 +115,7 @@ class TestROIAlign:
              [5.5, 1.5, 22.5, 14.0]], jnp.float32)
         out = roi_align(features, rois, pooled_size=pooled,
                         sampling_ratio=sampling, spatial_scale=scale,
-                        interpret=True)
+                        implementation=implementation, interpret=True)
         expect = roi_align_reference(
             features, rois, pooled_size=pooled,
             sampling_ratio=sampling, spatial_scale=scale)

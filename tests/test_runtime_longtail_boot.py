@@ -44,6 +44,15 @@ CASES = {
     "elasticsearch": ("elasticsearch", True, False),
     "minio": ("minio", True, False),
     "redis": ("redis-server", True, False),
+    # gateways / DNS / engines on the declarative SERVICE_ARGS path
+    "haproxy": ("haproxy", True, False),
+    "nginx": ("nginx", True, False),
+    "dnsmasq": ("dnsmasq", True, False),
+    "coredns": ("coredns", True, False),
+    "bind": ("named", True, False),
+    "consul": ("consul", True, False),
+    "grafana": ("grafana", True, False),
+    "trino": ("launcher", True, False),
 }
 
 
