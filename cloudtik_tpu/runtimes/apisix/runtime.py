@@ -36,6 +36,8 @@ def render_apisix_yaml(services: List[Dict[str, Any]]) -> str:
 
 class APISIXRuntime(ServiceRuntimeBase):
     SERVICE_NAME = "apisix"
+    BINARY = "apisix"
+    CONF_FILE = "apisix.yaml"
     DEFAULT_PORT = APISIX_PORT
     PROTOCOL = "http"
     NODE_KIND = HEAD

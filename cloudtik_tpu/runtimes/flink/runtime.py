@@ -40,6 +40,32 @@ class FlinkRuntime(ServiceRuntimeBase):
     NODE_KIND = ALL_NODES
     PROCESS_KEYWORD = "flink"
     ENDPOINT_NAME = "Flink Dashboard"
+    BINARY = "jobmanager.sh"
+    # Reference: runtime/flink install recipe (release tarball).
+    INSTALL = {
+        "type": "archive",
+        "url": ("https://archive.apache.org/dist/flink/flink-1.18.1/"
+                "flink-1.18.1-bin-scala_2.12.tgz"),
+        "strip_components": 1,
+    }
+
+    def service_command(self, node_context):
+        import os
+        binary = self.find_binary()
+        if binary is None:
+            return None
+        if node_context.get("is_head"):
+            return [binary, "start-foreground"]
+        tm = os.path.join(os.path.dirname(binary), "taskmanager.sh")
+        return [tm, "start-foreground"] if os.access(tm, os.X_OK) else None
+
+    def service_env(self, node_context):
+        from cloudtik_tpu.runtimes import installer
+        return {"FLINK_CONF_DIR": self.conf_dir(node_context),
+                "FLINK_HOME": installer.install_dir(self.SERVICE_NAME)}
+
+    def service_ready_port(self, node_context):
+        return self.port if node_context.get("is_head") else None
 
     def node_configure(self, node_context: Dict[str, Any]) -> None:
         import os

@@ -41,6 +41,8 @@ def render_kong_declarative(services: List[Dict[str, Any]]) -> str:
 
 class KongRuntime(ServiceRuntimeBase):
     SERVICE_NAME = "kong"
+    BINARY = "kong"
+    CONF_FILE = "kong.yml"
     DEFAULT_PORT = KONG_PROXY_PORT
     PROTOCOL = "http"
     NODE_KIND = HEAD

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import os
 import shutil
-import subprocess
 from typing import Any, Dict, List, Optional, Tuple
 
 from cloudtik_tpu.core.runtime import Runtime
@@ -36,19 +35,37 @@ class MLflowRuntime(Runtime):
             "protocol": "TCP",
             "port": self.runtime_config.get("port", DEFAULT_PORT)}}
 
+    def node_install(self, node_context: Dict[str, Any]) -> None:
+        """pip-install mlflow when absent (reference: ai install.sh:48-54
+        pinning the MLflow server)."""
+        if not node_context.get("is_head") or shutil.which("mlflow"):
+            return
+        from cloudtik_tpu.runtimes import installer
+        spec = self.runtime_config.get("install") or {
+            "type": "pip", "packages": ["mlflow"]}
+        installer.install("mlflow", spec)
+
     def node_services(self, node_context: Dict[str, Any], command: str) -> None:
+        from cloudtik_tpu.runtimes.common import process_runner
+
         if not node_context.get("is_head"):
             return
-        if command == "start" and shutil.which("mlflow"):
-            backend_dir = os.path.expanduser("~/.tik/mlflow")
-            os.makedirs(backend_dir, exist_ok=True)
-            subprocess.Popen([
-                "mlflow", "server",
-                "--host", "0.0.0.0",
-                "--port", str(self.runtime_config.get("port", DEFAULT_PORT)),
-                "--backend-store-uri", f"sqlite:///{backend_dir}/mlflow.db",
-                "--default-artifact-root", f"{backend_dir}/artifacts",
-            ], stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        if command == "stop":
+            process_runner.stop_service("mlflow")
+            return
+        if command != "start" or not shutil.which("mlflow"):
+            return
+        backend_dir = os.path.expanduser("~/.tik/mlflow")
+        os.makedirs(backend_dir, exist_ok=True)
+        port = self.runtime_config.get("port", DEFAULT_PORT)
+        process_runner.spawn_service("mlflow", [
+            "mlflow", "server",
+            "--host", "0.0.0.0",
+            "--port", str(port),
+            "--backend-store-uri", f"sqlite:///{backend_dir}/mlflow.db",
+            "--default-artifact-root", f"{backend_dir}/artifacts",
+        ])
+        process_runner.wait_for_port("mlflow", int(port), timeout_s=60)
 
     def get_processes(self) -> Optional[List[Tuple[str, bool, str, str]]]:
         return [("mlflow", True, "MLflow", "head")]

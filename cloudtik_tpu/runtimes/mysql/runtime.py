@@ -44,6 +44,10 @@ def render_my_cnf(server_id: int, port: int = MYSQL_PORT,
 
 class MySQLRuntime(ServiceRuntimeBase):
     SERVICE_NAME = "mysql"
+    BINARY = "mysqld"
+    CONF_FILE = "my.cnf"
+    SERVICE_ARGS = ("{binary}", "--defaults-file={conf}",
+                    "--port={port}")
     DEFAULT_PORT = MYSQL_PORT
     NODE_KIND = ALL_NODES
     PROCESS_KEYWORD = "mysqld"

@@ -37,6 +37,9 @@ def render_pgbouncer_ini(primary_ip: str, primary_port: int = 5432,
 
 class PgBouncerRuntime(ServiceRuntimeBase):
     SERVICE_NAME = "pgbouncer"
+    BINARY = "pgbouncer"
+    CONF_FILE = "pgbouncer.ini"
+    SERVICE_ARGS = ("{binary}", "{conf}")
     DEFAULT_PORT = PGBOUNCER_PORT
     NODE_KIND = HEAD
     PROCESS_KEYWORD = "pgbouncer"

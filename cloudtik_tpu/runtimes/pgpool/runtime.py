@@ -41,6 +41,9 @@ def render_pgpool_conf(backends: List[Dict[str, Any]],
 
 class PgpoolRuntime(ServiceRuntimeBase):
     SERVICE_NAME = "pgpool"
+    BINARY = "pgpool"
+    CONF_FILE = "pgpool.conf"
+    SERVICE_ARGS = ("{binary}", "-n", "-f", "{conf}")
     DEFAULT_PORT = PGPOOL_PORT
     NODE_KIND = HEAD
     PROCESS_KEYWORD = "pgpool"

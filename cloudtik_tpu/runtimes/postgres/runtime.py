@@ -60,6 +60,28 @@ def render_replica_conninfo(primary_ip: str, port: int = PG_PORT,
 
 class PostgresRuntime(ServiceRuntimeBase):
     SERVICE_NAME = "postgres"
+    BINARY = "postgres"
+    CONF_FILE = "postgresql.conf"
+
+    def service_command(self, node_context):
+        import os
+        conf = os.path.join(self.conf_dir(node_context),
+                            "postgresql.conf")
+        binary = self.find_binary()
+        if binary is None or not os.path.exists(conf):
+            return None
+        data_dir = os.path.expanduser(self.runtime_config.get(
+            "data_dir", "~/.tik/postgres/data"))
+        if not os.path.exists(os.path.join(data_dir, "PG_VERSION")):
+            # first boot: initdb from the same installation
+            import subprocess
+            initdb = os.path.join(os.path.dirname(binary), "initdb")
+            if os.access(initdb, os.X_OK):
+                subprocess.run([initdb, "-D", data_dir, "-U", "tik"],
+                               capture_output=True)
+        return [binary, "-D", data_dir,
+                "-c", f"config_file={conf}",
+                "-p", str(self.port)]
     DEFAULT_PORT = PG_PORT
     NODE_KIND = ALL_NODES
     PROCESS_KEYWORD = "postgres"

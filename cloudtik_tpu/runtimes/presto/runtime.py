@@ -24,6 +24,15 @@ class PrestoRuntime(ServiceRuntimeBase):
     NODE_KIND = ALL_NODES
     PROCESS_KEYWORD = "com.facebook.presto.server.PrestoServer"
     ENDPOINT_NAME = "Presto"
+    BINARY = "launcher"
+    SERVICE_ARGS = ("{binary}", "run", "--etc-dir", "{conf_dir}")
+    # Reference: runtime/presto install recipe (server release tarball).
+    INSTALL = {
+        "type": "archive",
+        "url": ("https://repo1.maven.org/maven2/com/facebook/presto/"
+                "presto-server/0.287/presto-server-0.287.tar.gz"),
+        "strip_components": 1,
+    }
 
     def node_configure(self, node_context: Dict[str, Any]) -> None:
         import os

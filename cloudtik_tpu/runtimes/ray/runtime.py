@@ -59,6 +59,24 @@ class RayRuntime(ServiceRuntimeBase):
     NODE_KIND = ALL_NODES
     PROCESS_KEYWORD = "raylet"
     ENDPOINT_NAME = "Ray Dashboard"
+    BINARY = "ray"
+    # pip package provides the binary; configs may point install at a
+    # wheel mirror (reference: runtime/ray install recipe).
+    INSTALL = {"type": "pip", "packages": ["ray[default]"]}
+
+    def service_command(self, node_context):
+        binary = self.find_binary()
+        if binary is None:
+            return None
+        if node_context.get("is_head"):
+            return [binary, "start", "--head", "--block",
+                    f"--port={self.port}"]
+        head_ip = node_context.get("head_ip", "127.0.0.1")
+        return [binary, "start", "--block",
+                f"--address={head_ip}:{self.port}"]
+
+    def service_ready_port(self, node_context):
+        return self.port if node_context.get("is_head") else None
 
     def node_configure(self, node_context: Dict[str, Any]) -> None:
         import json

@@ -53,6 +53,11 @@ CASES = {
     "consul": ("consul", True, False),
     "grafana": ("grafana", True, False),
     "trino": ("launcher", True, False),
+    "mysql": ("mysqld", True, False),
+    "flink": ("jobmanager.sh", True, False),
+    "presto": ("launcher", True, False),
+    "pgbouncer": ("pgbouncer", True, False),
+    "pgpool": ("pgpool", True, False),
 }
 
 
