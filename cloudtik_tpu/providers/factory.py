@@ -37,6 +37,9 @@ _WORKSPACE_PROVIDERS: Dict[str, str] = {
 _STORAGE_PROVIDERS: Dict[str, str] = {
     "gcp": "cloudtik_tpu.providers.gcp.storage_provider:GCSStorageProvider",
     "aws": "cloudtik_tpu.providers.aws.storage_provider:S3StorageProvider",
+    "azure": "cloudtik_tpu.providers.azure.storage_provider:AzureBlobStorageProvider",
+    "aliyun": "cloudtik_tpu.providers.aliyun.storage_provider:OSSStorageProvider",
+    "huaweicloud": "cloudtik_tpu.providers.huaweicloud.storage_provider:OBSStorageProvider",
 }
 
 _DATABASE_PROVIDERS: Dict[str, str] = {

@@ -275,3 +275,102 @@ class TestHuaweiWorkspace:
         p.delete_workspace({})
         assert p.check_workspace_existence({}) == Existence.NOT_EXIST
         assert not fake.nats and not fake.groups
+
+
+# ------------------------------------------------- per-cloud storage --
+
+class FakeAzureBlob:
+    class _Container:
+        def __init__(self, parent, name):
+            self.parent, self.name = parent, name
+
+        def get_container_properties(self):
+            if self.name not in self.parent.containers:
+                raise KeyError(self.name)
+            return {"metadata": self.parent.containers[self.name]}
+
+    def __init__(self):
+        self.containers: Dict[str, Dict[str, str]] = {}
+
+    def create_container(self, name, metadata=None):
+        if name in self.containers:
+            e = RuntimeError("exists")
+            e.error_code = "ContainerAlreadyExists"
+            raise e
+        self.containers[name] = dict(metadata or {})
+
+    def delete_container(self, name):
+        if name not in self.containers:
+            e = RuntimeError("missing")
+            e.error_code = "ContainerNotFound"
+            raise e
+        del self.containers[name]
+
+    def get_container_client(self, name):
+        return self._Container(self, name)
+
+
+class FakeObjectStore:
+    """Shared fake for the OSS/OBS snake_case bucket surfaces."""
+
+    def __init__(self):
+        self.buckets: Dict[str, Dict[str, Any]] = {}
+        self.objects: Dict[str, list] = {}
+
+    # oss surface
+    def put_bucket(self, bucket_name, region):
+        self.buckets[bucket_name] = {"region": region}
+        self.objects[bucket_name] = []
+
+    def get_bucket_info(self, bucket_name):
+        return self.buckets.get(bucket_name)
+
+    def delete_bucket(self, bucket_name):
+        del self.buckets[bucket_name]
+
+    def list_objects(self, bucket_name):
+        return list(self.objects.get(bucket_name, []))
+
+    def delete_objects(self, bucket_name, keys):
+        self.objects[bucket_name] = [
+            k for k in self.objects[bucket_name] if k not in keys]
+
+    # obs surface
+    def create_bucket(self, bucket_name, location):
+        self.put_bucket(bucket_name, location)
+
+    def head_bucket(self, bucket_name):
+        return bucket_name in self.buckets
+
+
+class TestPerCloudStorage:
+    def test_azure_blob_cycle(self):
+        from cloudtik_tpu.providers.factory import create_storage_provider
+        blob = FakeAzureBlob()
+        sp = create_storage_provider(
+            {"type": "azure", "subscription_id": "s",
+             "blob_service_client": blob}, "ws", "data")
+        assert sp.get_info({}) is None
+        sp.create({})
+        info = sp.get_info({})
+        assert info["managed"] and "tik-ws-data" in info["uri"]
+        sp.create({})  # idempotent
+        sp.delete({})
+        assert sp.get_info({}) is None
+        sp.delete({})  # idempotent
+
+    @pytest.mark.parametrize("ptype,key,scheme", [
+        ("aliyun", "oss_client", "oss"),
+        ("huaweicloud", "obs_client", "obs"),
+    ])
+    def test_object_store_cycle(self, ptype, key, scheme):
+        from cloudtik_tpu.providers.factory import create_storage_provider
+        store = FakeObjectStore()
+        sp = create_storage_provider(
+            {"type": ptype, key: store}, "ws", "data")
+        assert sp.get_info({}) is None
+        sp.create({})
+        assert sp.get_info({})["uri"] == f"{scheme}://tik-ws-data"
+        store.objects["tik-ws-data"].append("shard-0000")
+        sp.delete({})  # drains objects first
+        assert sp.get_info({}) is None
