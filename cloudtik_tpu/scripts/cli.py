@@ -462,8 +462,10 @@ def node_start(is_head, node_id, head_ip, daemonize):
         return
     config = load_bootstrap_config()
     node_id = node_id or os.environ.get("TIK_NODE_ID", "head")
+    from cloudtik_tpu.utils.constants import TIK_STATE_PORT_DEFAULT
     starter = NodeServicesStarter(
-        config, node_id, is_head=is_head, head_ip=head_ip)
+        config, node_id, is_head=is_head, head_ip=head_ip,
+        state_port=config.get("state_port", TIK_STATE_PORT_DEFAULT))
     if is_head:
         starter.start_head_processes()
     else:
