@@ -339,33 +339,45 @@ def hidden_states(
     from cloudtik_tpu.parallel.pipeline import pipe_axis_size, pipeline_apply
     n_stages = pipe_axis_size()
     if n_stages > 1:
-        # GPipe over the pipe axis: each stage scans its local layer slice;
-        # positions ride the pipeline with each microbatch.
-        if cfg.is_moe:
-            raise NotImplementedError(
-                "MoE layers under pipeline parallelism are not supported "
-                "yet (router aux losses don't cross stages)")
+        # GPipe over the pipe axis: each stage scans its local layer
+        # slice; positions ride the pipeline with each microbatch, and
+        # MoE router losses accumulate along the ride (per-microbatch
+        # statistics — the standard GPipe formulation).
+        n_micro = cfg.pipeline_microbatches or n_stages
+        aux_init = ({"moe_aux_loss": 0.0, "moe_z_loss": 0.0,
+                     "moe_drop_fraction": 0.0} if cfg.is_moe else None)
 
         def stage(stage_params, x_micro, pos_micro):
             def body(carry, layer_params):
-                carry, _ = layer_fn(carry, layer_params, pos_micro)
-                return carry, None
-            out, _ = jax.lax.scan(body, x_micro, stage_params,
-                                  unroll=cfg.scan_unroll)
-            return out
+                carry, layer_aux = layer_fn(carry, layer_params, pos_micro)
+                return carry, layer_aux
+            out, aux_stacked = jax.lax.scan(body, x_micro, stage_params,
+                                            unroll=cfg.scan_unroll)
+            if aux_init is None:
+                return out
+            return out, {k: v.sum() for k, v in aux_stacked.items()}
 
-        x = pipeline_apply(
+        result = pipeline_apply(
             stage, params["layers"], x,
-            n_microbatches=cfg.pipeline_microbatches or n_stages,
-            extras=positions)
-        aux_stacked: Dict[str, jax.Array] = {}
-    else:
-        def scan_body(carry, layer_params):
-            carry, aux = layer_fn(carry, layer_params, positions)
-            return carry, aux
+            n_microbatches=n_micro,
+            extras=positions, aux_init=aux_init)
+        if cfg.is_moe:
+            x, aux_sum = result
+            # summed over layers and microbatches -> mean over both,
+            # matching the non-pipe path's per-layer mean
+            aux = {k: v / (cfg.n_layers * n_micro)
+                   for k, v in aux_sum.items()}
+        else:
+            x, aux = result, {}
+        x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+        return x, aux
 
-        x, aux_stacked = jax.lax.scan(scan_body, x, params["layers"],
-                                      unroll=cfg.scan_unroll)
+    def scan_body(carry, layer_params):
+        carry, aux = layer_fn(carry, layer_params, positions)
+        return carry, aux
+
+    x, aux_stacked = jax.lax.scan(scan_body, x, params["layers"],
+                                  unroll=cfg.scan_unroll)
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     aux = {k: v.mean() for k, v in aux_stacked.items()}
     return x, aux

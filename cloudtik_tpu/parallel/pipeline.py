@@ -19,7 +19,7 @@ multiples of the stage count to amortize.
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -34,25 +34,34 @@ def pipe_axis_size(axis: str = "pipe") -> int:
 
 
 def pipeline_apply(
-    stage_fn: Callable[..., jax.Array],
+    stage_fn: Callable[..., Any],
     stacked_params: Any,
     x: jax.Array,
     *,
     n_microbatches: int,
     extras: Any = None,
+    aux_init: Any = None,
     axis: str = "pipe",
-) -> jax.Array:
+):
     """Apply a pipe-sharded layer stack to x with a GPipe schedule.
 
-    stage_fn(stage_params, x_micro, extras_micro) -> y_micro applies one
-    stage's local slice of the layer stack; y must have x's shape/dtype
-    (residual-stream semantics).  stacked_params is a pytree whose leaves
-    have leading dim L, sharded over `axis` (rule "layers" -> "pipe").
-    x: [B, ...] with B divisible by n_microbatches.  extras: optional
-    pytree of per-example arrays ([B, ...]) each stage needs for its
-    current microbatch (e.g. positions); they ride the pipeline alongside
-    the activations.  With no `pipe` axis on the mesh (or size 1) this
-    reduces to running all layers locally — same code, any mesh.
+    stage_fn(stage_params, x_micro, extras_micro) applies one stage's
+    local slice of the layer stack and returns y_micro (x's shape/dtype —
+    residual-stream semantics), or (y_micro, aux) when `aux_init` is
+    given.  stacked_params is a pytree whose leaves have leading dim L,
+    sharded over `axis` (rule "layers" -> "pipe").  x: [B, ...] with B
+    divisible by n_microbatches.  extras: optional pytree of per-example
+    arrays ([B, ...]) each stage needs for its current microbatch (e.g.
+    positions); they ride the pipeline alongside the activations.
+
+    aux_init: optional pytree of f32 scalars (e.g. MoE router losses).
+    Each stage ADDS its contribution for the microbatch it is processing;
+    the accumulator rides the pipeline with the activations, and the
+    return becomes (y, aux_sum) where aux_sum is summed over stages AND
+    microbatches (divide by layers * microbatches for a mean).
+
+    With no `pipe` axis on the mesh (or size 1) this reduces to running
+    all layers locally — same code, any mesh.
     """
     n_stages = pipe_axis_size(axis)
     M = n_microbatches
@@ -60,6 +69,7 @@ def pipeline_apply(
     if B % M:
         raise ValueError(
             f"batch {B} not divisible by n_microbatches {M}")
+    with_aux = aux_init is not None
     if n_stages == 1:
         return stage_fn(stacked_params, x, extras)
 
@@ -71,25 +81,29 @@ def pipeline_apply(
     xs = x.reshape(M, B // M, *x.shape[1:]).astype(jnp.float32)
     extras_s = jax.tree.map(
         lambda e: e.reshape(M, B // M, *e.shape[1:]), extras)
+    aux_zero = jax.tree.map(
+        lambda a: jnp.zeros((), jnp.float32), aux_init)
 
     inner = functools.partial(
         _staged, stage_fn, n_stages=n_stages, n_micro=M, axis=axis,
-        dtype=x.dtype)
+        dtype=x.dtype, with_aux=with_aux)
     # Manual over `pipe` only: params enter stage-sliced on the stacked
     # layer dim; activations replicated across pipe (other axes stay auto).
-    return jax.shard_map(
+    out, aux = jax.shard_map(
         inner,
         in_specs=(jax.tree.map(lambda _: P(axis), stacked_params),
-                  P(), jax.tree.map(lambda _: P(), extras_s)),
-        out_specs=P(),
+                  P(), jax.tree.map(lambda _: P(), extras_s),
+                  jax.tree.map(lambda _: P(), aux_zero)),
+        out_specs=(P(), jax.tree.map(lambda _: P(), aux_zero)),
         axis_names={axis},
         check_vma=False,
-    )(stacked_params, xs, extras_s).astype(x.dtype).reshape(
-        B, *x.shape[1:])
+    )(stacked_params, xs, extras_s, aux_zero)
+    out = out.astype(x.dtype).reshape(B, *x.shape[1:])
+    return (out, aux) if with_aux else out
 
 
-def _staged(stage_fn, params_local, xs, extras_s, *, n_stages, n_micro,
-            axis, dtype):
+def _staged(stage_fn, params_local, xs, extras_s, aux_zero, *, n_stages,
+            n_micro, axis, dtype, with_aux):
     """Body run per pipe group: M + P - 1 ticks of compute + ppermute."""
     xs = xs.astype(dtype)  # back to compute dtype past the f32 boundary
     idx = lax.axis_index(axis)
@@ -97,7 +111,7 @@ def _staged(stage_fn, params_local, xs, extras_s, *, n_stages, n_micro,
     x_shape = xs.shape[1:]
 
     def tick(carry, t):
-        state, state_extras, outputs = carry
+        state, state_extras, state_aux, aux_total, outputs = carry
         mb = jnp.clip(t, 0, n_micro - 1)
         inp = lax.dynamic_index_in_dim(xs, mb, 0, keepdims=False)
         inp_extras = jax.tree.map(
@@ -109,25 +123,40 @@ def _staged(stage_fn, params_local, xs, extras_s, *, n_stages, n_micro,
         e_in = jax.tree.map(
             lambda fresh, held: jnp.where(idx == 0, fresh, held),
             inp_extras, state_extras)
-        y = stage_fn(params_local, x_in, e_in)
+        aux_in = jax.tree.map(
+            lambda held: jnp.where(idx == 0, 0.0, held), state_aux)
+        if with_aux:
+            y, aux_local = stage_fn(params_local, x_in, e_in)
+            aux_out = jax.tree.map(
+                lambda a, b: a + b.astype(jnp.float32), aux_in, aux_local)
+        else:
+            y = stage_fn(params_local, x_in, e_in)
+            aux_out = aux_in
         # Last stage emits finished microbatch t - (P-1).
+        valid = (idx == n_stages - 1) & (t >= n_stages - 1)
         out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
         cur = lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
-        emit = jnp.where((idx == n_stages - 1) & (t >= n_stages - 1),
-                         y, cur)
+        emit = jnp.where(valid, y, cur)
         outputs = lax.dynamic_update_index_in_dim(outputs, emit, out_idx, 0)
+        aux_total = jax.tree.map(
+            lambda total, a: total + jnp.where(valid, a, 0.0),
+            aux_total, aux_out)
         state = lax.ppermute(y, axis, perm)
         state_extras = jax.tree.map(
             lambda e: lax.ppermute(e, axis, perm), e_in)
-        return (state, state_extras, outputs), None
+        state_aux = jax.tree.map(
+            lambda a: lax.ppermute(a, axis, perm), aux_out)
+        return (state, state_extras, state_aux, aux_total, outputs), None
 
     carry0 = (
         jnp.zeros(x_shape, xs.dtype),
         jax.tree.map(
             lambda e: jnp.zeros(e.shape[1:], e.dtype), extras_s),
+        jax.tree.map(lambda a: jnp.zeros((), jnp.float32), aux_zero),
+        jax.tree.map(lambda a: jnp.zeros((), jnp.float32), aux_zero),
         jnp.zeros_like(xs),
     )
-    (_, _, outputs), _ = lax.scan(
+    (_, _, _, aux_total, outputs), _ = lax.scan(
         tick, carry0, jnp.arange(n_micro + n_stages - 1))
     # Only the last stage holds real outputs; all_gather + index broadcasts
     # them so the (replicated-over-pipe) caller continues identically
@@ -135,5 +164,12 @@ def _staged(stage_fn, params_local, xs, extras_s, *, n_stages, n_micro,
     # (psum forward, psum-scatter as this gather's transpose) under
     # partial-auto shard_map hard-crash XLA's SPMD partitioner ("Invalid
     # binary instruction opcode copy"), so both directions must ride f32.
-    return lax.all_gather(
+    out = lax.all_gather(
         outputs.astype(jnp.float32), axis)[n_stages - 1]
+    # aux is f32 scalars: the masked psum broadcast is safe here (the
+    # partitioner crash is bf16-specific).
+    aux = jax.tree.map(
+        lambda total: lax.psum(
+            jnp.where(idx == n_stages - 1, total, 0.0), axis),
+        aux_total)
+    return out, aux
