@@ -197,6 +197,33 @@ class AzureNodeProvider(NodeProvider):
         return None
 
     # -- mutation ----------------------------------------------------------
+    def _subnet_id(self, node_config) -> str:
+        """Deterministic ARM resource path for the node's subnet (the
+        workspace-provider naming scheme; overridable per node)."""
+        names = workspace_resource_names(
+            self.provider_config.get("workspace_name", "default"))
+        sub = self.provider_config.get("subscription_id", "")
+        vnet = node_config.get("vnet", names["vnet"])
+        subnet = node_config.get("subnet", names["private_subnet"])
+        return (f"/subscriptions/{sub}/resourceGroups/"
+                f"{self.resource_group}/providers/Microsoft.Network/"
+                f"virtualNetworks/{vnet}/subnets/{subnet}")
+
+    def _ensure_nic(self, vm_name: str, node_config) -> str:
+        """Create the VM's NIC in the workspace subnet; returns its id."""
+        poller = self.network.network_interfaces.begin_create_or_update(
+            self.resource_group, f"{vm_name}-nic",
+            {"location": self.location,
+             "ip_configurations": [{
+                 "name": "primary",
+                 "subnet": {"id": self._subnet_id(node_config)}}]})
+        nic = poller.result() if hasattr(poller, "result") else poller
+        nic_id = getattr(nic, "id", None)
+        if nic_id is None and isinstance(nic, dict):
+            nic_id = nic.get("id")
+        return nic_id or (f"{self._subnet_id(node_config)}"
+                          f"/../networkInterfaces/{vm_name}-nic")
+
     def create_node(self, node_config, tags, count):
         created = {}
         for _ in range(count):
@@ -206,7 +233,8 @@ class AzureNodeProvider(NodeProvider):
             vm_name = (f"tik-{self.cluster_name}-"
                        f"{tags.get('tik-node-kind', 'node')}-"
                        f"{uuid.uuid4().hex[:8]}")
-            nic_id = node_config.get("nic_id", "")
+            nic_id = node_config.get("nic_id") or \
+                self._ensure_nic(vm_name, node_config)
             params = build_vm_parameters(
                 node_config, dict(tags,
                                   **{"tik-cluster-name":
@@ -242,3 +270,25 @@ class AzureNodeProvider(NodeProvider):
         if not provider_config.get("compute_client") and \
                 not provider_config.get("subscription_id"):
             raise ValueError("azure provider requires subscription_id")
+
+    @staticmethod
+    def bootstrap_config(cluster_config: Dict[str, Any]) -> Dict[str, Any]:
+        """Fill workspace-derived network defaults: resource group, and
+        per-node-type vnet/subnet (head on the public subnet, workers on
+        the private one) — reference parity with the _azure config.py
+        bootstrap."""
+        provider = cluster_config.setdefault("provider", {})
+        workspace = cluster_config.get("workspace_name", "default")
+        names = workspace_resource_names(workspace)
+        provider.setdefault("workspace_name", workspace)
+        provider.setdefault("resource_group", names["resource_group"])
+        head_type = cluster_config.get("head_node_type")
+        for type_name, node_type in cluster_config.get(
+                "available_node_types", {}).items():
+            node_config = node_type.setdefault("node_config", {})
+            node_config.setdefault("vnet", names["vnet"])
+            node_config.setdefault(
+                "subnet",
+                names["public_subnet"] if type_name == head_type
+                else names["private_subnet"])
+        return cluster_config

@@ -374,3 +374,78 @@ class TestPerCloudStorage:
         store.objects["tik-ws-data"].append("shard-0000")
         sp.delete({})  # drains objects first
         assert sp.get_info({}) is None
+
+
+class TestAzureNodeBootstrap:
+    """Azure bootstrap_config fills workspace network defaults, and
+    create_node provisions the VM's NIC in the workspace subnet."""
+
+    def test_bootstrap_fills_network_defaults(self):
+        from cloudtik_tpu.providers.azure.node_provider import (
+            AzureNodeProvider)
+        config = {
+            "workspace_name": "ws",
+            "head_node_type": "head",
+            "provider": {"type": "azure", "subscription_id": "sub"},
+            "available_node_types": {
+                "head": {"node_config": {}},
+                "worker": {"node_config": {}},
+            },
+        }
+        out = AzureNodeProvider.bootstrap_config(config)
+        assert out["provider"]["resource_group"] == "tik-ws-rg"
+        head = out["available_node_types"]["head"]["node_config"]
+        worker = out["available_node_types"]["worker"]["node_config"]
+        assert head["subnet"] == "tik-ws-public"
+        assert worker["subnet"] == "tik-ws-private"
+        assert head["vnet"] == worker["vnet"] == "tik-ws-vnet"
+
+    def test_create_node_provisions_nic(self):
+        from cloudtik_tpu.providers.azure.node_provider import (
+            AzureNodeProvider)
+
+        class FakeNics:
+            def __init__(self):
+                self.created = {}
+
+            def begin_create_or_update(self, rg, name, params):
+                self.created[name] = params
+                return _Poller({"id": f"/nic/{name}"})
+
+        class FakeVMs:
+            def __init__(self):
+                self.vms = {}
+
+            def begin_create_or_update(self, rg, name, params):
+                self.vms[name] = params
+
+            def list(self, rg):
+                return []
+
+        class FakeCompute:
+            def __init__(self):
+                self.virtual_machines = FakeVMs()
+
+        class FakeNetwork:
+            def __init__(self):
+                self.network_interfaces = FakeNics()
+
+        compute, network = FakeCompute(), FakeNetwork()
+        provider = AzureNodeProvider(
+            {"subscription_id": "sub", "workspace_name": "ws",
+             "resource_group": "tik-ws-rg", "location": "eastus",
+             "compute_client": compute, "network_client": network},
+            "c1")
+        provider.create_node(
+            {"subnet": "tik-ws-private", "vnet": "tik-ws-vnet",
+             "vm_size": "Standard_D4s_v5"},
+            {"tik-node-kind": "worker"}, 1)
+        assert len(network.network_interfaces.created) == 1
+        nic_name, nic = next(
+            iter(network.network_interfaces.created.items()))
+        subnet_id = nic["ip_configurations"][0]["subnet"]["id"]
+        assert subnet_id.endswith(
+            "virtualNetworks/tik-ws-vnet/subnets/tik-ws-private")
+        vm = next(iter(compute.virtual_machines.vms.values()))
+        assert vm["network_profile"]["network_interfaces"][0][
+            "id"] == f"/nic/{nic_name}"
