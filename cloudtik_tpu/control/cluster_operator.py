@@ -149,19 +149,21 @@ def get_or_create_head_node(
 
     if head_id is None:
         cli_logger.info("Creating new head node...")
-        provider.create_node(head_config, {
-            TAG_CLUSTER_NAME: cluster_name,
-            TAG_NODE_KIND: NODE_KIND_HEAD,
-            TAG_NODE_STATUS: STATUS_UNINITIALIZED,
-            TAG_USER_NODE_TYPE: head_type,
-            TAG_LAUNCH_CONFIG: launch_hash,
-        }, 1)
-        deadline = time.time() + 300
-        while time.time() < deadline:
-            head_id = _find_head(provider, cluster_name)
-            if head_id and provider.internal_ip(head_id):
-                break
-            time.sleep(2)
+        from cloudtik_tpu.utils.log_timer import LogTimer
+        with LogTimer(f"head node create ({cluster_name})"):
+            provider.create_node(head_config, {
+                TAG_CLUSTER_NAME: cluster_name,
+                TAG_NODE_KIND: NODE_KIND_HEAD,
+                TAG_NODE_STATUS: STATUS_UNINITIALIZED,
+                TAG_USER_NODE_TYPE: head_type,
+                TAG_LAUNCH_CONFIG: launch_hash,
+            }, 1)
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                head_id = _find_head(provider, cluster_name)
+                if head_id and provider.internal_ip(head_id):
+                    break
+                time.sleep(2)
         if head_id is None:
             raise RuntimeError("head node did not appear after create")
 

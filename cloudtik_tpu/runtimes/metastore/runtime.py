@@ -47,6 +47,23 @@ class MetastoreRuntime(ServiceRuntimeBase):
     NODE_KIND = HEAD
     PROCESS_KEYWORD = "HiveMetaStore"
     DEPENDENCIES = ["mysql"]
+    BINARY = "start-metastore"
+    CONF_FILE = "hive-site.xml"
+    SERVICE_ARGS = ("{binary}", "-p", "{port}")
+    # Reference: runtime/metastore install recipe (standalone metastore).
+    INSTALL = {
+        "type": "archive",
+        "url": ("https://archive.apache.org/dist/hive/"
+                "hive-standalone-metastore-3.0.0/"
+                "hive-standalone-metastore-3.0.0-bin.tar.gz"),
+        "strip_components": 1,
+    }
+
+    def service_env(self, node_context: Dict[str, Any]):
+        from cloudtik_tpu.runtimes import installer
+        return {"METASTORE_HOME": installer.install_dir(
+                    self.SERVICE_NAME),
+                "HIVE_CONF_DIR": self.conf_dir(node_context)}
 
     def node_configure(self, node_context: Dict[str, Any]) -> None:
         if not self.runs_on(node_context):

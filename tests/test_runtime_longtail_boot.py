@@ -56,6 +56,7 @@ CASES = {
     "mysql": ("mysqld", True, False),
     "flink": ("jobmanager.sh", True, False),
     "presto": ("launcher", True, False),
+    "metastore": ("start-metastore", True, False),
     "pgbouncer": ("pgbouncer", True, False),
     "pgpool": ("pgpool", True, False),
 }
@@ -106,6 +107,11 @@ def test_runtime_boots_from_clean_home(name, tik_home_tmp, tmp_path):
     if quorum or not is_head:
         state.table_put("nodes", node_id,
                         {"kind": "worker", "ip": "127.0.0.1"})
+    if name == "metastore":
+        # metastore gates its config on a discovered backing database
+        from cloudtik_tpu.runtimes.discovery.runtime import ServiceRegistry
+        ServiceRegistry(state, "lt", "w").register(
+            "mysql", "head", "127.0.0.1", 3306)
     ctx = delivery.build_node_context(
         config, is_head=is_head, head_ip="127.0.0.1", node_id=node_id,
         node_ip="127.0.0.1", state_client=state)
