@@ -129,6 +129,10 @@ class TrainerConfig:
     log_every: int = 10
     checkpoint_every: int = 0          # 0 = disabled
     checkpoint_dir: Optional[str] = None
+    # Gradient accumulation: each optimizer step averages grads over this
+    # many sequential micro-steps (the batch splits on its leading dim).
+    # Scales effective batch beyond what one step's activations fit.
+    grad_accum_steps: int = 1
 
 
 class Trainer:
@@ -248,10 +252,40 @@ class Trainer:
     def _build_step(self):
         optimizer = self.optimizer
         loss_fn = self.spec.loss_fn
+        accum = max(int(self.config.grad_accum_steps), 1)
+
+        def grads_of(params, batch):
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+            (_loss, metrics), grads = grad_fn(params, batch)
+            return grads, metrics
+
+        def accumulated_grads(params, batch):
+            """Mean grads over `accum` sequential micro-steps: the batch
+            splits on its leading dim and a lax.scan re-uses one
+            micro-step's activation memory for all of them."""
+            micro = jax.tree.map(
+                lambda b: b.reshape(accum, b.shape[0] // accum,
+                                    *b.shape[1:]), batch)
+
+            def body(carry, micro_batch):
+                grads, metrics = grads_of(params, micro_batch)
+                carry = jax.tree.map(
+                    lambda acc, g: acc + g.astype(acc.dtype),
+                    carry, grads)
+                return carry, metrics
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            total, metrics_stacked = jax.lax.scan(body, zeros, micro)
+            grads = jax.tree.map(lambda g: g / accum, total)
+            metrics = jax.tree.map(lambda m: m.mean(), metrics_stacked)
+            return grads, metrics
 
         def train_step(state, batch):
-            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-            (loss, metrics), grads = grad_fn(state["params"], batch)
+            if accum == 1:
+                grads, metrics = grads_of(state["params"], batch)
+            else:
+                grads, metrics = accumulated_grads(state["params"], batch)
             updates, new_opt = optimizer.update(
                 grads, state["opt_state"], state["params"])
             new_params = jax.tree.map(
