@@ -62,6 +62,43 @@ class AliyunNodeProvider(NodeProvider):
         self._client = provider_config.get("ecs_client")
         self._lock = threading.RLock()
 
+    @staticmethod
+    def bootstrap_config(cluster_config: Dict[str, Any]) -> Dict[str, Any]:
+        """Resolve workspace network IDs (vSwitch / security group) by
+        name through the VPC client and default them into every node
+        config — the reference's aliyun/config.py bootstrap.  Skipped
+        gracefully when no client/SDK is available (IDs must then be set
+        explicitly)."""
+        provider = cluster_config.setdefault("provider", {})
+        vpc_client = provider.get("vpc_client")
+        if vpc_client is None:
+            return cluster_config
+        names = workspace_resource_names(
+            cluster_config.get("workspace_name", "default"))
+        vpcs = vpc_client.describe_vpcs(vpc_name=names["vpc"]).get(
+            "Vpcs", {}).get("Vpc", [])
+        if not vpcs:
+            return cluster_config
+        vpc_id = vpcs[0]["VpcId"]
+        vswitches = [
+            v for v in vpc_client.describe_vswitches(vpc_id=vpc_id)
+            .get("VSwitches", {}).get("VSwitch", [])
+            if v.get("VSwitchName") == names["vswitch"]]
+        groups = [
+            g for g in vpc_client.describe_security_groups(vpc_id=vpc_id)
+            .get("SecurityGroups", {}).get("SecurityGroup", [])
+            if g.get("SecurityGroupName") == names["security_group"]]
+        for node_type in cluster_config.get(
+                "available_node_types", {}).values():
+            node_config = node_type.setdefault("node_config", {})
+            if vswitches:
+                node_config.setdefault(
+                    "v_switch_id", vswitches[0]["VSwitchId"])
+            if groups:
+                node_config.setdefault(
+                    "security_group_id", groups[0]["SecurityGroupId"])
+        return cluster_config
+
     @property
     def ecs(self):
         if self._client is None:

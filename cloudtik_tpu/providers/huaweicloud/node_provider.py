@@ -56,6 +56,33 @@ class HuaweiCloudNodeProvider(NodeProvider):
         self._client = provider_config.get("ecs_client")
         self._lock = threading.RLock()
 
+    @staticmethod
+    def bootstrap_config(cluster_config: Dict[str, Any]) -> Dict[str, Any]:
+        """Resolve the workspace VPC / subnet IDs by name through the VPC
+        client and default them into every node config (reference:
+        huaweicloud/config.py bootstrap).  Skipped when no client."""
+        provider = cluster_config.setdefault("provider", {})
+        vpc_client = provider.get("vpc_client")
+        if vpc_client is None:
+            return cluster_config
+        names = workspace_resource_names(
+            cluster_config.get("workspace_name", "default"))
+        vpcs = [v for v in vpc_client.list_vpcs().get("vpcs", [])
+                if v.get("name") == names["vpc"]]
+        if not vpcs:
+            return cluster_config
+        vpc_id = vpcs[0]["id"]
+        subnets = [s for s in vpc_client.list_subnets().get("subnets", [])
+                   if s.get("vpc_id") == vpc_id
+                   and s.get("name") == names["subnet"]]
+        for node_type in cluster_config.get(
+                "available_node_types", {}).values():
+            node_config = node_type.setdefault("node_config", {})
+            node_config.setdefault("vpc_id", vpc_id)
+            if subnets:
+                node_config.setdefault("subnet_id", subnets[0]["id"])
+        return cluster_config
+
     @property
     def ecs(self):
         if self._client is None:

@@ -449,3 +449,47 @@ class TestAzureNodeBootstrap:
         vm = next(iter(compute.virtual_machines.vms.values()))
         assert vm["network_profile"]["network_interfaces"][0][
             "id"] == f"/nic/{nic_name}"
+
+
+class TestAliyunHuaweiNodeBootstrap:
+    def test_aliyun_resolves_workspace_ids(self):
+        from cloudtik_tpu.providers.aliyun.node_provider import (
+            AliyunNodeProvider)
+        fake = FakeAliyunVpc()
+        ws = create_workspace_provider(
+            {"type": "aliyun", "vpc_client": fake}, "ws")
+        ws.create_workspace({})
+        config = {
+            "workspace_name": "ws",
+            "provider": {"type": "aliyun", "vpc_client": fake},
+            "available_node_types": {"worker": {"node_config": {}}},
+        }
+        out = AliyunNodeProvider.bootstrap_config(config)
+        nc = out["available_node_types"]["worker"]["node_config"]
+        assert nc["v_switch_id"].startswith("vsw-")
+        assert nc["security_group_id"].startswith("sg-")
+
+    def test_huawei_resolves_workspace_ids(self):
+        from cloudtik_tpu.providers.huaweicloud.node_provider import (
+            HuaweiCloudNodeProvider)
+        fake = FakeHuaweiVpc()
+        ws = create_workspace_provider(
+            {"type": "huaweicloud", "vpc_client": fake}, "ws")
+        ws.create_workspace({})
+        config = {
+            "workspace_name": "ws",
+            "provider": {"type": "huaweicloud", "vpc_client": fake},
+            "available_node_types": {"worker": {"node_config": {}}},
+        }
+        out = HuaweiCloudNodeProvider.bootstrap_config(config)
+        nc = out["available_node_types"]["worker"]["node_config"]
+        assert nc["vpc_id"].startswith("vpc-")
+        assert nc["subnet_id"].startswith("subnet-")
+
+    def test_no_client_is_graceful(self):
+        from cloudtik_tpu.providers.aliyun.node_provider import (
+            AliyunNodeProvider)
+        config = {"workspace_name": "ws", "provider": {"type": "aliyun"},
+                  "available_node_types": {"w": {"node_config": {}}}}
+        out = AliyunNodeProvider.bootstrap_config(config)
+        assert out["available_node_types"]["w"]["node_config"] == {}
