@@ -1,0 +1,105 @@
+"""tik-run launcher + Distributor.
+
+Reference parity: runner/util/distributor.py:141 host/slots parsing and
+runner/launch.py:261's launch flow — collapsed here to one SPMD program
+per slice host with TIK_COORDINATOR_* env.  The multi-host path is driven
+with a recorded fake `ssh` on PATH; the local path runs a real child
+program that asserts its env.
+"""
+
+from __future__ import annotations
+
+import os
+import stat
+import sys
+
+import pytest
+from click.testing import CliRunner
+
+from cloudtik_tpu.launch.distributor import Distributor, HostSpec
+from cloudtik_tpu.launch.run import main as tik_run
+
+
+class TestDistributor:
+    def test_slots_syntax_and_comma_lists(self):
+        d = Distributor(hosts=["10.0.0.1:4,10.0.0.2", "10.0.0.3:2"])
+        assert [h.address for h in d.hosts] == \
+            ["10.0.0.1", "10.0.0.2", "10.0.0.3"]
+        assert [h.slots for h in d.hosts] == [4, 1, 2]
+        assert d.num_processes == 3
+        assert d.coordinator_address == "10.0.0.1:8476"
+
+    def test_hostfile_with_comments(self, tmp_path):
+        hostfile = tmp_path / "hosts"
+        hostfile.write_text("# slice hosts\n10.0.0.1\n\n10.0.0.2:8\n")
+        d = Distributor(hostfile=str(hostfile))
+        assert [h.address for h in d.hosts] == ["10.0.0.1", "10.0.0.2"]
+        assert d.hosts[1].slots == 8
+
+    def test_num_nodes_truncates_and_validates(self):
+        d = Distributor(hosts=["a", "b", "c"], num_nodes=2)
+        assert d.num_processes == 2
+        with pytest.raises(ValueError, match="available hosts"):
+            Distributor(hosts=["a"], num_nodes=3)
+
+    def test_defaults_to_localhost(self):
+        d = Distributor()
+        assert d.num_processes == 1 and not d.distributed()
+
+    def test_env_for_process(self):
+        d = Distributor(hosts=["h0", "h1"], coordinator_port=9000)
+        env = d.env_for(1)
+        assert env == {"TIK_COORDINATOR_ADDRESS": "h0:9000",
+                       "TIK_NUM_PROCESSES": "2",
+                       "TIK_PROCESS_ID": "1"}
+
+
+class TestTikRun:
+    def test_local_launch_exports_coordinator_env(self, tmp_path,
+                                                  monkeypatch):
+        monkeypatch.delenv("TIK_SLICE_HOSTS", raising=False)
+        monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+        probe = tmp_path / "probe.py"
+        out = tmp_path / "env.txt"
+        probe.write_text(
+            "import os\n"
+            f"open({str(out)!r}, 'w').write(\n"
+            "    os.environ['TIK_COORDINATOR_ADDRESS'] + ' ' +\n"
+            "    os.environ['TIK_NUM_PROCESSES'] + ' ' +\n"
+            "    os.environ['TIK_PROCESS_ID'])\n")
+        result = CliRunner().invoke(tik_run, [str(probe)])
+        assert result.exit_code == 0, result.output
+        addr, nproc, pid = out.read_text().split()
+        assert addr == "127.0.0.1:8476" and nproc == "1" and pid == "0"
+
+    def test_multi_host_fans_out_over_ssh(self, tmp_path, monkeypatch):
+        log = tmp_path / "ssh-calls.log"
+        stub_dir = tmp_path / "bin"
+        stub_dir.mkdir()
+        stub = stub_dir / "ssh"
+        stub.write_text("#!/bin/sh\n"
+                        f"echo \"$@\" >> {log}\n")
+        stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+        monkeypatch.setenv("PATH",
+                           f"{stub_dir}:{os.environ.get('PATH', '')}")
+        result = CliRunner().invoke(
+            tik_run,
+            ["--hosts", "h0,h1,h2", "--ssh-user", "tik",
+             "--coordinator-port", "9100", "train.py", "--lr", "1e-4"])
+        assert result.exit_code == 0, result.output
+        calls = log.read_text().strip().splitlines()
+        assert len(calls) == 3
+        # every host gets the same program with its own process id
+        for i, call in enumerate(sorted(calls)):
+            assert f"tik@h{i}" in call
+            assert "TIK_COORDINATOR_ADDRESS=h0:9100" in call
+            assert f"TIK_PROCESS_ID={i}" in call
+            assert "train.py --lr 1e-4" in call
+
+    def test_slice_hosts_env_resolution(self, monkeypatch):
+        from cloudtik_tpu.launch.run import resolve_cluster_hosts
+        monkeypatch.setenv("TIK_SLICE_HOSTS", "a,b")
+        assert resolve_cluster_hosts() == ["a", "b"]
+        monkeypatch.delenv("TIK_SLICE_HOSTS")
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "w0,w1,w2")
+        assert resolve_cluster_hosts() == ["w0", "w1", "w2"]
