@@ -99,8 +99,20 @@ def run_bench(model: str = "tpu_1b", seq_len: int = 2048,
 
 
 def main():
+    # Watchdog: a wedged device grant (the axon tunnel can stick for a
+    # while after a killed TPU process) would otherwise hang forever with
+    # no JSON line at all; better to emit the failure record.
+    import os
+    import signal
+
+    def _alarm(_sig, _frame):
+        raise TimeoutError("bench watchdog expired (device grant wedged?)")
+
+    signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(int(os.environ.get("TIK_BENCH_TIMEOUT_S", "2700")))
     try:
         result = run_bench()
+        signal.alarm(0)
     except Exception:
         traceback.print_exc()
         print(json.dumps({

@@ -11,8 +11,18 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 from typing import Any, Dict, Optional
+
+import jax
+
+# TIK_PLATFORM overrides the backend BEFORE any device initializes —
+# env JAX_PLATFORMS alone is pinned too late by TPU-image sitecustomize
+# hooks (tests force cpu this way; a wedged device grant would otherwise
+# hang every recipe at import).
+if os.environ.get("TIK_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["TIK_PLATFORM"])
 
 from cloudtik_tpu.parallel.mesh import MeshConfig, build_mesh
 from cloudtik_tpu.train.trainer import Trainer, TrainerConfig

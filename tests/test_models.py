@@ -218,3 +218,39 @@ class TestLoRA:
             trainer.state["params"]))
         n_base = sum(x.size for x in jax.tree.leaves(base))
         assert n_adapter < n_base * 0.05
+
+
+class TestRecipesSmoke:
+    """Every BASELINE recipe script runs one tiny step end-to-end on the
+    CPU mesh (reference: applications/ai/quickstart/bin/* recipes,
+    SURVEY §2.8) — argparse, mesh build, data, trainer, report."""
+
+    @pytest.mark.parametrize("script,args", [
+        ("bert_large_pretrain.py",
+         ["--model", "tiny", "--seq-len", "64"]),
+        ("resnet50_imagenet.py", ["--model", "tiny"]),
+        ("dlrm_criteo.py", ["--model", "tiny"]),
+        ("llama_lora_finetune.py",
+         ["--model", "tiny", "--seq-len", "64"]),
+        ("sdxl_fsdp.py", ["--model", "tiny"]),
+    ])
+    def test_recipe_one_step(self, script, args):
+        import os
+        import subprocess
+        import sys
+        recipes = os.path.join(os.path.dirname(__file__), "..",
+                               "examples", "recipes")
+        env = dict(os.environ,
+                   TIK_PLATFORM="cpu",
+                   JAX_PLATFORMS="cpu",
+                   PYTHONPATH=os.pathsep.join(
+                       [os.path.abspath(os.path.join(recipes, "..", "..")),
+                        os.environ.get("PYTHONPATH", "")]))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(recipes, script),
+             "--steps", "1", "--batch", "8", "--data", "8", *args],
+            capture_output=True, text=True, timeout=420, env=env,
+            cwd=recipes)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "tokens" in proc.stdout or "samples" in proc.stdout \
+            or "steps" in proc.stdout, proc.stdout
