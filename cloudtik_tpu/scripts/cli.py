@@ -349,6 +349,91 @@ def workspace_status(config_file):
         _load_workspace(config_file)), indent=2, default=str))
 
 
+# ------------------------------------------------------- storage/database --
+
+@cli.group()
+def storage():
+    """Managed cloud-storage operations (reference: `cloudtik storage`)."""
+
+
+def _storage_provider(config_file, name):
+    from cloudtik_tpu.providers.factory import create_storage_provider
+    config = _load_workspace(config_file)
+    return config, create_storage_provider(
+        config["provider"], config["workspace_name"], name)
+
+
+@storage.command(name="create")
+@click.argument("config_file", type=click.Path(exists=True))
+@click.option("--name", default="data")
+def storage_create(config_file, name):
+    config, provider = _storage_provider(config_file, name)
+    provider.create(config)
+    cli_logger.success("Storage {} created.", name)
+
+
+@storage.command(name="delete")
+@click.argument("config_file", type=click.Path(exists=True))
+@click.option("--name", default="data")
+@click.option("--yes", "-y", is_flag=True)
+def storage_delete(config_file, name, yes):
+    config, provider = _storage_provider(config_file, name)
+    cli_logger.confirm(yes, "Delete storage {}?", name)
+    provider.delete(config)
+    cli_logger.success("Storage {} deleted.", name)
+
+
+@storage.command(name="info")
+@click.argument("config_file", type=click.Path(exists=True))
+@click.option("--name", default="data")
+def storage_info(config_file, name):
+    config, provider = _storage_provider(config_file, name)
+    click.echo(json.dumps(provider.get_info(config), indent=2,
+                          default=str))
+
+
+@cli.group()
+def database():
+    """Managed cloud-database operations (reference: `cloudtik
+    database`)."""
+
+
+def _database_provider(config_file, name):
+    from cloudtik_tpu.providers.factory import create_database_provider
+    config = _load_workspace(config_file)
+    return config, create_database_provider(
+        config["provider"], config["workspace_name"], name)
+
+
+@database.command(name="create")
+@click.argument("config_file", type=click.Path(exists=True))
+@click.option("--name", default="db")
+def database_create(config_file, name):
+    config, provider = _database_provider(config_file, name)
+    provider.create(config)
+    cli_logger.success("Database {} created.", name)
+
+
+@database.command(name="delete")
+@click.argument("config_file", type=click.Path(exists=True))
+@click.option("--name", default="db")
+@click.option("--yes", "-y", is_flag=True)
+def database_delete(config_file, name, yes):
+    config, provider = _database_provider(config_file, name)
+    cli_logger.confirm(yes, "Delete database {}?", name)
+    provider.delete(config)
+    cli_logger.success("Database {} deleted.", name)
+
+
+@database.command(name="info")
+@click.argument("config_file", type=click.Path(exists=True))
+@click.option("--name", default="db")
+def database_info(config_file, name):
+    config, provider = _database_provider(config_file, name)
+    click.echo(json.dumps(provider.get_info(config), indent=2,
+                          default=str))
+
+
 # ---------------------------------------------------------------- runtime --
 
 @cli.group()

@@ -1,0 +1,40 @@
+"""Injectable fake shared-infra providers for CLI tests (loaded through
+provider.storage_module / database_module external-class paths)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from cloudtik_tpu.core.database_provider import DatabaseProvider
+from cloudtik_tpu.core.storage_provider import StorageProvider
+
+# module-level stores so CLI invocations observe each other
+STORAGE: Dict[str, Dict[str, Any]] = {}
+DATABASES: Dict[str, Dict[str, Any]] = {}
+
+
+class FakeStorageProvider(StorageProvider):
+    def create(self, config):
+        STORAGE[f"{self.workspace_name}/{self.storage_name}"] = {
+            "uri": f"fake://{self.workspace_name}/{self.storage_name}"}
+
+    def delete(self, config):
+        STORAGE.pop(f"{self.workspace_name}/{self.storage_name}", None)
+
+    def get_info(self, config) -> Optional[Dict[str, Any]]:
+        return STORAGE.get(
+            f"{self.workspace_name}/{self.storage_name}")
+
+
+class FakeDatabaseProvider(DatabaseProvider):
+    def create(self, config):
+        DATABASES[f"{self.workspace_name}/{self.database_name}"] = {
+            "host": "fake-db", "port": 5432}
+
+    def delete(self, config):
+        DATABASES.pop(
+            f"{self.workspace_name}/{self.database_name}", None)
+
+    def get_info(self, config) -> Optional[Dict[str, Any]]:
+        return DATABASES.get(
+            f"{self.workspace_name}/{self.database_name}")

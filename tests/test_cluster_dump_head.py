@@ -156,3 +156,52 @@ class TestHeadGroup:
         cluster_operator.scale_cluster(config, num_workers=2)
         request = head_env.table_get("scaling", "user-request")
         assert request and len(request["resource_demands"]) == 2
+
+
+class TestStorageDatabaseCLI:
+    """`tik storage` / `tik database` groups (reference: the storage and
+    database groups in scripts/scripts.py — round-3 missing item 6)."""
+
+    @pytest.fixture
+    def workspace_config(self, tmp_path):
+        config = tmp_path / "ws.yaml"
+        config.write_text(
+            "workspace_name: ws\n"
+            "provider:\n"
+            "  type: virtual\n"
+            "  storage_module: tests.fake_infra:FakeStorageProvider\n"
+            "  database_module: tests.fake_infra:FakeDatabaseProvider\n")
+        return str(config)
+
+    def test_storage_lifecycle(self, workspace_config):
+        from tests import fake_infra
+        fake_infra.STORAGE.clear()
+        runner = CliRunner()
+        r = runner.invoke(cli, ["storage", "create", workspace_config,
+                                "--name", "data"],
+                          catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        assert "ws/data" in fake_infra.STORAGE
+        r = runner.invoke(cli, ["storage", "info", workspace_config,
+                                "--name", "data"], catch_exceptions=False)
+        assert "fake://ws/data" in r.output
+        r = runner.invoke(cli, ["storage", "delete", workspace_config,
+                                "--name", "data", "-y"],
+                          catch_exceptions=False)
+        assert r.exit_code == 0
+        assert fake_infra.STORAGE == {}
+
+    def test_database_lifecycle(self, workspace_config):
+        from tests import fake_infra
+        fake_infra.DATABASES.clear()
+        runner = CliRunner()
+        r = runner.invoke(cli, ["database", "create", workspace_config],
+                          catch_exceptions=False)
+        assert r.exit_code == 0, r.output
+        assert "ws/db" in fake_infra.DATABASES
+        r = runner.invoke(cli, ["database", "info", workspace_config],
+                          catch_exceptions=False)
+        assert "fake-db" in r.output
+        r = runner.invoke(cli, ["database", "delete", workspace_config,
+                                "-y"], catch_exceptions=False)
+        assert fake_infra.DATABASES == {}
