@@ -215,6 +215,31 @@ def get_or_create_head_node(
     return head_id
 
 
+def _reap_local_node_services() -> None:
+    """Hard teardown skips the graceful on-head `node stop`; on providers
+    whose "head" shares this filesystem (virtual/local) the daemonized
+    services process (`node start --daemonize`, its own session) survives
+    node termination — reap it via the pidfile `node stop` would use."""
+    import signal
+
+    from cloudtik_tpu.utils.constants import TIK_RUN_DIR
+    pid_file = os.path.join(os.path.expanduser(TIK_RUN_DIR),
+                            "node-services.pid")
+    if not os.path.exists(pid_file):
+        return
+    try:
+        with open(pid_file) as f:
+            pid = int(f.read().strip())
+        os.kill(pid, signal.SIGTERM)
+        logger.info("reaped local node services (pid %d)", pid)
+    except (ValueError, ProcessLookupError, PermissionError):
+        pass
+    try:
+        os.unlink(pid_file)
+    except OSError:
+        pass
+
+
 def teardown_cluster(
     config: Dict[str, Any],
     workers_only: bool = False,
@@ -270,6 +295,8 @@ def teardown_cluster(
                 provider.terminate_node(node_id)
         if not workers_only and head_id:
             provider.terminate_node(head_id)
+            if hard:
+                _reap_local_node_services()
         cli_logger.success("Cluster {} torn down.", cluster_name)
     finally:
         provider.cleanup()

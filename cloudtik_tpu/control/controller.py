@@ -161,10 +161,21 @@ class ClusterController:
         self._publish_node_table()
         self.scaler.update()
         self.ticks += 1
+        # drain the tick's aggregated events into the log + event table
+        # (key = tick:index — time-based keys collide within one tick)
+        events = self.scaler.event_summarizer.drain()
+        now = time.time()
+        for i, line in enumerate(events):
+            logger.info("cluster event: %s", line)
+            self.state.table_put(
+                "events", f"{self.ticks:08d}:{i:03d}",
+                {"time": now, "message": line})
+        summary = self.scaler.summary()
+        summary["events"] = events
         self.state.table_put("controller", "status", {
-            "time": time.time(),
+            "time": now,
             "ticks": self.ticks,
-            "summary": self.scaler.summary(),
+            "summary": summary,
             "last_error": self.last_error,
         })
 

@@ -103,6 +103,14 @@ class ClusterScaler:
 
         self.pending_launches = PendingLaunches()
         self.launch_queue: "queue.Queue" = queue.Queue()
+        # counted one-liners per reconcile tick (ref event_summarizer.py:73)
+        from cloudtik_tpu.utils.event_summarizer import EventSummarizer
+        self.event_summarizer = EventSummarizer()
+        # single-flight executor construction: recover + update threads
+        # race to build an SSH executor for the same node (ref
+        # concurrent_cache.py:21); invalidated on termination
+        from cloudtik_tpu.utils.concurrent_cache import ConcurrentObjectCache
+        self._executor_cache = ConcurrentObjectCache()
         # categorized launch-failure history surfaced in summary()
         from cloudtik_tpu.control.node_availability import (
             NodeAvailabilityTracker)
@@ -216,6 +224,7 @@ class ClusterScaler:
         nodes.remove(all_dead)
         for node_id in all_dead:
             self.updaters.pop(node_id, None)
+            self._executor_cache.invalidate(node_id)
 
     # ------------------------------------------------------------------
     def recover_or_terminate_unhealthy(
@@ -250,11 +259,16 @@ class ClusterScaler:
                 # place (the SPMD program spanning it is gone): recycle it.
                 logger.warning("recycling unhealthy node group %s (%d nodes)",
                                group_id, len(members))
+                self.event_summarizer.add_once_per_interval(
+                    "Recycling unhealthy node group %s (%d nodes)."
+                    % (group_id, len(members)), key="recycle:" + group_id)
                 if self.provider.supports_node_groups():
                     self.provider.terminate_node_group(group_id)
                 else:
                     self.provider.terminate_nodes(members)
                 nodes.remove(set(members))
+                for node_id in members:
+                    self._executor_cache.invalidate(node_id)
             else:
                 for node_id in members:
                     self.recover_if_needed(node_id)
@@ -264,11 +278,15 @@ class ClusterScaler:
         if self.disable_node_updaters:
             logger.warning("terminating unhealthy node %s", node_id)
             self.provider.terminate_node(node_id)
+            self._executor_cache.invalidate(node_id)
             return
         if node_id in self.updaters:
             return
         logger.warning("recovering node %s: re-running start commands",
                        node_id)
+        self.event_summarizer.add_once_per_interval(
+            "Restarting %s services on %s." % (self.cluster_name, node_id),
+            key="recover:" + node_id)
         self._spawn_updater(node_id, restart_only=True)
 
     # ------------------------------------------------------------------
@@ -327,11 +345,14 @@ class ClusterScaler:
     def _default_executor(self, node_id: str):
         from cloudtik_tpu.utils.call_context import CallContext
 
-        return self.provider.get_command_executor(
-            CallContext(), f"[{node_id}] ", node_id,
-            self.config.get("auth", {}), self.cluster_name,
-            use_internal_ip=True,
-            docker_config=self.config.get("docker"))
+        def build():
+            return self.provider.get_command_executor(
+                CallContext(), f"[{node_id}] ", node_id,
+                self.config.get("auth", {}), self.cluster_name,
+                use_internal_ip=True,
+                docker_config=self.config.get("docker"))
+
+        return self._executor_cache.get(node_id, build)
 
     # ------------------------------------------------------------------
     def launch_required_nodes(self, nodes: NonTerminatedNodes) -> None:
@@ -364,6 +385,9 @@ class ClusterScaler:
             if count <= 0:
                 continue
             logger.info("launching %d x %s", count, node_type)
+            self.event_summarizer.add(
+                "Adding {} node(s) of type %s." % node_type,
+                quantity=count)
             self.pending_launches.inc(node_type, count)
             self.launch_queue.put((node_type, count))
 
@@ -385,6 +409,7 @@ class ClusterScaler:
             "workers_by_type": by_type,
             "pending_launches": self.pending_launches.counts(),
             "active_updaters": len(self.updaters),
+            "events": self.event_summarizer.summary(),
             "metrics": self.metrics.summary(),
         }
 

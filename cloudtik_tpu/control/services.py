@@ -51,7 +51,18 @@ def write_bootstrap_config(config: Dict[str, Any],
 
 
 def load_bootstrap_config(path: Optional[str] = None) -> Dict[str, Any]:
-    with open(path or _bootstrap_config_path()) as f:
+    if path is None:
+        path = _bootstrap_config_path()
+        if not os.path.exists(path):
+            # The updater's file mount delivers the config to the remote
+            # user's literal ~/.tik (TIK_BOOTSTRAP_CONFIG_REMOTE); when
+            # TIK_HOME points elsewhere (dev/test overrides), fall back to
+            # the delivery location instead of failing node start.
+            delivered = os.path.expanduser(
+                "~/.tik/bootstrap-config.yaml")
+            if os.path.exists(delivered):
+                path = delivered
+    with open(path) as f:
         return yaml.safe_load(f)
 
 

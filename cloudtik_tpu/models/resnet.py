@@ -34,6 +34,8 @@ class ResNetConfig:
     stage_widths: Tuple[int, ...] = (256, 512, 1024, 2048)
     stem_width: int = 64
     bottleneck: bool = True
+    groups: int = 1                  # ResNeXt cardinality (grouped 3x3)
+    width_per_group: int = 64        # ResNeXt bottleneck width basis
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     norm_eps: float = 1e-5
@@ -49,10 +51,24 @@ PRESETS: Dict[str, ResNetConfig] = {
     "resnet18": ResNetConfig(stage_blocks=(2, 2, 2, 2),
                              stage_widths=(64, 128, 256, 512),
                              bottleneck=False),
+    "resnet34": ResNetConfig(stage_blocks=(3, 4, 6, 3),
+                             stage_widths=(64, 128, 256, 512),
+                             bottleneck=False),
     "tiny": ResNetConfig(num_classes=10, image_size=32,
                          stage_blocks=(1, 1), stage_widths=(64, 128),
                          stem_width=16),
+    # ResNeXt (reference recipe resnext-32x16d, SURVEY.md §2.8): grouped
+    # 3x3 bottlenecks; cardinality x width replaces plain bottleneck width.
+    "resnext50_32x4d": ResNetConfig(groups=32, width_per_group=4),
+    "resnext101_32x16d": ResNetConfig(stage_blocks=(3, 4, 23, 3),
+                                      groups=32, width_per_group=16),
 }
+
+
+def _mid_width(cfg: ResNetConfig, width: int) -> int:
+    """Bottleneck inner width (torchvision formula): planes scaled by
+    width_per_group/64, times cardinality."""
+    return int((width // 4) * cfg.width_per_group / 64.0) * cfg.groups
 
 
 def config(name: str, **overrides) -> ResNetConfig:
@@ -73,9 +89,10 @@ def _forward_flops(cfg: ResNetConfig) -> float:
             s = stride if block == 0 else 1
             out_size = size // s
             if cfg.bottleneck:
-                mid = width // 4
+                mid = _mid_width(cfg, width)
                 flops += 2 * (c_in * mid) * out_size ** 2            # 1x1
-                flops += 2 * (9 * mid * mid) * out_size ** 2         # 3x3
+                flops += 2 * (9 * mid * mid // cfg.groups) \
+                    * out_size ** 2                                  # 3x3
                 flops += 2 * (mid * width) * out_size ** 2           # 1x1
             else:
                 flops += 2 * (9 * c_in * width) * out_size ** 2
@@ -141,13 +158,15 @@ def init_params(rng: jax.Array, cfg: ResNetConfig) -> Params:
         for block in range(n_blocks):
             b: Params = {}
             if cfg.bottleneck:
-                mid = width // 4
-                shapes = [(1, 1, c_in, mid), (3, 3, mid, mid),
-                          (1, 1, mid, width)]
+                mid = _mid_width(cfg, width)
+                shapes = [(1, 1, c_in, mid, 1),
+                          (3, 3, mid, mid, cfg.groups),
+                          (1, 1, mid, width, 1)]
             else:
-                shapes = [(3, 3, c_in, width), (3, 3, width, width)]
-            for i, (kh, kw, ci, co) in enumerate(shapes):
-                b[f"conv{i}"] = conv_kernel_init(next(keys), kh, kw, ci, co, pdt)
+                shapes = [(3, 3, c_in, width, 1), (3, 3, width, width, 1)]
+            for i, (kh, kw, ci, co, g) in enumerate(shapes):
+                b[f"conv{i}"] = conv_kernel_init(next(keys), kh, kw, ci, co,
+                                                 pdt, groups=g)
                 b[f"scale{i}"], b[f"bias{i}"] = norm_pair(co)
             if block == 0:
                 b["proj"] = conv_kernel_init(next(keys), 1, 1, c_in, width, pdt)
@@ -187,7 +206,8 @@ def _block(x: jax.Array, b: Params, cfg: ResNetConfig,
     for i in range(n_convs):
         # v1.5: the stride lives on the 3x3 conv
         s = stride if (i == (1 if cfg.bottleneck else 0)) else 1
-        h = conv_nhwc(h, b[f"conv{i}"], stride=s, dtype=cfg.dtype)
+        g = cfg.groups if (cfg.bottleneck and i == 1) else 1
+        h = conv_nhwc(h, b[f"conv{i}"], stride=s, dtype=cfg.dtype, groups=g)
         h = _batch_norm(h, b[f"scale{i}"], b[f"bias{i}"], cfg.norm_eps)
         if i < n_convs - 1:
             h = jax.nn.relu(h)
@@ -199,19 +219,31 @@ def _block(x: jax.Array, b: Params, cfg: ResNetConfig,
     return jax.nn.relu(h + shortcut)
 
 
-def forward(params: Params, images: jax.Array,
-            cfg: ResNetConfig) -> jax.Array:
-    """images [B, H, W, 3] -> logits [B, num_classes] (f32)."""
+def forward_features(params: Params, images: jax.Array,
+                     cfg: ResNetConfig) -> List[jax.Array]:
+    """images [B, H, W, 3] -> per-stage feature maps (NHWC, model dtype).
+
+    The backbone entry point detection models (SSD) build on: stage i has
+    stride 4*2^i relative to the input."""
     x = conv_nhwc(images, params["stem"]["conv"], stride=2, dtype=cfg.dtype)
     x = _batch_norm(x, params["stem"]["scale"], params["stem"]["bias"],
                     cfg.norm_eps)
     x = jax.nn.relu(x)
     x = jax.lax.reduce_window(
         x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+    feats: List[jax.Array] = []
     for stage in range(len(cfg.stage_blocks)):
         stride = 1 if stage == 0 else 2
         for block, b in enumerate(params[f"stage{stage}"]):
             x = _block(x, b, cfg, stride if block == 0 else 1)
+        feats.append(x)
+    return feats
+
+
+def forward(params: Params, images: jax.Array,
+            cfg: ResNetConfig) -> jax.Array:
+    """images [B, H, W, 3] -> logits [B, num_classes] (f32)."""
+    x = forward_features(params, images, cfg)[-1]
     x = x.mean(axis=(1, 2)).astype(jnp.float32)       # global avg pool
     fc = params["fc"]
     return x @ fc["kernel"].astype(jnp.float32) \

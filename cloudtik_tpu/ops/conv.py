@@ -21,16 +21,21 @@ def conv_kernel_axes() -> Tuple[None, None, str, str]:
 
 
 def conv_nhwc(x: jax.Array, kernel: jax.Array, stride: int = 1,
-              dtype=jnp.bfloat16) -> jax.Array:
+              dtype=jnp.bfloat16, groups: int = 1) -> jax.Array:
+    """NHWC conv; `groups` > 1 is a grouped conv (ResNeXt cardinality) —
+    XLA lowers feature_group_count to per-group MXU matmuls, the TPU
+    equivalent of the reference's torch grouped Conv2d."""
     return jax.lax.conv_general_dilated(
         x.astype(dtype), kernel.astype(dtype),
         window_strides=(stride, stride), padding="SAME",
+        feature_group_count=groups,
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 def conv_kernel_init(key, kh: int, kw: int, c_in: int, c_out: int,
-                     param_dtype) -> jax.Array:
-    fan_in = kh * kw * c_in
+                     param_dtype, groups: int = 1) -> jax.Array:
+    """HWIO kernel; for grouped convs the I dim is c_in // groups."""
+    fan_in = kh * kw * (c_in // groups)
     return (jax.random.truncated_normal(
-        key, -2, 2, (kh, kw, c_in, c_out), jnp.float32)
+        key, -2, 2, (kh, kw, c_in // groups, c_out), jnp.float32)
         * (2.0 / fan_in) ** 0.5).astype(param_dtype)

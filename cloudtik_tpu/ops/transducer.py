@@ -1,0 +1,138 @@
+"""RNN-T (transducer) loss, TPU-first.
+
+Reference parity: the rnnt recipe family
+(applications/ai/quickstart/bin/rnnt/{train,train-distributed,
+inference}.sh — torch model zoo RNN-T driven by warp-transducer-style CPU
+loss).  That implementation walks the (T, U) lattice with per-cell scalar
+loops; here the lattice forward recursion is re-derived for the TPU's
+vector unit:
+
+* One `lax.scan` over encoder time t carries the alpha row over label
+  positions u.
+* The within-row recurrence
+      alpha[t, u] = LSE(alpha[t-1, u] + blank[t-1, u],
+                        alpha[t, u-1] + label[t, u-1])
+  is a first-order affine recurrence in the (LSE, +) log semiring:
+  f_u(x) = LSE(b_u, x + a_u).  Those maps compose associatively —
+  (a1, b1) . (a2, b2) = (a1 + a2, LSE(b2, b1 + a2)) — so the row solves
+  with `lax.associative_scan` in O(log U) depth instead of a serial u
+  loop.  All shapes static; padding rides -inf.
+
+Gradients come from autodiff through the scan (the backward recursion the
+reference hand-codes falls out of VJP).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _lse(a: jax.Array, b: jax.Array) -> jax.Array:
+    mx = jnp.maximum(a, b)
+    mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+    return mx + jnp.log(jnp.exp(a - mx) + jnp.exp(b - mx))
+
+
+def _affine_compose(left, right):
+    """Compose log-semiring affine maps applied left-then-right."""
+    a1, b1 = left
+    a2, b2 = right
+    return a1 + a2, _lse(b2, b1 + a2)
+
+
+def _solve_row(from_above: jax.Array, emit: jax.Array) -> jax.Array:
+    """r[0] = from_above[0]; r[u] = LSE(from_above[u], r[u-1] + emit[u-1]).
+
+    from_above, emit: [..., U1].  Returns r [..., U1]."""
+    a = jnp.concatenate(
+        [jnp.full(emit[..., :1].shape, _NEG_INF), emit[..., :-1]], axis=-1)
+    maps = (a, from_above)
+    a_acc, b_acc = jax.lax.associative_scan(_affine_compose, maps, axis=-1)
+    del a_acc
+    return b_acc
+
+
+def transducer_loss(log_probs: jax.Array, labels: jax.Array,
+                    input_lengths: jax.Array, label_lengths: jax.Array,
+                    blank: int = 0) -> jax.Array:
+    """Negative log posterior of `labels` under the transducer lattice.
+
+    log_probs  [B, T, U+1, V] — log softmax of the joint network.
+    labels     [B, U] int32 (padding arbitrary past label_lengths).
+    input_lengths  [B] int32 in [1, T].
+    label_lengths  [B] int32 in [0, U].
+    Returns per-example loss [B] (f32).
+    """
+    lp = log_probs.astype(jnp.float32)
+    B, T, U1, V = lp.shape
+    U = U1 - 1
+    if labels.shape != (B, U):
+        raise ValueError(f"labels {labels.shape} vs log_probs {lp.shape}")
+
+    lp_blank = lp[..., blank]                               # [B, T, U+1]
+    lab = jnp.concatenate(
+        [labels, jnp.zeros((B, 1), labels.dtype)], axis=1)  # [B, U+1]
+    lp_label = jnp.take_along_axis(
+        lp, lab[:, None, :, None], axis=-1)[..., 0]         # [B, T, U+1]
+    # emissions past the true label length never advance u
+    can_emit = (jnp.arange(U1)[None, :]
+                < label_lengths[:, None])                   # [B, U+1]
+    lp_label = jnp.where(can_emit[:, None, :], lp_label, _NEG_INF)
+
+    # alpha[0, u] = sum of label emissions along row 0 up to u
+    first_above = jnp.concatenate(
+        [jnp.zeros((B, 1)), jnp.full((B, U), _NEG_INF)], axis=-1)
+    alpha0 = _solve_row(first_above, lp_label[:, 0])
+
+    def step(alpha_prev, xs):
+        lp_blank_prev, lp_label_t = xs
+        from_above = alpha_prev + lp_blank_prev
+        alpha_t = _solve_row(from_above, lp_label_t)
+        return alpha_t, alpha_prev
+
+    xs = (jnp.moveaxis(lp_blank, 1, 0)[:-1],
+          jnp.moveaxis(lp_label, 1, 0)[1:])
+    alpha_last, alpha_hist = jax.lax.scan(step, alpha0, xs)
+    # step emits its carry, so alpha_hist holds rows 0..T-2; the final
+    # carry is row T-1 -> full lattice [T, B, U+1]
+    alphas = jnp.concatenate([alpha_hist, alpha_last[None]], axis=0)
+
+    t_idx = jnp.clip(input_lengths - 1, 0, T - 1)           # [B]
+    u_idx = jnp.clip(label_lengths, 0, U)                   # [B]
+    batch = jnp.arange(B)
+    alpha_final = alphas[t_idx, batch, u_idx]
+    final_blank = lp_blank[batch, t_idx, u_idx]
+    return -(alpha_final + final_blank)
+
+
+def transducer_loss_reference(log_probs, labels, input_lengths,
+                              label_lengths, blank: int = 0) -> jax.Array:
+    """Per-cell Python-loop lattice walk (numpy semantics; test oracle)."""
+    import numpy as np
+
+    lp = jax.device_get(log_probs).astype(np.float64)
+    labels = jax.device_get(labels)
+    B, T, U1, V = lp.shape
+    out = np.zeros((B,), np.float64)
+    for b in range(B):
+        Tl = int(input_lengths[b])
+        Ul = int(label_lengths[b])
+        alpha = np.full((Tl, Ul + 1), -np.inf)
+        alpha[0, 0] = 0.0
+        for t in range(Tl):
+            for u in range(Ul + 1):
+                cands = []
+                if t > 0:
+                    cands.append(alpha[t - 1, u] + lp[b, t - 1, u, blank])
+                if u > 0:
+                    cands.append(alpha[t, u - 1]
+                                 + lp[b, t, u - 1, labels[b, u - 1]])
+                if cands:
+                    alpha[t, u] = np.logaddexp.reduce(cands)
+        out[b] = -(alpha[Tl - 1, Ul] + lp[b, Tl - 1, Ul, blank])
+    return jnp.asarray(out, jnp.float32)

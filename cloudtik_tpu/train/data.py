@@ -120,6 +120,89 @@ def synthetic_mlm_batches(
                "mlm_labels": labels.astype(np.int32)}
 
 
+def synthetic_detection_batches(
+    batch_size: int,
+    image_size: int,
+    num_classes: int,
+    max_boxes: int = 64,
+    seed: int = 0,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Synthetic detection batches: images + padded normalized gt boxes
+    (xyxy) with int labels; label 0 marks padding rows."""
+    rng = np.random.default_rng(seed)
+    while True:
+        n = rng.integers(1, max_boxes // 2 + 1, (batch_size,))
+        boxes = np.zeros((batch_size, max_boxes, 4), np.float32)
+        labels = np.zeros((batch_size, max_boxes), np.int32)
+        for b in range(batch_size):
+            xy = rng.uniform(0.0, 0.7, (n[b], 2))
+            wh = rng.uniform(0.1, 0.3, (n[b], 2))
+            boxes[b, :n[b], :2] = xy
+            boxes[b, :n[b], 2:] = np.minimum(xy + wh, 1.0)
+            labels[b, :n[b]] = rng.integers(1, num_classes, n[b])
+        yield {
+            "images": rng.standard_normal(
+                (batch_size, image_size, image_size, 3)).astype(np.float32),
+            "gt_boxes": boxes,
+            "gt_labels": labels,
+        }
+
+
+def synthetic_speech_batches(
+    batch_size: int,
+    max_frames: int,
+    feature_dim: int,
+    vocab_size: int,
+    max_labels: int = 32,
+    seed: int = 0,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Synthetic RNN-T batches: padded log-mel frames + label sequences."""
+    rng = np.random.default_rng(seed)
+    while True:
+        flen = rng.integers(max_frames // 2, max_frames + 1,
+                            (batch_size,)).astype(np.int32)
+        llen = rng.integers(1, max_labels + 1,
+                            (batch_size,)).astype(np.int32)
+        labels = rng.integers(
+            1, vocab_size, (batch_size, max_labels), dtype=np.int32)
+        for b in range(batch_size):
+            labels[b, llen[b]:] = 0
+        yield {
+            "features": rng.standard_normal(
+                (batch_size, max_frames, feature_dim)).astype(np.float32),
+            "feature_lengths": flen,
+            "labels": labels,
+            "label_lengths": llen,
+        }
+
+
+def synthetic_graph_batches(
+    num_nodes: int,
+    feature_dim: int,
+    num_classes: int,
+    max_degree: int = 10,
+    seed: int = 0,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Synthetic padded-adjacency graph blocks for GraphSAGE."""
+    rng = np.random.default_rng(seed)
+    while True:
+        deg = rng.integers(1, max_degree + 1, (num_nodes,))
+        neighbors = rng.integers(
+            0, num_nodes, (num_nodes, max_degree), dtype=np.int32)
+        mask = np.arange(max_degree)[None, :] < deg[:, None]
+        neighbors = np.where(
+            mask, neighbors, np.arange(num_nodes)[:, None]).astype(np.int32)
+        yield {
+            "features": rng.standard_normal(
+                (num_nodes, feature_dim)).astype(np.float32),
+            "neighbors": neighbors,
+            "neighbor_mask": mask,
+            "labels": rng.integers(
+                0, num_classes, (num_nodes,), dtype=np.int32),
+            "train_mask": rng.uniform(size=(num_nodes,)) < 0.7,
+        }
+
+
 def global_batches(
     local_iter: Iterator[Dict[str, np.ndarray]],
     sharding: NamedSharding,

@@ -118,6 +118,43 @@ def diffusion_spec(cfg) -> ModelSpec:
     )
 
 
+def ssd_spec(cfg) -> ModelSpec:
+    """Detection (reference recipe ssd-resnet34): per-image accounting."""
+    from cloudtik_tpu.models import ssd as S
+
+    return ModelSpec(
+        init=lambda rng: S.init_params(rng, cfg),
+        loss_fn=lambda params, batch: S.loss_fn(params, batch, cfg),
+        logical_axes=S.param_logical_axes(cfg),
+        flops_per_token=cfg.flops_per_image(),
+    )
+
+
+def rnnt_spec(cfg) -> ModelSpec:
+    """Speech transducer (reference recipe rnnt): per-frame accounting."""
+    from cloudtik_tpu.models import rnnt as N
+
+    return ModelSpec(
+        init=lambda rng: N.init_params(rng, cfg),
+        loss_fn=lambda params, batch: N.loss_fn(params, batch, cfg),
+        logical_axes=N.param_logical_axes(cfg),
+        flops_per_token=cfg.flops_per_frame(),
+    )
+
+
+def graphsage_spec(cfg, objective: str = "supervised") -> ModelSpec:
+    """Graph model (reference: graph_modeling GraphSAGE)."""
+    from cloudtik_tpu.models import graphsage as G
+
+    loss = G.loss_fn if objective == "supervised" else G.link_pred_loss
+    return ModelSpec(
+        init=lambda rng: G.init_params(rng, cfg),
+        loss_fn=lambda params, batch: loss(params, batch, cfg),
+        logical_axes=G.param_logical_axes(cfg),
+        flops_per_token=cfg.flops_per_node(),
+    )
+
+
 @dataclasses.dataclass
 class TrainerConfig:
     global_batch_size: int = 8

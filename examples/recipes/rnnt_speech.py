@@ -1,0 +1,34 @@
+"""RNN-T speech-recognition training recipe (LibriSpeech-style shapes).
+
+Reference recipe: applications/ai/quickstart/bin/rnnt/
+{train,train-distributed}.sh.  Here: one SPMD program; batch over
+data x fsdp.  Launch with `tik-run examples/recipes/rnnt_speech.py --
+--batch 64 --data 8`.
+"""
+
+from cloudtik_tpu.models import rnnt as N
+from cloudtik_tpu.train.data import synthetic_speech_batches
+from cloudtik_tpu.train.trainer import rnnt_spec
+
+from common import build_recipe_trainer, recipe_argparser, run_and_report
+
+
+def main():
+    p = recipe_argparser("rnnt")
+    p.add_argument("--model", default="rnnt")
+    p.add_argument("--max-frames", type=int, default=256)
+    p.add_argument("--max-labels", type=int, default=64)
+    args = p.parse_args()
+
+    cfg = N.config(args.model)
+    trainer = build_recipe_trainer(rnnt_spec(cfg), args,
+                                   seq_len=args.max_frames)
+    data = synthetic_speech_batches(args.batch, args.max_frames,
+                                    cfg.feature_dim, cfg.vocab_size,
+                                    args.max_labels)
+    run_and_report(trainer, data, args.steps,
+                   args.batch * args.max_frames, "frame")
+
+
+if __name__ == "__main__":
+    main()

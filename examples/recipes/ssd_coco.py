@@ -1,0 +1,31 @@
+"""SSD-ResNet34 COCO detection training recipe.
+
+Reference recipe: applications/ai/quickstart/bin/ssd-resnet34/
+{train,train-distributed}.sh (torch model zoo over cloudtik-run DDP).
+Here: one SPMD program; batch over data x fsdp, conv channels over
+tensor.  Launch with `tik-run examples/recipes/ssd_coco.py -- --batch 256
+--data 8`.
+"""
+
+from cloudtik_tpu.models import ssd as S
+from cloudtik_tpu.train.data import synthetic_detection_batches
+from cloudtik_tpu.train.trainer import ssd_spec
+
+from common import build_recipe_trainer, recipe_argparser, run_and_report
+
+
+def main():
+    p = recipe_argparser("ssd_resnet34")
+    p.add_argument("--model", default="ssd_resnet34")
+    p.add_argument("--image-size", type=int, default=300)
+    args = p.parse_args()
+
+    cfg = S.config(args.model, image_size=args.image_size)
+    trainer = build_recipe_trainer(ssd_spec(cfg), args)
+    data = synthetic_detection_batches(args.batch, cfg.image_size,
+                                       cfg.num_classes, cfg.max_boxes)
+    run_and_report(trainer, data, args.steps, args.batch, "img")
+
+
+if __name__ == "__main__":
+    main()

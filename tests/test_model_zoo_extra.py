@@ -1,0 +1,218 @@
+"""Tests for the detection / speech / graph model families.
+
+Mirrors the reference test strategy (SURVEY.md §4): tiny configs, CPU,
+oracle comparisons for the numeric kernels (transducer lattice vs a
+per-cell dynamic program; box codec roundtrip; matcher on a hand case).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cloudtik_tpu.models import graphsage as G
+from cloudtik_tpu.models import resnet as R
+from cloudtik_tpu.models import rnnt as N
+from cloudtik_tpu.models import ssd as S
+from cloudtik_tpu.ops.transducer import (
+    transducer_loss, transducer_loss_reference)
+from cloudtik_tpu.train.data import (
+    synthetic_detection_batches, synthetic_graph_batches,
+    synthetic_speech_batches)
+
+
+# -------------------------------------------------------------------------
+# transducer loss
+# -------------------------------------------------------------------------
+
+class TestTransducerLoss:
+    def _random_case(self, B=3, T=6, U=4, V=5, seed=0):
+        rng = np.random.default_rng(seed)
+        logits = rng.standard_normal((B, T, U + 1, V)).astype(np.float32)
+        log_probs = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+        labels = jnp.asarray(
+            rng.integers(1, V, (B, U), dtype=np.int32))
+        in_len = jnp.asarray([T, T - 1, T - 2], jnp.int32)[:B]
+        lab_len = jnp.asarray([U, U - 1, 1], jnp.int32)[:B]
+        return log_probs, labels, in_len, lab_len
+
+    def test_matches_reference_lattice(self):
+        args = self._random_case()
+        got = transducer_loss(*args)
+        want = transducer_loss_reference(*args)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_single_step_closed_form(self):
+        # T=1, U=1: only path is emit label then blank
+        lp = jax.nn.log_softmax(
+            jnp.asarray(np.random.default_rng(1).standard_normal(
+                (1, 1, 2, 3)).astype(np.float32)), axis=-1)
+        labels = jnp.asarray([[2]], jnp.int32)
+        loss = transducer_loss(lp, labels, jnp.asarray([1]),
+                               jnp.asarray([1]))
+        want = -(lp[0, 0, 0, 2] + lp[0, 0, 1, 0])
+        np.testing.assert_allclose(loss[0], want, rtol=1e-5)
+
+    def test_gradients_finite(self):
+        args = self._random_case(B=2, T=4, U=3, V=4, seed=2)
+
+        def f(lp):
+            return transducer_loss(lp, *args[1:]).sum()
+
+        g = jax.grad(f)(args[0])
+        assert np.isfinite(np.asarray(g)).all()
+        # padded-region gradients are exactly zero (past label length the
+        # lattice never visits those emissions)
+        assert float(jnp.abs(g[1, :, 3:, :]).sum()) == pytest.approx(
+            0.0, abs=1e-6)
+
+
+# -------------------------------------------------------------------------
+# RNN-T model
+# -------------------------------------------------------------------------
+
+class TestRNNT:
+    def test_loss_and_decode(self):
+        cfg = N.config("tiny")
+        params = N.init_params(jax.random.PRNGKey(0), cfg)
+        batch = next(iter_n(synthetic_speech_batches(
+            2, 8, cfg.feature_dim, cfg.vocab_size, max_labels=4)))
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        loss, metrics = N.loss_fn(params, batch, cfg)
+        assert np.isfinite(float(loss)) and float(loss) > 0
+        hyp = N.greedy_decode(params, batch["features"], cfg,
+                              max_symbols=6)
+        assert hyp.shape == (2, 6)
+
+    def test_loss_differentiable(self):
+        cfg = N.config("tiny")
+        params = N.init_params(jax.random.PRNGKey(0), cfg)
+        batch = next(iter_n(synthetic_speech_batches(
+            2, 6, cfg.feature_dim, cfg.vocab_size, max_labels=3)))
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        g = jax.grad(lambda p: N.loss_fn(p, batch, cfg)[0])(params)
+        flat, _ = jax.tree_util.tree_flatten(g)
+        assert all(np.isfinite(np.asarray(x)).all() for x in flat)
+
+
+# -------------------------------------------------------------------------
+# SSD
+# -------------------------------------------------------------------------
+
+class TestSSD:
+    def test_box_codec_roundtrip(self):
+        cfg = S.config("tiny")
+        a = S.anchors(cfg)
+        rng = np.random.default_rng(0)
+        # [N, 2 points, 2 coords] sorted over points -> (x1,y1,x2,y2)
+        gt = jnp.asarray(np.sort(
+            rng.uniform(0.05, 0.95, (a.shape[0], 2, 2)), axis=1
+        ).reshape(-1, 4).astype(np.float32))
+        deltas = S.encode_boxes(S.xyxy_to_cxcywh(gt), a, cfg)
+        back = S.decode_boxes(deltas, a, cfg)
+        np.testing.assert_allclose(back, gt, rtol=1e-4, atol=1e-4)
+
+    def test_matcher_hand_case(self):
+        cfg = S.config("tiny")
+        a = S.anchors(cfg)
+        # gt equal to anchor 5's box must claim it as positive
+        gt_box = S.cxcywh_to_xyxy(a[5:6])
+        gt_boxes = jnp.concatenate(
+            [gt_box, jnp.zeros((cfg.max_boxes - 1, 4))], axis=0)
+        gt_labels = jnp.zeros((cfg.max_boxes,), jnp.int32).at[0].set(3)
+        labels, targets = S.match_anchors(gt_boxes, gt_labels, a, cfg)
+        assert int(labels[5]) == 3
+        # its regression target is (near) zero deltas
+        np.testing.assert_allclose(targets[5], jnp.zeros(4), atol=1e-4)
+        # anchors far away stay background
+        assert int(labels.sum()) >= 3
+
+    def test_loss_and_detect(self):
+        cfg = S.config("tiny")
+        params = S.init_params(jax.random.PRNGKey(0), cfg)
+        batch = next(iter_n(synthetic_detection_batches(
+            2, cfg.image_size, cfg.num_classes, cfg.max_boxes)))
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        loss, metrics = S.loss_fn(params, batch, cfg)
+        assert np.isfinite(float(loss))
+        assert float(metrics["num_pos"]) >= 1
+        out = S.detect(params, batch["images"], cfg, max_detections=10)
+        assert out["boxes"].shape == (2, 10, 4)
+        assert out["labels"].shape == (2, 10)
+
+    def test_anchor_count_matches_head(self):
+        cfg = S.config("tiny")
+        params = S.init_params(jax.random.PRNGKey(0), cfg)
+        cls, box = S.forward(
+            params, jnp.zeros((1, cfg.image_size, cfg.image_size, 3)), cfg)
+        assert cls.shape == (1, cfg.num_anchors(), cfg.num_classes)
+        assert box.shape == (1, cfg.num_anchors(), 4)
+        assert S.anchors(cfg).shape == (cfg.num_anchors(), 4)
+
+
+# -------------------------------------------------------------------------
+# ResNeXt (grouped convs)
+# -------------------------------------------------------------------------
+
+class TestResNeXt:
+    def test_forward_and_flops(self):
+        cfg = R.config("resnext50_32x4d", image_size=32, num_classes=7)
+        params = R.init_params(jax.random.PRNGKey(0), cfg)
+        logits = R.forward(params, jnp.zeros((2, 32, 32, 3)), cfg)
+        assert logits.shape == (2, 7)
+        # grouped 3x3 kernels carry in_channels/groups on the I dim
+        k = params["stage0"][0]["conv1"]
+        assert k.shape[2] * cfg.groups == k.shape[3]
+        assert cfg.flops_per_image() > 0
+
+
+# -------------------------------------------------------------------------
+# GraphSAGE
+# -------------------------------------------------------------------------
+
+class TestGraphSAGE:
+    def test_supervised_overfits_tiny_graph(self):
+        cfg = G.config("tiny")
+        batch = next(iter_n(synthetic_graph_batches(
+            16, cfg.in_dim, cfg.num_classes, cfg.max_degree)))
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        params = G.init_params(jax.random.PRNGKey(0), cfg)
+
+        @jax.jit
+        def step(p):
+            (l, m), g = jax.value_and_grad(
+                lambda q: G.loss_fn(q, batch, cfg), has_aux=True)(p)
+            return jax.tree_util.tree_map(
+                lambda x, dx: x - 0.3 * dx, p, g), l
+
+        first = None
+        for _ in range(150):
+            params, loss = step(params)
+            first = float(loss) if first is None else first
+        assert float(loss) < first * 0.5
+
+    def test_link_pred_loss(self):
+        cfg = G.config("tiny")
+        batch = next(iter_n(synthetic_graph_batches(
+            16, cfg.in_dim, cfg.num_classes, cfg.max_degree)))
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        rng = np.random.default_rng(0)
+        for k in ("src", "dst", "neg_dst"):
+            batch[k] = jnp.asarray(
+                rng.integers(0, 16, (8,), dtype=np.int32))
+        params = G.init_params(jax.random.PRNGKey(1), cfg)
+        loss, metrics = G.link_pred_loss(params, batch, cfg)
+        assert np.isfinite(float(loss))
+
+    def test_isolated_node_aggregates_self_only(self):
+        cfg = G.config("tiny")
+        h = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (4, 8)).astype(np.float32))
+        neighbors = jnp.zeros((4, cfg.max_degree), jnp.int32)
+        mask = jnp.zeros((4, cfg.max_degree), jnp.bool_)
+        agg = G._aggregate(h, neighbors, mask)
+        np.testing.assert_allclose(agg, jnp.zeros_like(agg), atol=1e-6)
+
+
+def iter_n(it):
+    yield next(it)

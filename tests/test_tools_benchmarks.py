@@ -1,0 +1,73 @@
+"""Tests for the tools/benchmarks harnesses (dry-run command plans)."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+import pytest
+
+TOOLS = Path(__file__).resolve().parents[1] / "tools" / "benchmarks"
+
+
+def _load(relpath, name):
+    spec = importlib.util.spec_from_file_location(name, TOOLS / relpath)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestTPCDS:
+    def test_dry_run_full_plan(self, capsys):
+        tpcds = _load("spark/tpcds.py", "tpcds")
+        rc = tpcds.main(["--dry-run", "--scale", "10"])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 1 + 99          # datagen + all queries
+        assert "GenTPCDSData" in out[0]
+        assert "--scale 10" in out[0]
+
+    def test_query_subset_and_validation(self, capsys):
+        tpcds = _load("spark/tpcds.py", "tpcds")
+        rc = tpcds.main(["--dry-run", "--skip-datagen",
+                         "--queries", "q1,q72"])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 2 and "q72.sql" in out[1]
+        with pytest.raises(SystemExit):
+            tpcds.main(["--dry-run", "--queries", "q999"])
+
+
+class TestKafkaPerf:
+    def test_dry_run_produce_consume(self, capsys):
+        perf = _load("kafka/perf.py", "kafka_perf")
+        rc = perf.main(["--dry-run", "--brokers", "b1:9092,b2:9092"])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 2
+        assert "kafka-producer-perf-test.sh" in out[0]
+        assert "bootstrap.servers=b1:9092,b2:9092" in out[0]
+        assert "kafka-consumer-perf-test.sh" in out[1]
+
+
+class TestTPCxAI:
+    def test_dry_run_covers_all_families(self, capsys):
+        tpcx = _load("ai/tpcx_ai.py", "tpcx_ai")
+        rc = tpcx.main(["--dry-run"])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 8
+        joined = "\n".join(out)
+        for recipe in ("resnet50_imagenet", "dlrm_criteo",
+                       "bert_large_pretrain", "sdxl_fsdp",
+                       "llama_lora_finetune", "ssd_coco", "rnnt_speech",
+                       "graphsage_nodes"):
+            assert recipe in joined
+        # every recipe referenced must exist on disk
+        for line in out:
+            path = line.split()[1]
+            assert Path(path).exists(), path
+
+    def test_rejects_unknown_case(self):
+        tpcx = _load("ai/tpcx_ai.py", "tpcx_ai")
+        with pytest.raises(SystemExit):
+            tpcx.main(["--dry-run", "--cases", "nope"])

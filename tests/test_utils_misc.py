@@ -1,0 +1,110 @@
+"""Tests for concurrent cache + event summarizer (SURVEY §2.1 misc)."""
+
+import threading
+import time
+
+import pytest
+
+from cloudtik_tpu.utils.concurrent_cache import ConcurrentObjectCache
+from cloudtik_tpu.utils.event_summarizer import EventSummarizer
+
+
+class TestConcurrentObjectCache:
+    def test_single_flight_under_race(self):
+        cache = ConcurrentObjectCache()
+        calls = []
+        started = threading.Barrier(8)
+        results = []
+
+        def factory():
+            calls.append(1)
+            time.sleep(0.05)
+            return "built"
+
+        def worker():
+            started.wait()
+            results.append(cache.get("k", factory))
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(calls) == 1
+        assert results == ["built"] * 8
+
+    def test_failure_not_cached(self):
+        cache = ConcurrentObjectCache()
+        attempts = []
+
+        def failing():
+            attempts.append(1)
+            raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError):
+            cache.get("k", failing)
+        assert cache.get("k", lambda: 42) == 42
+        assert len(attempts) == 1
+
+    def test_invalidate(self):
+        cache = ConcurrentObjectCache()
+        assert cache.get("k", lambda: 1) == 1
+        cache.invalidate("k")
+        assert cache.get("k", lambda: 2) == 2
+
+
+class TestEventSummarizer:
+    def test_aggregates_quantities(self):
+        s = EventSummarizer()
+        s.add("Adding {} node(s) of type tpu.", quantity=2)
+        s.add("Adding {} node(s) of type tpu.", quantity=3)
+        s.add("Removing {} node(s).", quantity=1)
+        lines = s.drain()
+        assert "Adding 5 node(s) of type tpu." in lines
+        assert "Removing 1 node(s)." in lines
+        assert s.drain() == []
+
+    def test_once_per_interval(self):
+        s = EventSummarizer()
+        s.add_once_per_interval("node n1 unhealthy", key="n1")
+        s.add_once_per_interval("node n1 unhealthy", key="n1")
+        assert s.drain() == ["node n1 unhealthy"]
+        # a new interval may re-emit
+        s.add_once_per_interval("node n1 unhealthy", key="n1")
+        assert s.drain() == ["node n1 unhealthy"]
+
+    def test_summary_is_non_destructive(self):
+        s = EventSummarizer()
+        s.add("x {}", quantity=1)
+        assert s.summary() == ["x 1"]
+        assert s.drain() == ["x 1"]
+
+
+class TestAIDataAPI:
+    def test_engine_switch_and_batches(self):
+        import pandas as pd
+
+        from cloudtik_tpu.runtimes.ai import data as D
+
+        assert D.set_engine("pandas") == "pandas"
+        # modin isn't bundled: soft-degrades to pandas
+        assert D.set_engine("modin") == "pandas"
+        assert D.dataframe() is pd
+
+        df = pd.DataFrame({
+            "a": range(10), "b": range(10), "y": [i % 2 for i in range(10)]})
+        it = D.to_device_batches(df, ["a", "b"], "y", batch_size=4,
+                                 repeat=False)
+        batches = list(it)
+        assert len(batches) == 2          # drop_remainder
+        assert batches[0]["features"].shape == (4, 2)
+        assert batches[0]["labels"].shape == (4,)
+
+    def test_rejects_small_frames(self):
+        import pandas as pd
+
+        from cloudtik_tpu.runtimes.ai import data as D
+
+        df = pd.DataFrame({"a": [1.0]})
+        with pytest.raises(ValueError):
+            next(D.to_device_batches(df, ["a"], batch_size=4))

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""TPCx-AI-style benchmark driver over the recipe zoo.
+
+Reference parity: tools/benchmarks/ai/tpcx-ai — maps the benchmark's
+use cases onto the framework's training recipes and reports one JSON
+line per case.  Use cases cover the same model families the reference's
+harness exercises (classification, recommendation, detection, speech,
+language, generation, graph).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shlex
+import subprocess
+import sys
+from pathlib import Path
+from typing import Dict, List
+
+RECIPES = Path(__file__).resolve().parents[3] / "examples" / "recipes"
+
+USE_CASES: Dict[str, List[str]] = {
+    # name -> recipe + default args (tiny-leaning; --full scales up)
+    "uc1_classification": ["resnet50_imagenet.py", "--model", "resnet50"],
+    "uc2_recommendation": ["dlrm_criteo.py"],
+    "uc3_language": ["bert_large_pretrain.py"],
+    "uc4_generation": ["sdxl_fsdp.py"],
+    "uc5_finetune": ["llama_lora_finetune.py"],
+    "uc6_detection": ["ssd_coco.py"],
+    "uc7_speech": ["rnnt_speech.py"],
+    "uc8_graph": ["graphsage_nodes.py"],
+}
+
+
+def case_command(name: str, steps: int, batch: int) -> List[str]:
+    recipe, *extra = USE_CASES[name]
+    return [sys.executable, str(RECIPES / recipe), *extra,
+            "--steps", str(steps), "--batch", str(batch)]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tpcx-ai")
+    p.add_argument("--cases", default=None,
+                   help="comma list (default: all)")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args(argv)
+
+    cases = args.cases.split(",") if args.cases else list(USE_CASES)
+    bad = [c for c in cases if c not in USE_CASES]
+    if bad:
+        raise SystemExit(f"unknown use cases: {bad} "
+                         f"(have {list(USE_CASES)})")
+    results = {}
+    for case in cases:
+        cmd = case_command(case, args.steps, args.batch)
+        if args.dry_run:
+            print(shlex.join(cmd))
+            continue
+        print(f"+ {shlex.join(cmd)}", file=sys.stderr)
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+        results[case] = (json.loads(lines[-1]) if lines and proc.returncode == 0
+                         else {"rc": proc.returncode})
+    if not args.dry_run:
+        print(json.dumps(results))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Kafka producer/consumer perf harness.
+
+Reference parity: tools/benchmarks/kafka — wraps kafka's own
+kafka-producer-perf-test/kafka-consumer-perf-test against the cluster's
+discovered brokers; --dry-run prints the command plan for CI assertions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import subprocess
+import sys
+from typing import List
+
+
+def producer_command(brokers: str, topic: str, records: int,
+                     record_size: int, throughput: int) -> List[str]:
+    return [
+        "kafka-producer-perf-test.sh", "--topic", topic,
+        "--num-records", str(records), "--record-size", str(record_size),
+        "--throughput", str(throughput),
+        "--producer-props", f"bootstrap.servers={brokers}",
+    ]
+
+
+def consumer_command(brokers: str, topic: str, records: int) -> List[str]:
+    return [
+        "kafka-consumer-perf-test.sh", "--topic", topic,
+        "--messages", str(records),
+        "--bootstrap-server", brokers,
+    ]
+
+
+def build_plan(args) -> List[List[str]]:
+    plan = [producer_command(args.brokers, args.topic, args.records,
+                             args.record_size, args.throughput)]
+    if not args.produce_only:
+        plan.append(consumer_command(args.brokers, args.topic,
+                                     args.records))
+    return plan
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("kafka-perf")
+    p.add_argument("--brokers", default="localhost:9092")
+    p.add_argument("--topic", default="tik-bench")
+    p.add_argument("--records", type=int, default=1_000_000)
+    p.add_argument("--record-size", type=int, default=1024)
+    p.add_argument("--throughput", type=int, default=-1)
+    p.add_argument("--produce-only", action="store_true")
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args(argv)
+
+    for cmd in build_plan(args):
+        if args.dry_run:
+            print(shlex.join(cmd))
+            continue
+        print(f"+ {shlex.join(cmd)}", file=sys.stderr)
+        rc = subprocess.call(cmd)
+        if rc != 0:
+            return rc
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""TPC-DS benchmark harness for the spark runtime.
+
+Reference parity: tools/benchmarks/spark (TPC-DS/TPC-H harness configs +
+run scripts).  The harness composes the spark-sql-perf invocations and
+drives them through `tik submit` (or prints them with --dry-run so CI can
+assert the command plan without a cluster).  Scale factor, query subset,
+and iterations mirror the reference's knobs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shlex
+import subprocess
+import sys
+from typing import List
+
+CANONICAL_QUERIES = [f"q{i}" for i in range(1, 100)]
+
+
+def datagen_command(scale: int, location: str,
+                    partitions: int) -> List[str]:
+    """Data generation via spark-submit of the dsdgen driver."""
+    return [
+        "spark-submit", "--class", "com.databricks.spark.sql.perf.tpcds"
+        ".GenTPCDSData", "spark-sql-perf.jar",
+        "--scale", str(scale), "--location", location,
+        "--partitions", str(partitions), "--format", "parquet",
+    ]
+
+
+def query_command(query: str, location: str,
+                  iterations: int) -> List[str]:
+    return [
+        "spark-sql", "--database", "tpcds",
+        "-f", f"{location}/queries/{query}.sql",
+        "--conf", f"spark.sql.perf.iterations={iterations}",
+    ]
+
+
+def build_plan(args) -> List[List[str]]:
+    queries = (args.queries.split(",") if args.queries
+               else CANONICAL_QUERIES)
+    bad = [q for q in queries if q not in CANONICAL_QUERIES]
+    if bad:
+        raise SystemExit(f"unknown TPC-DS queries: {bad}")
+    plan = []
+    if not args.skip_datagen:
+        plan.append(datagen_command(args.scale, args.location,
+                                    args.partitions))
+    for q in queries:
+        plan.append(query_command(q, args.location, args.iterations))
+    return plan
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tpcds")
+    p.add_argument("--cluster", default=None,
+                   help="cluster config YAML; run via `tik submit`")
+    p.add_argument("--scale", type=int, default=1, help="scale factor GB")
+    p.add_argument("--location", default="hdfs:///tpcds")
+    p.add_argument("--partitions", type=int, default=8)
+    p.add_argument("--queries", default=None,
+                   help="comma list (default: all 99)")
+    p.add_argument("--iterations", type=int, default=1)
+    p.add_argument("--skip-datagen", action="store_true")
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args(argv)
+
+    plan = build_plan(args)
+    if args.dry_run:
+        for cmd in plan:
+            print(shlex.join(cmd))
+        return 0
+    for cmd in plan:
+        full = cmd if not args.cluster else [
+            "tik", "submit", args.cluster, "--", *cmd]
+        print(f"+ {shlex.join(full)}", file=sys.stderr)
+        rc = subprocess.call(full)
+        if rc != 0:
+            print(json.dumps({"failed": shlex.join(cmd), "rc": rc}))
+            return rc
+    print(json.dumps({"queries": len(plan), "status": "ok"}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
