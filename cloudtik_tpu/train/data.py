@@ -125,10 +125,12 @@ def synthetic_detection_batches(
     image_size: int,
     num_classes: int,
     max_boxes: int = 64,
+    mask_size: int = 0,
     seed: int = 0,
 ) -> Iterator[Dict[str, np.ndarray]]:
     """Synthetic detection batches: images + padded normalized gt boxes
-    (xyxy) with int labels; label 0 marks padding rows."""
+    (xyxy) with int labels; label 0 marks padding rows.  mask_size > 0
+    adds box-interior instance masks (Mask R-CNN training)."""
     rng = np.random.default_rng(seed)
     while True:
         n = rng.integers(1, max_boxes // 2 + 1, (batch_size,))
@@ -140,12 +142,26 @@ def synthetic_detection_batches(
             boxes[b, :n[b], :2] = xy
             boxes[b, :n[b], 2:] = np.minimum(xy + wh, 1.0)
             labels[b, :n[b]] = rng.integers(1, num_classes, n[b])
-        yield {
+        batch = {
             "images": rng.standard_normal(
                 (batch_size, image_size, image_size, 3)).astype(np.float32),
             "gt_boxes": boxes,
             "gt_labels": labels,
         }
+        if mask_size:
+            # instance masks: filled box interiors at mask resolution
+            masks = np.zeros(
+                (batch_size, max_boxes, mask_size, mask_size), np.float32)
+            grid = (np.arange(mask_size) + 0.5) / mask_size
+            for b in range(batch_size):
+                for m in range(n[b]):
+                    x1, y1, x2, y2 = boxes[b, m]
+                    masks[b, m] = ((grid[:, None] >= y1)
+                                   & (grid[:, None] <= y2)
+                                   & (grid[None, :] >= x1)
+                                   & (grid[None, :] <= x2))
+            batch["gt_masks"] = masks
+        yield batch
 
 
 def synthetic_speech_batches(

@@ -130,6 +130,18 @@ def ssd_spec(cfg) -> ModelSpec:
     )
 
 
+def maskrcnn_spec(cfg) -> ModelSpec:
+    """Two-stage detection (reference recipe maskrcnn)."""
+    from cloudtik_tpu.models import maskrcnn as M
+
+    return ModelSpec(
+        init=lambda rng: M.init_params(rng, cfg),
+        loss_fn=lambda params, batch: M.loss_fn(params, batch, cfg),
+        logical_axes=M.param_logical_axes(cfg),
+        flops_per_token=cfg.flops_per_image(),
+    )
+
+
 def rnnt_spec(cfg) -> ModelSpec:
     """Speech transducer (reference recipe rnnt): per-frame accounting."""
     from cloudtik_tpu.models import rnnt as N

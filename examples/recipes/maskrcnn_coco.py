@@ -1,0 +1,31 @@
+"""Mask R-CNN COCO instance-segmentation training recipe.
+
+Reference recipe: applications/ai/quickstart/bin/maskrcnn/
+{train,train-distributed}.sh (vendored maskrcnn-benchmark over DDP).
+Here: one SPMD program; batch over data x fsdp.  Launch with
+`tik-run examples/recipes/maskrcnn_coco.py -- --batch 32 --data 8`.
+"""
+
+from cloudtik_tpu.models import maskrcnn as M
+from cloudtik_tpu.train.data import synthetic_detection_batches
+from cloudtik_tpu.train.trainer import maskrcnn_spec
+
+from common import build_recipe_trainer, recipe_argparser, run_and_report
+
+
+def main():
+    p = recipe_argparser("maskrcnn")
+    p.add_argument("--model", default="maskrcnn_resnet50")
+    p.add_argument("--image-size", type=int, default=512)
+    args = p.parse_args()
+
+    cfg = M.config(args.model, image_size=args.image_size)
+    trainer = build_recipe_trainer(maskrcnn_spec(cfg), args)
+    data = synthetic_detection_batches(
+        args.batch, cfg.image_size, cfg.num_classes, cfg.max_boxes,
+        mask_size=2 * cfg.mask_pool)
+    run_and_report(trainer, data, args.steps, args.batch, "img")
+
+
+if __name__ == "__main__":
+    main()

@@ -151,6 +151,63 @@ class TestSSD:
 
 
 # -------------------------------------------------------------------------
+# Mask R-CNN
+# -------------------------------------------------------------------------
+
+class TestMaskRCNN:
+    def _batch(self, cfg, B=2):
+        it = synthetic_detection_batches(
+            B, cfg.image_size, cfg.num_classes, cfg.max_boxes,
+            mask_size=2 * cfg.mask_pool)
+        return {k: jnp.asarray(v) for k, v in next(iter_n(it)).items()}
+
+    def test_loss_grad_detect(self):
+        from cloudtik_tpu.models import maskrcnn as M
+        cfg = M.config("tiny")
+        params = M.init_params(jax.random.PRNGKey(0), cfg)
+        batch = self._batch(cfg)
+        loss, metrics = M.loss_fn(params, batch, cfg)
+        assert np.isfinite(float(loss))
+        assert "mask_loss" in metrics
+        g = jax.grad(lambda p: M.loss_fn(p, batch, cfg)[0])(params)
+        flat, _ = jax.tree_util.tree_flatten(g)
+        assert all(np.isfinite(np.asarray(x)).all() for x in flat)
+        out = M.detect(params, batch["images"], cfg, max_detections=5)
+        assert out["boxes"].shape == (2, 5, 4)
+        assert out["mask_logits"].shape[:2] == (2, cfg.num_proposals)
+
+    def test_roi_targets_hand_case(self):
+        from cloudtik_tpu.models import maskrcnn as M
+        cfg = M.config("tiny")
+        gt_boxes = jnp.zeros((cfg.max_boxes, 4)).at[0].set(
+            jnp.asarray([0.2, 0.2, 0.6, 0.6]))
+        gt_labels = jnp.zeros((cfg.max_boxes,), jnp.int32).at[0].set(3)
+        proposals = jnp.asarray(
+            [[0.2, 0.2, 0.6, 0.6],          # exact match -> positive 3
+             [0.7, 0.7, 0.9, 0.9]])         # disjoint -> background
+        labels, targets, best_gt, pos = M._roi_targets(
+            proposals, gt_boxes, gt_labels, cfg)
+        assert int(labels[0]) == 3 and bool(pos[0])
+        assert int(labels[1]) == 0 and not bool(pos[1])
+        np.testing.assert_allclose(targets[0], np.zeros(4), atol=1e-4)
+
+    def test_mask_crop_of_full_mask_is_full(self):
+        from cloudtik_tpu.models import maskrcnn as M
+        cfg = M.config("tiny")
+        gt_masks = jnp.ones((cfg.max_boxes, 14, 14))
+        proposals = jnp.asarray([[0.25, 0.25, 0.75, 0.75]] * 4)
+        best_gt = jnp.zeros((4,), jnp.int32)
+        pos = jnp.asarray([True, True, False, True])
+        crops = M._crop_gt_masks(gt_masks, best_gt, proposals, pos, cfg)
+        assert crops.shape == (4, cfg.mask_pool, cfg.mask_pool)
+        # interior crop of an all-ones mask stays (near) one; masked-out
+        # proposal rows are zero
+        np.testing.assert_allclose(
+            crops[0], np.ones((cfg.mask_pool, cfg.mask_pool)), atol=1e-3)
+        assert float(jnp.abs(crops[2]).sum()) == 0.0
+
+
+# -------------------------------------------------------------------------
 # ResNeXt (grouped convs)
 # -------------------------------------------------------------------------
 

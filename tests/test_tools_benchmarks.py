@@ -55,12 +55,12 @@ class TestTPCxAI:
         rc = tpcx.main(["--dry-run"])
         assert rc == 0
         out = capsys.readouterr().out.strip().splitlines()
-        assert len(out) == 8
+        assert len(out) == 9
         joined = "\n".join(out)
         for recipe in ("resnet50_imagenet", "dlrm_criteo",
                        "bert_large_pretrain", "sdxl_fsdp",
                        "llama_lora_finetune", "ssd_coco", "rnnt_speech",
-                       "graphsage_nodes"):
+                       "graphsage_nodes", "maskrcnn_coco"):
             assert recipe in joined
         # every recipe referenced must exist on disk
         for line in out:

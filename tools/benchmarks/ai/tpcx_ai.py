@@ -30,6 +30,7 @@ USE_CASES: Dict[str, List[str]] = {
     "uc6_detection": ["ssd_coco.py"],
     "uc7_speech": ["rnnt_speech.py"],
     "uc8_graph": ["graphsage_nodes.py"],
+    "uc9_segmentation": ["maskrcnn_coco.py"],
 }
 
 
