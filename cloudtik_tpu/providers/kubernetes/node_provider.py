@@ -104,10 +104,13 @@ class KubernetesNodeProvider(NodeProvider):
 
     # -- mutation ----------------------------------------------------------
     def create_node(self, node_config, tags, count):
+        from cloudtik_tpu.providers.kubernetes.cloud import apply_cloud_glue
         created = {}
         for _ in range(count):
-            manifest = build_pod_manifest(
-                node_config, tags, self.cluster_name, self.namespace)
+            manifest = apply_cloud_glue(
+                build_pod_manifest(
+                    node_config, tags, self.cluster_name, self.namespace),
+                self.provider_config.get("cloud"))
             try:
                 pod = self.api.create_namespaced_pod(
                     self.namespace, manifest)
@@ -150,4 +153,8 @@ class KubernetesNodeProvider(NodeProvider):
 
     @staticmethod
     def validate_config(provider_config: Dict[str, Any]) -> None:
-        return None
+        cloud = provider_config.get("cloud")
+        if cloud:
+            from cloudtik_tpu.providers.kubernetes.cloud import (
+                validate_cloud_config)
+            validate_cloud_config(cloud)

@@ -1,0 +1,116 @@
+"""Database failover: active-standby promotion for replicated runtimes.
+
+Reference parity: runtime/{postgres,redis,mysql} HA — the reference
+elects a primary through consul/etcd locks and promotes a replica when
+the lease lapses (leader_election/ + active_standby_service.py).  Here
+the same roles ride the head state store's leases
+(`runtimes/common/leader_election.py`):
+
+* Every DB node campaigns for `<service>-primary`.
+* The node that starts as the primary (the head, per each runtime's
+  config render) wins the initial election and simply advertises itself.
+* When its lease lapses (process death, node loss), a replica's campaign
+  succeeds; the daemon runs the runtime-supplied `promote` action
+  (pg_ctl promote / REPLICAOF NO ONE / ...) exactly once and re-points
+  the discovery registry's `<service>` primary record at itself, so
+  pgpool/haproxy/clients following discovery fail over with it.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from cloudtik_tpu.runtimes.common.active_standby import ActiveStandbyService
+
+logger = logging.getLogger(__name__)
+
+
+class DBFailoverDaemon:
+    """Campaigns for the primary role; promotes on takeover.
+
+    promote: zero-arg callable executing the engine-specific promotion.
+    It runs at most once, and never on the member that started as the
+    primary (it is already writable)."""
+
+    def __init__(self, state, service_name: str, member_id: str,
+                 node_ip: str, port: int,
+                 promote: Callable[[], None],
+                 *, initially_primary: bool = False,
+                 cluster_name: str = "", workspace_name: str = "",
+                 ttl_s: float = 15.0):
+        self.service_name = service_name
+        self.member_id = member_id
+        self.node_ip = node_ip
+        self.port = port
+        self._promote = promote
+        self._needs_promote = not initially_primary
+        self._promote_lock = threading.Lock()
+        self._state = state
+        self._cluster_name = cluster_name
+        self._workspace_name = workspace_name
+        self.service = ActiveStandbyService(
+            state, f"{service_name}-primary", member_id,
+            metadata={"ip": node_ip, "port": port},
+            activate=self._on_active, ttl_s=ttl_s)
+
+    def _on_active(self) -> None:
+        with self._promote_lock:
+            if self._needs_promote:
+                logger.warning(
+                    "%s: promoting %s to primary", self.service_name,
+                    self.member_id)
+                self._promote()
+                self._needs_promote = False
+        self._advertise()
+
+    def _advertise(self) -> None:
+        try:
+            from cloudtik_tpu.runtimes.discovery.runtime import (
+                ServiceRegistry)
+            registry = ServiceRegistry(
+                self._state, self._cluster_name, self._workspace_name)
+            registry.register(
+                self.service_name, self.member_id, self.node_ip,
+                self.port, tags={"role": "primary"})
+        except Exception:
+            logger.exception("%s: primary advertisement failed",
+                             self.service_name)
+
+    def start(self, poll_s: float = 0.5) -> None:
+        self.service.election.start(poll_s=poll_s)
+
+    def stop(self) -> None:
+        self.service.stop()
+
+    @property
+    def is_primary(self) -> bool:
+        return self.service.is_active
+
+    def current_primary(self) -> Optional[Dict[str, Any]]:
+        return self.service.get_active()
+
+
+def spawn_db_failover(runtime, node_context: Dict[str, Any],
+                      promote: Callable[[], None],
+                      *, ttl_s: float = 15.0) -> Optional[DBFailoverDaemon]:
+    """Shared post-start wiring for DB runtimes: start the daemon when a
+    state client is present and `failover` isn't disabled in the
+    runtime's config.  Returns the daemon (kept on the runtime so stop
+    can resign)."""
+    state = node_context.get("state_client")
+    if state is None or not runtime.runtime_config.get("failover", True):
+        return None
+    config = node_context.get("config", {})
+    daemon = DBFailoverDaemon(
+        state, runtime.SERVICE_NAME,
+        node_context.get("node_id", "") or "node",
+        node_context.get("node_ip") or node_context.get("head_ip", ""),
+        runtime.port, promote,
+        initially_primary=bool(node_context.get("is_head")),
+        cluster_name=config.get("cluster_name", ""),
+        workspace_name=config.get("workspace_name", ""),
+        ttl_s=float(runtime.runtime_config.get("failover_ttl_s", ttl_s)))
+    daemon.start()
+    return daemon

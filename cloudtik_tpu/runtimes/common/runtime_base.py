@@ -227,6 +227,7 @@ class ServiceRuntimeBase(Runtime):
             return
         name = self.SERVICE_NAME
         if command == "stop":
+            self.post_stop(node_context)
             process_runner.stop_service(name)
             self._deregister(node_context)
             return
@@ -244,6 +245,14 @@ class ServiceRuntimeBase(Runtime):
                 timeout_s=float(self.runtime_config.get(
                     "start_timeout_s", 30)))
         self._register(node_context)
+        self.post_start(node_context)
+
+    def post_start(self, node_context: Dict[str, Any]) -> None:
+        """Hook after the service is up + registered (sidecar daemons:
+        failover election, sync loops).  Default: nothing."""
+
+    def post_stop(self, node_context: Dict[str, Any]) -> None:
+        """Hook before the service process is stopped."""
 
     def _register(self, node_context: Dict[str, Any]) -> None:
         state_client = node_context.get("state_client")

@@ -116,3 +116,30 @@ class PostgresRuntime(ServiceRuntimeBase):
                                  "node_kind": "worker",
                                  "tags": {"role": "replica"}},
         }
+
+    def post_start(self, node_context: Dict[str, Any]) -> None:
+        """HA: campaign for the primary lease; on takeover run
+        `pg_ctl promote` (reference: postgres HA failover via
+        consul/etcd leader election)."""
+        from cloudtik_tpu.runtimes.common.failover import spawn_db_failover
+
+        def promote():
+            import os
+            import subprocess
+            binary = self.find_binary()
+            if binary is None:
+                return
+            data_dir = os.path.expanduser(self.runtime_config.get(
+                "data_dir", "~/.tik/postgres/data"))
+            pg_ctl = os.path.join(os.path.dirname(binary), "pg_ctl")
+            if os.access(pg_ctl, os.X_OK):
+                subprocess.run([pg_ctl, "promote", "-D", data_dir],
+                               capture_output=True)
+
+        self._failover = spawn_db_failover(self, node_context, promote)
+
+    def post_stop(self, node_context: Dict[str, Any]) -> None:
+        daemon = getattr(self, "_failover", None)
+        if daemon is not None:
+            daemon.stop()
+            self._failover = None

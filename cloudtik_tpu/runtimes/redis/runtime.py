@@ -81,3 +81,32 @@ class RedisRuntime(ServiceRuntimeBase):
                               "node_kind": "worker",
                               "tags": {"role": "replica"}},
         }
+
+    def post_start(self, node_context: Dict[str, Any]) -> None:
+        """HA: campaign for the primary lease; a promoted replica runs
+        REPLICAOF NO ONE (reference: redis HA + sentinel-style
+        promotion via leader election)."""
+        from cloudtik_tpu.runtimes.common.failover import spawn_db_failover
+
+        def promote():
+            import os
+            import subprocess
+            binary = self.find_binary()
+            if binary is None:
+                return
+            cli = os.path.join(os.path.dirname(binary), "redis-cli")
+            if os.access(cli, os.X_OK):
+                cmd = [cli, "-p", str(self.port)]
+                password = self.runtime_config.get("password")
+                if password:
+                    cmd += ["-a", password]
+                subprocess.run(cmd + ["replicaof", "no", "one"],
+                               capture_output=True)
+
+        self._failover = spawn_db_failover(self, node_context, promote)
+
+    def post_stop(self, node_context: Dict[str, Any]) -> None:
+        daemon = getattr(self, "_failover", None)
+        if daemon is not None:
+            daemon.stop()
+            self._failover = None

@@ -1,0 +1,86 @@
+"""DB failover: active-standby promotion over the state store.
+
+Reference parity: postgres/redis HA promotion via leader election
+(runtime/common/leader_election + active_standby_service in the
+reference).  Two members campaign for the primary lease on an in-memory
+state backend; killing the primary's lease promotes the standby exactly
+once and re-points the discovery registry.
+"""
+
+import time
+
+import pytest
+
+from cloudtik_tpu.control.state import InMemoryStateBackend, StateClient
+from cloudtik_tpu.runtimes.common.failover import DBFailoverDaemon
+from cloudtik_tpu.runtimes.discovery.runtime import ServiceRegistry
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+class TestDBFailover:
+    def test_standby_promotes_on_primary_loss(self):
+        state = StateClient(InMemoryStateBackend())
+        promoted = []
+
+        primary = DBFailoverDaemon(
+            state, "postgres", "node-a", "10.0.0.1", 5432,
+            promote=lambda: promoted.append("a"),
+            initially_primary=True, cluster_name="c1", ttl_s=1.0)
+        standby = DBFailoverDaemon(
+            state, "postgres", "node-b", "10.0.0.2", 5432,
+            promote=lambda: promoted.append("b"),
+            initially_primary=False, cluster_name="c1", ttl_s=1.0)
+
+        primary.start(poll_s=0.05)
+        assert _wait(lambda: primary.is_primary)
+        standby.start(poll_s=0.05)
+        # the initial primary never runs its promote action
+        assert promoted == []
+        active = standby.current_primary()
+        assert active["member_id"] == "node-a"
+        assert active["ip"] == "10.0.0.1"
+
+        # primary dies -> lease lapses -> standby promotes exactly once
+        primary.stop()
+        assert _wait(lambda: standby.is_primary)
+        assert _wait(lambda: promoted == ["b"])
+        time.sleep(0.3)
+        assert promoted == ["b"]          # no double promotion
+
+        # discovery registry now points the primary record at node-b
+        registry = ServiceRegistry(state, "c1", "")
+        services = registry.query("postgres")
+        by_node = {s["node_id"]: s for s in services}
+        assert by_node["node-b"]["tags"]["role"] == "primary"
+        standby.stop()
+
+    def test_failover_disabled_by_config(self):
+        from cloudtik_tpu.runtimes.common.failover import spawn_db_failover
+
+        class FakeRuntime:
+            SERVICE_NAME = "postgres"
+            runtime_config = {"failover": False}
+            port = 5432
+
+        daemon = spawn_db_failover(
+            FakeRuntime(), {"state_client": StateClient(
+                InMemoryStateBackend()), "is_head": True}, lambda: None)
+        assert daemon is None
+
+    def test_no_state_client_no_daemon(self):
+        from cloudtik_tpu.runtimes.common.failover import spawn_db_failover
+
+        class FakeRuntime:
+            SERVICE_NAME = "redis"
+            runtime_config = {}
+            port = 6379
+
+        assert spawn_db_failover(FakeRuntime(), {}, lambda: None) is None

@@ -1,0 +1,69 @@
+"""Tests for the EKS/GKE/AKS cloud glue on the kubernetes provider."""
+
+import pytest
+
+from cloudtik_tpu.providers.kubernetes.cloud import (
+    apply_cloud_glue, cloud_pod_env, cloud_service_account_manifest,
+    validate_cloud_config)
+from cloudtik_tpu.providers.kubernetes.manifests import build_pod_manifest
+
+
+def _pod():
+    return build_pod_manifest({"resources": {"cpu": "2"}},
+                              {"tik-node-kind": "worker"}, "demo")
+
+
+class TestCloudGlue:
+    def test_eks_irsa(self):
+        cloud = {"type": "aws", "region": "us-west-2",
+                 "aws_role_arn": "arn:aws:iam::123:role/tik",
+                 "storage": {"uri": "s3://tik-bucket"}}
+        sa = cloud_service_account_manifest(cloud)
+        assert sa["metadata"]["annotations"][
+            "eks.amazonaws.com/role-arn"] == "arn:aws:iam::123:role/tik"
+        pod = apply_cloud_glue(_pod(), cloud)
+        assert pod["spec"]["serviceAccountName"] == "tik-node"
+        env = {e["name"]: e["value"]
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env["AWS_REGION"] == "us-west-2"
+        assert env["TIK_CLOUD_STORAGE_URI"] == "s3://tik-bucket"
+
+    def test_gke_workload_identity(self):
+        cloud = {"type": "gcp", "project_id": "proj",
+                 "gcp_service_account": "sa@proj.iam.gserviceaccount.com"}
+        sa = cloud_service_account_manifest(cloud, namespace="ml")
+        assert sa["metadata"]["namespace"] == "ml"
+        assert sa["metadata"]["annotations"][
+            "iam.gke.io/gcp-service-account"].startswith("sa@proj")
+        env = cloud_pod_env(cloud)
+        assert env["GOOGLE_CLOUD_PROJECT"] == "proj"
+
+    def test_aks_workload_identity_label(self):
+        cloud = {"type": "azure", "azure_client_id": "abc-123"}
+        pod = apply_cloud_glue(_pod(), cloud)
+        assert pod["metadata"]["labels"][
+            "azure.workload.identity/use"] == "true"
+        env = {e["name"]: e["value"]
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env["AZURE_CLIENT_ID"] == "abc-123"
+
+    def test_no_cloud_is_identity(self):
+        pod = _pod()
+        assert apply_cloud_glue(pod, None) is pod
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            validate_cloud_config({"type": "dcos"})
+        with pytest.raises(ValueError):
+            validate_cloud_config({"type": "aws"})   # missing role arn
+
+    def test_existing_env_not_clobbered(self):
+        pod = _pod()
+        pod["spec"]["containers"][0]["env"] = [
+            {"name": "AWS_REGION", "value": "keep-me"}]
+        cloud = {"type": "aws", "region": "us-east-1",
+                 "aws_role_arn": "arn:aws:iam::1:role/r"}
+        out = apply_cloud_glue(pod, cloud)
+        env = [e for e in out["spec"]["containers"][0]["env"]
+               if e["name"] == "AWS_REGION"]
+        assert env == [{"name": "AWS_REGION", "value": "keep-me"}]
