@@ -45,6 +45,7 @@ _STORAGE_PROVIDERS: Dict[str, str] = {
 _DATABASE_PROVIDERS: Dict[str, str] = {
     "gcp": "cloudtik_tpu.providers.gcp.database_provider:CloudSQLDatabaseProvider",
     "aws": "cloudtik_tpu.providers.aws.database_provider:RDSDatabaseProvider",
+    "azure": "cloudtik_tpu.providers.azure.database_provider:AzureDatabaseProvider",
 }
 
 _LOAD_BALANCER_PROVIDERS: Dict[str, str] = {

@@ -507,3 +507,89 @@ class TestFactoryAndWorkspaceWiring:
         _create_managed_infra(config)
         assert "tik-ws-data" in gcp_cloud.buckets
         assert "tik-ws-meta" in gcp_cloud.sql
+
+
+# ---------------------------------------------------------------------------
+# Azure flexible server (fake PostgreSQLManagementClient)
+# ---------------------------------------------------------------------------
+
+class _FakePoller:
+    def __init__(self, fn=None):
+        self._fn = fn
+
+    def result(self, timeout=None):
+        if self._fn:
+            self._fn()
+        return None
+
+
+class FakeAzurePostgres:
+    """azure-mgmt-rdbms flexible-servers client shape used by the
+    provider: servers.get / begin_create / begin_delete."""
+
+    class _NotFound(Exception):
+        status_code = 404
+
+    def __init__(self):
+        self._servers = {}
+        self.servers = self
+
+    def get(self, rg, name):
+        if (rg, name) not in self._servers:
+            raise self._NotFound("ResourceNotFound")
+        import types
+        body = self._servers[(rg, name)]
+        return types.SimpleNamespace(
+            state="Ready",
+            fully_qualified_domain_name=f"{name}.postgres.azure.local",
+            **{"properties": body})
+
+    def begin_create(self, rg, name, body):
+        def commit():
+            self._servers[(rg, name)] = body
+        return _FakePoller(commit)
+
+    def begin_delete(self, rg, name):
+        def commit():
+            self._servers.pop((rg, name), None)
+        return _FakePoller(commit)
+
+
+class TestAzureDatabaseProvider:
+    def test_cycle(self):
+        from cloudtik_tpu.providers.azure.database_provider import (
+            AzureDatabaseProvider)
+
+        fake = FakeAzurePostgres()
+        dp = AzureDatabaseProvider(
+            {"type": "azure", "resource_group": "rg",
+             "location": "westus2", "postgres_client": fake},
+            "ws", "meta")
+        dp.create({"database": {"version": 15}})
+        info = dp.get_info({})
+        assert info["state"] == "Ready"
+        assert info["host"].endswith("postgres.azure.local")
+        assert info["port"] == 5432
+        dp.create({})  # idempotent: no second begin_create commit needed
+        dp.delete({})
+        assert dp.get_info({}) is None
+
+    def test_validate_requires_subscription(self):
+        import pytest as _pytest
+
+        from cloudtik_tpu.providers.azure.database_provider import (
+            AzureDatabaseProvider)
+
+        dp = AzureDatabaseProvider(
+            {"postgres_client": FakeAzurePostgres()}, "ws", "db")
+        dp.validate_config({"postgres_client": object()})
+        with _pytest.raises(ValueError):
+            dp.validate_config({})
+
+    def test_factory_dispatch_azure_database(self):
+        from cloudtik_tpu.providers.factory import create_database_provider
+
+        dp = create_database_provider(
+            {"type": "azure", "postgres_client": FakeAzurePostgres()},
+            "ws", "db")
+        assert type(dp).__name__ == "AzureDatabaseProvider"
