@@ -88,6 +88,22 @@ class NodeAgent:
         }
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # TIK_NATIVE_AGENT=1: /proc-reading C++ sampler (SURVEY §2.4 —
+        # psutil's per-sample cost matters on busy training hosts);
+        # psutil remains the fallback when the build/start fails
+        self._native_sampler = None
+        import os
+        if os.environ.get("TIK_NATIVE_AGENT") == "1":
+            try:
+                from cloudtik_tpu.native import NativeHostSampler
+                sampler = NativeHostSampler(
+                    interval_ms=int(metrics_period_s * 1000))
+                sampler.start()
+                self._native_sampler = sampler
+            except Exception:
+                logger.warning(
+                    "native host agent unavailable; using psutil",
+                    exc_info=True)
 
     def heartbeat_once(self) -> None:
         self.state.table_put(TABLE_HEARTBEAT, self.node_id, {
@@ -97,7 +113,9 @@ class NodeAgent:
         })
 
     def publish_metrics_once(self) -> None:
-        metrics = collect_node_metrics()
+        native = (self._native_sampler.latest()
+                  if self._native_sampler else None)
+        metrics = dict(native) if native else collect_node_metrics()
         metrics["node_id"] = self.node_id
         metrics["node_ip"] = self.node_ip
         cpu_free = self.total_resources.get("CPU", 0) * \
@@ -134,6 +152,9 @@ class NodeAgent:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._native_sampler is not None:
+            self._native_sampler.stop()
+            self._native_sampler = None
 
 
 def _local_ip() -> str:

@@ -49,6 +49,78 @@ def ensure_built(force: bool = False) -> Optional[str]:
     return out
 
 
+_AGENT_SRC = os.path.join(os.path.dirname(__file__), "host_agent.cpp")
+
+
+def agent_binary_path() -> str:
+    return os.path.join(tik_home(), "native", "tik-host-agent")
+
+
+def ensure_agent_built(force: bool = False) -> Optional[str]:
+    """Compile the host-metrics sampler; None when no C++ compiler."""
+    out = agent_binary_path()
+    if not force and os.path.exists(out) and \
+            os.path.getmtime(out) >= os.path.getmtime(_AGENT_SRC):
+        return out
+    cxx = compiler()
+    if cxx is None:
+        return None
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    proc = subprocess.run(
+        [cxx, "-O2", "-std=c++17", "-o", out, _AGENT_SRC],
+        capture_output=True, text=True, timeout=300)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native host agent build failed:\n{proc.stderr[-2000:]}")
+    return out
+
+
+class NativeHostSampler:
+    """Streams samples from tik-host-agent; `latest()` returns the most
+    recent metrics dict (None until the first sample arrives).  Linux
+    only (/proc); callers fall back to psutil when start() fails."""
+
+    def __init__(self, interval_ms: int = 1000):
+        self.interval_ms = interval_ms
+        self._proc: Optional[subprocess.Popen] = None
+        self._latest = None
+        self._thread = None
+
+    def start(self) -> None:
+        import json
+        import threading
+
+        binary = ensure_agent_built()
+        if binary is None:
+            raise RuntimeError("no C++ compiler for the native host agent")
+        self._proc = subprocess.Popen(
+            [binary, "--interval-ms", str(self.interval_ms)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+
+        def _pump():
+            for line in self._proc.stdout:  # type: ignore[union-attr]
+                try:
+                    self._latest = json.loads(line)
+                except ValueError:
+                    continue
+
+        self._thread = threading.Thread(
+            target=_pump, name="tik-host-agent-pump", daemon=True)
+        self._thread.start()
+
+    def latest(self):
+        return self._latest
+
+    def stop(self) -> None:
+        if self._proc is not None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+            self._proc = None
+
+
 class NativeStateServer:
     """Spawns the native binary; same surface as control.state.StateServer
     (.port / .start() / .stop())."""

@@ -1,0 +1,84 @@
+"""Native C++ host-metrics sampler: build, sample shape, psutil parity,
+and the node-agent integration path."""
+
+import json
+import os
+import subprocess
+import time
+
+import psutil
+import pytest
+
+from cloudtik_tpu import native
+
+
+@pytest.fixture(scope="module")
+def agent_binary(tmp_path_factory):
+    if native.compiler() is None:
+        pytest.skip("no C++ compiler")
+    home = tmp_path_factory.mktemp("native-home")
+    old = os.environ.get("TIK_HOME")
+    os.environ["TIK_HOME"] = str(home)
+    try:
+        yield native.ensure_agent_built(force=True)
+    finally:
+        if old is None:
+            os.environ.pop("TIK_HOME", None)
+        else:
+            os.environ["TIK_HOME"] = old
+
+
+class TestNativeHostAgent:
+    def test_once_sample_matches_psutil(self, agent_binary):
+        out = subprocess.run([agent_binary, "--once"],
+                             capture_output=True, text=True, timeout=30)
+        assert out.returncode == 0
+        sample = json.loads(out.stdout.strip())
+        assert sample["native"] is True
+        assert sample["cpu_count"] == psutil.cpu_count()
+        # within 2% of psutil's view of total memory (same /proc source)
+        assert abs(sample["memory_total"]
+                   - psutil.virtual_memory().total) \
+            <= 0.02 * psutil.virtual_memory().total
+        assert 0.0 <= sample["cpu_percent"] <= 100.0
+        assert 0.0 <= sample["memory_percent"] <= 100.0
+        assert sample["disk_total"] > 0
+        assert len(sample["load_avg"]) == 3
+        # fields are a superset of the psutil sampler's contract
+        from cloudtik_tpu.control.node_agent import collect_node_metrics
+        assert set(collect_node_metrics()) <= set(sample)
+
+    def test_streaming_sampler(self, agent_binary):
+        sampler = native.NativeHostSampler(interval_ms=100)
+        sampler.start()
+        try:
+            deadline = time.time() + 15
+            while sampler.latest() is None and time.time() < deadline:
+                time.sleep(0.05)
+            first = sampler.latest()
+            assert first is not None and first["native"] is True
+        finally:
+            sampler.stop()
+
+    def test_node_agent_uses_native_when_enabled(self, agent_binary,
+                                                 monkeypatch):
+        from cloudtik_tpu.control.node_agent import NodeAgent
+        from cloudtik_tpu.control.state import (
+            InMemoryStateBackend, StateClient, TABLE_METRICS)
+
+        monkeypatch.setenv("TIK_NATIVE_AGENT", "1")
+        state = StateClient(InMemoryStateBackend())
+        agent = NodeAgent(state, "n1", node_ip="127.0.0.1",
+                          metrics_period_s=0.1)
+        try:
+            assert agent._native_sampler is not None
+            deadline = time.time() + 15
+            while agent._native_sampler.latest() is None \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            agent.publish_metrics_once()
+            row = state.table_get(TABLE_METRICS, "n1")
+            assert row["native"] is True
+            assert row["available_resources"]["CPU"] >= 0.0
+        finally:
+            agent.stop()
