@@ -27,6 +27,17 @@ class LocalCommandExecutor(CommandExecutor):
     def run(self, cmd, *, environment_variables=None, with_output=False,
             run_env="auto", timeout=None, shutdown_after_run=False):
         full_cmd = _shell_env_prefix(environment_variables) + cmd
+        if not with_output and self.process_runner is subprocess:
+            # real execution path: stream per-line with the node prefix
+            # while keeping a bounded tail for the failure report
+            # (reference subprocess_output_util.py:392)
+            from cloudtik_tpu.utils.subprocess_output import (
+                run_with_streaming_output)
+            rc, tail = run_with_streaming_output(
+                full_cmd, prefix=self.log_prefix, timeout=timeout)
+            if rc != 0:
+                raise CommandError(cmd, rc, tail)
+            return None
         try:
             if with_output:
                 out = self.process_runner.check_output(

@@ -80,6 +80,63 @@ class TestEventSummarizer:
         assert s.drain() == ["x 1"]
 
 
+class TestStreamingOutput:
+    def test_streams_and_captures(self):
+        import io
+
+        from cloudtik_tpu.utils.subprocess_output import (
+            run_with_streaming_output)
+
+        buf = io.StringIO()
+        rc, tail = run_with_streaming_output(
+            "echo one; echo two >&2; echo three",
+            prefix="[n1] ", stream=buf)
+        assert rc == 0
+        assert tail.splitlines() == ["one", "two", "three"]
+        assert buf.getvalue().splitlines() == [
+            "[n1] one", "[n1] two", "[n1] three"]
+
+    def test_failure_tail_is_bounded(self):
+        import io
+
+        from cloudtik_tpu.utils.subprocess_output import (
+            run_with_streaming_output)
+
+        rc, tail = run_with_streaming_output(
+            "seq 1 500; exit 3", tail_lines=10, stream=io.StringIO())
+        assert rc == 3
+        lines = tail.splitlines()
+        assert len(lines) == 10 and lines[-1] == "500"
+
+    def test_timeout_kills(self):
+        import io
+        import time
+
+        from cloudtik_tpu.utils.subprocess_output import (
+            run_with_streaming_output)
+
+        t0 = time.time()
+        rc, tail = run_with_streaming_output(
+            "echo started; sleep 30", timeout=1.0, stream=io.StringIO())
+        assert rc == -1
+        assert time.time() - t0 < 15
+        assert "timeout" in tail
+
+    def test_local_executor_streams_and_raises_with_tail(self, capsys):
+        import pytest as _pytest
+
+        from cloudtik_tpu.control.executor.base import CommandError
+        from cloudtik_tpu.control.executor.local import (
+            LocalCommandExecutor)
+
+        ex = LocalCommandExecutor(log_prefix="[node] ")
+        ex.run("echo hello")
+        assert "[node] hello" in capsys.readouterr().out
+        with _pytest.raises(CommandError) as err:
+            ex.run("echo doomed; exit 7")
+        assert "doomed" in str(err.value)
+
+
 class TestAIDataAPI:
     def test_engine_switch_and_batches(self):
         import pandas as pd
