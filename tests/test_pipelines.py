@@ -1,0 +1,33 @@
+"""End-to-end pipeline smoke tests (reference applications parity:
+classical_ml + fraud_detection)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+PIPELINES = Path(__file__).resolve().parents[1] / "examples" / "pipelines"
+
+
+def _run(script, args):
+    proc = subprocess.run(
+        [sys.executable, str(PIPELINES / script), *args],
+        capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    return json.loads(lines[-1])
+
+
+class TestPipelines:
+    def test_classical_ml(self, tmp_path):
+        out = _run("classical_ml.py",
+                   ["--rows", "3000", "--trees", "20", "--depth", "4",
+                    "--out", str(tmp_path / "m.npz")])
+        assert out["test_accuracy"] > 0.85
+        assert (tmp_path / "m.npz").exists()
+
+    def test_fraud_detection(self):
+        out = _run("fraud_detection.py",
+                   ["--accounts", "600", "--edges", "3000",
+                    "--embed-steps", "20", "--trees", "20"])
+        assert out["test_auc"] > 0.9
