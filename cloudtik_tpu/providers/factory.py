@@ -51,6 +51,7 @@ _DATABASE_PROVIDERS: Dict[str, str] = {
 _LOAD_BALANCER_PROVIDERS: Dict[str, str] = {
     "gcp": "cloudtik_tpu.providers.gcp.load_balancer_provider:GCPLoadBalancerProvider",
     "aws": "cloudtik_tpu.providers.aws.load_balancer_provider:AWSLoadBalancerProvider",
+    "azure": "cloudtik_tpu.providers.azure.load_balancer_provider:AzureLoadBalancerProvider",
 }
 
 

@@ -593,3 +593,80 @@ class TestAzureDatabaseProvider:
             {"type": "azure", "postgres_client": FakeAzurePostgres()},
             "ws", "db")
         assert type(dp).__name__ == "AzureDatabaseProvider"
+
+
+# ---------------------------------------------------------------------------
+# Azure load balancer (fake NetworkManagementClient)
+# ---------------------------------------------------------------------------
+
+class FakeAzureNetwork:
+    def __init__(self):
+        self._lbs = {}
+        self.load_balancers = self
+
+    def list(self, rg):
+        return list(self._lbs.get(rg, {}).values())
+
+    def begin_create_or_update(self, rg, name, params):
+        def commit():
+            body = dict(params)
+            body["name"] = name
+            body["id"] = f"/fake/{rg}/{name}"
+            fe = body.get("frontend_ip_configurations") or []
+            if fe and not fe[0].get("private_ip_address"):
+                fe[0]["private_ip_address"] = "10.1.0.9"
+            self._lbs.setdefault(rg, {})[name] = body
+        return _FakePoller(commit)
+
+    def begin_delete(self, rg, name):
+        def commit():
+            self._lbs.get(rg, {}).pop(name, None)
+        return _FakePoller(commit)
+
+
+class TestAzureLoadBalancerProvider:
+    def _provider(self):
+        from cloudtik_tpu.providers.azure.load_balancer_provider import (
+            AzureLoadBalancerProvider)
+
+        fake = FakeAzureNetwork()
+        return AzureLoadBalancerProvider(
+            {"type": "azure", "resource_group": "rg",
+             "location": "westus2", "subnet_id": "/fake/subnet",
+             "virtual_network_id": "/fake/vnet",
+             "network_client": fake}, "ws"), fake
+
+    def test_create_list_update_delete(self):
+        lbp, fake = self._provider()
+        lbp.create({"name": "svc-lb", "port": 8080,
+                    "targets": [{"ip": "10.0.0.4", "port": 8080},
+                                {"ip": "10.0.0.5", "port": 8080}]})
+        lbs = lbp.list()
+        assert set(lbs) == {"svc-lb"}
+        info = lbs["svc-lb"]
+        assert info["port"] == 8080
+        assert [t["ip"] for t in info["targets"]] == [
+            "10.0.0.4", "10.0.0.5"]
+        assert info["dns"] == "10.1.0.9"
+
+        lbp.update(info, {"name": "svc-lb", "port": 8080,
+                          "targets": [{"ip": "10.0.0.6", "port": 8080}]})
+        info = lbp.list()["svc-lb"]
+        assert [t["ip"] for t in info["targets"]] == ["10.0.0.6"]
+
+        lbp.delete(info)
+        assert lbp.list() == {}
+
+    def test_unmanaged_lbs_invisible(self):
+        lbp, fake = self._provider()
+        fake._lbs.setdefault("rg", {})["other"] = {
+            "name": "other", "tags": {}}
+        assert lbp.list() == {}
+
+    def test_factory_dispatch_azure_lb(self):
+        from cloudtik_tpu.providers.factory import (
+            create_load_balancer_provider)
+
+        lbp = create_load_balancer_provider(
+            {"type": "azure", "network_client": FakeAzureNetwork()}, "ws")
+        assert type(lbp).__name__ == "AzureLoadBalancerProvider"
