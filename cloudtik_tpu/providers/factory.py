@@ -46,6 +46,8 @@ _DATABASE_PROVIDERS: Dict[str, str] = {
     "gcp": "cloudtik_tpu.providers.gcp.database_provider:CloudSQLDatabaseProvider",
     "aws": "cloudtik_tpu.providers.aws.database_provider:RDSDatabaseProvider",
     "azure": "cloudtik_tpu.providers.azure.database_provider:AzureDatabaseProvider",
+    "aliyun": "cloudtik_tpu.providers.aliyun.database_provider:AliyunDatabaseProvider",
+    "huaweicloud": "cloudtik_tpu.providers.huaweicloud.database_provider:HuaweiCloudDatabaseProvider",
 }
 
 _LOAD_BALANCER_PROVIDERS: Dict[str, str] = {

@@ -670,3 +670,89 @@ class TestAzureLoadBalancerProvider:
         lbp = create_load_balancer_provider(
             {"type": "azure", "network_client": FakeAzureNetwork()}, "ws")
         assert type(lbp).__name__ == "AzureLoadBalancerProvider"
+
+
+# ---------------------------------------------------------------------------
+# Aliyun + Huawei RDS (snake_case fake clients)
+# ---------------------------------------------------------------------------
+
+class FakeAliyunRDS:
+    def __init__(self):
+        self._items = []
+
+    def describe_db_instances(self, region_id):
+        return {"Items": list(self._items)}
+
+    def create_db_instance(self, **kw):
+        self._items.append({
+            "DBInstanceId": f"rm-{len(self._items)}",
+            "DBInstanceDescription": kw["db_instance_description"],
+            "Engine": kw["engine"],
+            "DBInstanceStatus": "Running",
+            "ConnectionString": "pg.rds.aliyuncs.local",
+            "Port": "5432"})
+
+    def delete_db_instance(self, db_instance_id):
+        self._items = [i for i in self._items
+                       if i["DBInstanceId"] != db_instance_id]
+
+
+class FakeHuaweiRDS:
+    def __init__(self):
+        self._items = []
+
+    def list_instances(self, region):
+        return {"instances": list(self._items)}
+
+    def create_instance(self, **kw):
+        self._items.append({
+            "id": f"in-{len(self._items)}",
+            "name": kw["name"],
+            "datastore": kw["datastore"],
+            "status": "ACTIVE",
+            "private_ips": ["192.168.0.20"],
+            "port": 5432})
+
+    def delete_instance(self, instance_id):
+        self._items = [i for i in self._items if i["id"] != instance_id]
+
+
+class TestAliyunHuaweiDatabaseProviders:
+    def test_aliyun_cycle(self):
+        from cloudtik_tpu.providers.aliyun.database_provider import (
+            AliyunDatabaseProvider)
+
+        dp = AliyunDatabaseProvider(
+            {"type": "aliyun", "rds_client": FakeAliyunRDS()},
+            "ws", "meta")
+        dp.create({})
+        info = dp.get_info({})
+        assert info["state"] == "Running" and info["port"] == 5432
+        dp.create({})  # idempotent
+        dp.delete({})
+        assert dp.get_info({}) is None
+
+    def test_huawei_cycle(self):
+        from cloudtik_tpu.providers.huaweicloud.database_provider import (
+            HuaweiCloudDatabaseProvider)
+
+        dp = HuaweiCloudDatabaseProvider(
+            {"type": "huaweicloud", "rds_client": FakeHuaweiRDS()},
+            "ws", "meta")
+        dp.create({"database": {"engine": "MySQL", "version": 8}})
+        info = dp.get_info({})
+        assert info["state"] == "ACTIVE"
+        assert info["engine"] == "MySQL"
+        assert info["host"] == "192.168.0.20"
+        dp.delete({})
+        assert dp.get_info({}) is None
+
+    def test_factory_dispatch(self):
+        from cloudtik_tpu.providers.factory import create_database_provider
+
+        assert type(create_database_provider(
+            {"type": "aliyun", "rds_client": FakeAliyunRDS()},
+            "ws", "db")).__name__ == "AliyunDatabaseProvider"
+        assert type(create_database_provider(
+            {"type": "huaweicloud", "rds_client": FakeHuaweiRDS()},
+            "ws", "db")).__name__ == "HuaweiCloudDatabaseProvider"
