@@ -1,0 +1,171 @@
+"""KV-cache autoregressive generation for the flagship transformer.
+
+Reference parity: the quickstart inference recipes
+(applications/ai/quickstart/bin/*/inference.sh — every family ships an
+inference entry).  TPU-first decoding:
+
+* One static-shape cache [L, B, max_len, Hkv, Dh] written with
+  `dynamic_update_slice` — no growing arrays, one compilation for the
+  whole decode.
+* Prefill runs the prompt in a single chunked forward (same einsum path
+  as training, dot-product attention against the cache being filled),
+  then `lax.scan` decodes one token per step — weights stay resident,
+  no per-step dispatch from the host.
+* GQA: cached K/V stay at n_kv_heads; queries repeat heads at the
+  attention einsum only.
+* Sampling: greedy, temperature, or top-k (masked categorical) under
+  the same jit.
+
+Works with the dense MLP path and MoE layers (ops.moe is shape-generic
+over S).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cloudtik_tpu.models.transformer import (
+    TransformerConfig, _embed_lookup, _lm_head, _rms_norm, _rope)
+
+Params = Dict[str, Any]
+_NEG = -1e30
+
+
+def init_cache(cfg: TransformerConfig, batch: int,
+               max_len: int) -> Dict[str, jax.Array]:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def _attend(q: jax.Array, ck: jax.Array, cv: jax.Array, start,
+            cfg: TransformerConfig) -> jax.Array:
+    """q [B,S,H,Dh] vs cache k/v [B,T,Hkv,Dh]; query s may see cache
+    positions <= start + s.  Returns [B,S,H,Dh] (f32 accumulate)."""
+    B, S, H, Dh = q.shape
+    T = ck.shape[1]
+    groups = H // ck.shape[2]
+    ck = jnp.repeat(ck, groups, axis=2)
+    cv = jnp.repeat(cv, groups, axis=2)
+    scores = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                        ck.astype(jnp.float32)) * (Dh ** -0.5)
+    t_pos = jnp.arange(T)[None, None, None, :]
+    s_pos = start + jnp.arange(S)[None, None, :, None]
+    scores = jnp.where(t_pos <= s_pos, scores, _NEG)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhst,bthd->bshd", probs, cv.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _layer_step(cfg: TransformerConfig, x: jax.Array, layer: Params,
+                ck: jax.Array, cv: jax.Array, start
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One layer over S new tokens at absolute position `start`.
+    ck/cv [B, max_len, Hkv, Dh] are updated in place (returned)."""
+    B, S, d = x.shape
+    positions = start + jnp.broadcast_to(
+        jnp.arange(S, dtype=jnp.int32), (B, S))
+    h = _rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(cfg.dtype))
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                      (0, start, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                      (0, start, 0, 0))
+    o = _attend(q, ck, cv, start, cfg)
+    attn_out = jnp.einsum("bshk,hkd->bsd", o,
+                          layer["wo"].astype(cfg.dtype))
+    x = x + attn_out
+    h = _rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
+    if cfg.is_moe:
+        from cloudtik_tpu.ops.moe import moe_ffn
+        down, _ = moe_ffn(h, layer["w_router"], layer["w_gate"],
+                          layer["w_up"], layer["w_down"],
+                          cfg.moe_config())
+    else:
+        gate = jnp.einsum("bsd,df->bsf", h,
+                          layer["w_gate"].astype(cfg.dtype))
+        up = jnp.einsum("bsd,df->bsf", h,
+                        layer["w_up"].astype(cfg.dtype))
+        down = jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                          layer["w_down"].astype(cfg.dtype))
+    return x + down, ck, cv
+
+
+def forward_step(params: Params, tokens: jax.Array,
+                 cache: Dict[str, jax.Array],
+                 cfg: TransformerConfig
+                 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Run S new tokens through all layers against the cache.
+    tokens [B, S] -> (logits [B, S, vocab] f32, updated cache)."""
+    start = cache["length"]
+    x = _embed_lookup(params["embed"], tokens, cfg)
+
+    def body(carry, xs):
+        x = carry
+        layer, ck, cv = xs
+        x, ck, cv = _layer_step(cfg, x, layer, ck, cv, start)
+        return x, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, _lm_head(params, cfg).astype(cfg.dtype),
+        preferred_element_type=jnp.float32)
+    new_cache = {"k": ks, "v": vs,
+                 "length": start + tokens.shape[1]}
+    return logits, new_cache
+
+
+def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
+            top_k: int) -> jax.Array:
+    """logits [B, V] -> token ids [B]."""
+    if temperature <= 0.0:
+        return logits.argmax(-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, _NEG, logits)
+    return jax.random.categorical(rng, logits).astype(jnp.int32)
+
+
+def generate(params: Params, prompt: jax.Array, cfg: TransformerConfig,
+             *, max_new_tokens: int = 32, temperature: float = 0.0,
+             top_k: int = 0, eos_id: Optional[int] = None,
+             rng: Optional[jax.Array] = None) -> jax.Array:
+    """prompt [B, S] int32 -> generated tokens [B, max_new_tokens]
+    (positions after EOS are padded with eos_id when given)."""
+    B, S = prompt.shape
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    cache = init_cache(cfg, B, S + max_new_tokens)
+    logits, cache = forward_step(params, prompt, cache, cfg)
+    rng, step_rng = jax.random.split(rng)
+    first = _sample(logits[:, -1, :], step_rng, temperature, top_k)
+    done0 = (first == eos_id) if eos_id is not None \
+        else jnp.zeros((B,), jnp.bool_)
+
+    def step(carry, _):
+        tok, cache, rng, done = carry
+        logits, cache = forward_step(params, tok[:, None], cache, cfg)
+        rng, step_rng = jax.random.split(rng)
+        nxt = _sample(logits[:, -1, :], step_rng, temperature, top_k)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (nxt, cache, rng, done), nxt
+
+    (_, _, _, _), rest = jax.lax.scan(
+        step, (first, cache, rng, done0), None,
+        length=max_new_tokens - 1)
+    return jnp.concatenate([first[:, None],
+                            jnp.moveaxis(rest, 0, 1)], axis=1)

@@ -1,0 +1,58 @@
+"""LM inference recipe: KV-cache generation + tokens/sec report.
+
+Reference parity: applications/ai/quickstart/bin/*/inference.sh (every
+recipe family ships an inference entry).  One jitted decode program:
+static-shape cache, scan over steps.  `tik-run` launches it on a slice
+the same way as training recipes.
+"""
+
+import json
+import time
+
+from cloudtik_tpu.models import generate as G
+from cloudtik_tpu.models import transformer as T
+
+from common import recipe_argparser
+
+
+def main():
+    p = recipe_argparser("lm_generate")
+    p.add_argument("--model", default="tpu_1b")
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--max-new", type=int, default=128)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-k", type=int, default=0)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    cfg = T.config(args.model,
+                   max_seq_len=args.prompt_len + args.max_new)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (args.batch, args.prompt_len)), jnp.int32)
+
+    gen = jax.jit(lambda pr, rng: G.generate(
+        params, pr, cfg, max_new_tokens=args.max_new,
+        temperature=args.temperature, top_k=args.top_k, rng=rng))
+    out = gen(prompt, jax.random.PRNGKey(1))        # compile + warmup
+    out.block_until_ready()
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        out = gen(prompt, jax.random.PRNGKey(2 + i))
+        out.block_until_ready()
+    dt = time.perf_counter() - t0
+    tokens = args.batch * args.max_new * args.steps
+    print(json.dumps({
+        "steps": args.steps,
+        "tokens_per_sec": round(tokens / dt, 2),
+        "prompt_len": args.prompt_len,
+        "max_new": args.max_new,
+        "batch": args.batch,
+    }))
+
+
+if __name__ == "__main__":
+    main()
