@@ -22,6 +22,7 @@ _BUILT_IN: Dict[str, str] = {
     "spark": "cloudtik_tpu.runtimes.spark.runtime:SparkRuntime",
     "grafana": "cloudtik_tpu.runtimes.grafana.runtime:GrafanaRuntime",
     "mlflow": "cloudtik_tpu.runtimes.mlflow.runtime:MLflowRuntime",
+    "serving": "cloudtik_tpu.runtimes.serving.runtime:ServingRuntime",
     # stateful / data services
     "etcd": "cloudtik_tpu.runtimes.etcd.runtime:EtcdRuntime",
     "zookeeper":

@@ -1,0 +1,71 @@
+"""Serving runtime: tik-serve model inference servers as a service.
+
+Reference parity: the ai runtime's MLflow model-serving role + the
+application serving stages (SURVEY.md §2.3/§2.8).  Runs the in-process
+`serve.server.ServeServer` on its nodes, registered in discovery so
+gateways (haproxy/kong/apisix) route to it like any runtime service.
+
+runtime_config:
+  serving:
+    model: tiny                # transformer preset
+    checkpoint_dir: ...        # optional
+    gbdt_model: /path.npz      # serve a GBDT instead
+    port: 8200
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from cloudtik_tpu.runtimes.common.runtime_base import (
+    HEAD, ServiceRuntimeBase)
+
+SERVE_PORT = 8200
+
+# live servers must outlive runtime instances (delivery re-creates them
+# per start/stop invocation)
+_servers: Dict[int, Any] = {}
+
+
+class ServingRuntime(ServiceRuntimeBase):
+    SERVICE_NAME = "serving"
+    DEFAULT_PORT = SERVE_PORT
+    PROTOCOL = "http"
+    NODE_KIND = HEAD
+    PROCESS_KEYWORD = "tik-serve"
+    ENDPOINT_NAME = "Model Serving"
+
+    def _build_backends(self):
+        from cloudtik_tpu.serve import server as S
+        gbdt_path = self.runtime_config.get("gbdt_model")
+        if gbdt_path:
+            return [S.gbdt_backend(gbdt_path)]
+        return [S.transformer_backend(
+            self.runtime_config.get("model", "tiny"),
+            checkpoint_dir=self.runtime_config.get("checkpoint_dir"))]
+
+    def node_services(self, node_context: Dict[str, Any],
+                      command: str) -> None:
+        if not self.runs_on(node_context):
+            return
+        from cloudtik_tpu.serve.server import ServeServer
+        if command == "start" and self.port not in _servers:
+            server = ServeServer(self._build_backends(), port=self.port)
+            server.start()
+            # port 0 binds ephemeral: adopt the bound port so discovery
+            # registration and endpoint listings advertise reality
+            self.runtime_config["port"] = server.port
+            _servers[self.port] = server
+            self._register(node_context)
+        elif command == "stop":
+            server = _servers.pop(self.port, None)
+            if server is not None:
+                server.stop()
+            self._deregister(node_context)
+
+    def get_runtime_services(self, cluster_config, cluster_head_ip):
+        return {
+            "serving": {"protocol": "http", "port": self.port,
+                        "node_kind": "head",
+                        "tags": {"lb-expose": "true"}},
+        }

@@ -1,0 +1,200 @@
+"""Model-serving HTTP server (`tik-serve`).
+
+Reference parity: the ai runtime's model-serving role (MLflow server on
+head + the disease_prediction/fraud_detection serving stages,
+SURVEY.md §2.3/§2.8).  One stdlib-threaded HTTP server in front of
+jitted predict functions:
+
+  POST /v1/generate  {"tokens": [[...]], "max_new_tokens": 8, ...}
+  POST /v1/predict   {"features": [[...]]}           (tabular/GBDT)
+  GET  /healthz                                       liveness
+  GET  /v1/models                                     what's loaded
+
+Backends are pluggable `ModelBackend`s; the built-ins load the
+transformer family (checkpoint dir or fresh init) and a saved GBDT
+forest.  The server registers itself in the cluster's discovery table
+when a state client is provided, so gateways (haproxy/kong) route to it
+like any other runtime service.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class ModelBackend:
+    """name + callable endpoints: {route_suffix: fn(payload) -> dict}."""
+
+    def __init__(self, name: str,
+                 endpoints: Dict[str, Callable[[Dict[str, Any]],
+                                               Dict[str, Any]]]):
+        self.name = name
+        self.endpoints = endpoints
+
+
+def transformer_backend(model: str = "tiny",
+                        checkpoint_dir: Optional[str] = None,
+                        **config_overrides) -> ModelBackend:
+    """Generation endpoint on the transformer family."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cloudtik_tpu.models import generate as G
+    from cloudtik_tpu.models import transformer as T
+
+    cfg = T.config(model, **config_overrides)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    if checkpoint_dir:
+        from cloudtik_tpu.train.checkpoint import (
+            CheckpointConfig, Checkpointer)
+        ckpt = Checkpointer(CheckpointConfig(directory=checkpoint_dir))
+        # trainer checkpoints hold {"params": ..., ...} train state
+        restored = ckpt.restore({"params": params})
+        params = restored["params"]
+        ckpt.close()
+
+    # one jitted program per (prompt_len, max_new) shape, cached
+    compiled: Dict[Any, Any] = {}
+    lock = threading.Lock()
+
+    def generate(payload: Dict[str, Any]) -> Dict[str, Any]:
+        tokens = np.asarray(payload["tokens"], np.int32)
+        max_new = int(payload.get("max_new_tokens", 16))
+        temperature = float(payload.get("temperature", 0.0))
+        top_k = int(payload.get("top_k", 0))
+        seed = int(payload.get("seed", 0))
+        key = (tokens.shape, max_new, temperature, top_k)
+        with lock:
+            fn = compiled.get(key)
+            if fn is None:
+                fn = jax.jit(lambda pr, rng: G.generate(
+                    params, pr, cfg, max_new_tokens=max_new,
+                    temperature=temperature, top_k=top_k, rng=rng))
+                compiled[key] = fn
+        out = fn(jnp.asarray(tokens), jax.random.PRNGKey(seed))
+        return {"tokens": np.asarray(out).tolist()}
+
+    return ModelBackend(f"transformer:{model}", {"generate": generate})
+
+
+def gbdt_backend(model_path: str) -> ModelBackend:
+    """Tabular predict endpoint on a saved GBDT forest."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cloudtik_tpu.models import gbdt as GB
+
+    forest, edges = GB.load(model_path)
+    depth = int(np.log2(forest["leaf"].shape[1]))
+    n_bins = int(edges.shape[1]) + 1 if edges is not None else 64
+    cfg = GB.config(n_trees=int(forest["leaf"].shape[0]), depth=depth,
+                    n_bins=n_bins)
+
+    def predict(payload: Dict[str, Any]) -> Dict[str, Any]:
+        X = np.asarray(payload["features"], np.float32)
+        binned = GB.apply_bins(X, edges) if edges is not None \
+            else X.astype(np.uint8)
+        proba = GB.predict_proba(forest, jnp.asarray(binned), cfg)
+        return {"probabilities": np.asarray(proba).tolist()}
+
+    return ModelBackend("gbdt", {"predict": predict})
+
+
+class ServeServer:
+    """Threaded HTTP server over one or more backends."""
+
+    def __init__(self, backends, host: str = "0.0.0.0", port: int = 0):
+        self.backends = list(backends)
+        routes: Dict[str, Callable] = {}
+        for b in self.backends:
+            for suffix, fn in b.endpoints.items():
+                routes[f"/v1/{suffix}"] = fn
+        models = [b.name for b in self.backends]
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _send(self, code: int, obj: Dict[str, Any]) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, {"status": "ok"})
+                elif self.path == "/v1/models":
+                    self._send(200, {"models": models})
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                fn = routes.get(self.path)
+                if fn is None:
+                    self._send(404, {"error": "not found"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(
+                        self.rfile.read(length) or b"{}")
+                    self._send(200, fn(payload))
+                except Exception as e:
+                    logger.exception("serve request failed")
+                    self._send(400, {"error": str(e)})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="tik-serve",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser("tik-serve")
+    p.add_argument("--model", default="tiny",
+                   help="transformer preset to serve")
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--gbdt", default=None, help="saved GBDT .npz path")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8200)
+    args = p.parse_args(argv)
+
+    backends = []
+    if args.gbdt:
+        backends.append(gbdt_backend(args.gbdt))
+    else:
+        backends.append(transformer_backend(
+            args.model, checkpoint_dir=args.checkpoint_dir))
+    server = ServeServer(backends, host=args.host, port=args.port)
+    server.start()
+    print(f"tik-serve listening on {args.host}:{server.port}",
+          flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,129 @@
+"""tik-serve model-serving server: HTTP contract + backend parity."""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+@pytest.fixture(scope="module")
+def gbdt_server(tmp_path_factory):
+    from cloudtik_tpu.models import gbdt as GB
+    from cloudtik_tpu.serve.server import ServeServer, gbdt_backend
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((400, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    cfg = GB.config(n_trees=5, depth=3, n_bins=16)
+    edges = GB.quantile_bins(X, cfg.n_bins)
+    forest = GB.fit(jnp.asarray(GB.apply_bins(X, edges)),
+                    jnp.asarray(y), cfg)
+    path = str(tmp_path_factory.mktemp("serve") / "model.npz")
+    GB.save(path, forest, edges)
+
+    server = ServeServer([gbdt_backend(path)], host="127.0.0.1")
+    server.start()
+    yield server, path, (forest, edges, cfg, X)
+    server.stop()
+
+
+class TestServeServer:
+    def test_health_and_models(self, gbdt_server):
+        server, _, _ = gbdt_server
+        assert _get(server.port, "/healthz")[1] == {"status": "ok"}
+        status, models = _get(server.port, "/v1/models")
+        assert models == {"models": ["gbdt"]}
+
+    def test_predict_matches_direct(self, gbdt_server):
+        from cloudtik_tpu.models import gbdt as GB
+
+        server, _, (forest, edges, cfg, X) = gbdt_server
+        status, out = _post(server.port, "/v1/predict",
+                            {"features": X[:8].tolist()})
+        assert status == 200
+        direct = GB.predict_proba(
+            forest, jnp.asarray(GB.apply_bins(X[:8], edges)), cfg)
+        np.testing.assert_allclose(out["probabilities"],
+                                   np.asarray(direct), rtol=1e-5)
+
+    def test_bad_payload_is_400(self, gbdt_server):
+        server, _, _ = gbdt_server
+        status, out = _post(server.port, "/v1/predict", {"wrong": 1})
+        assert status == 400 and "error" in out
+
+    def test_unknown_route_404(self, gbdt_server):
+        server, _, _ = gbdt_server
+        assert _post(server.port, "/v1/nope", {})[0] == 404
+
+
+class TestTransformerServing:
+    def test_generate_endpoint_matches_direct(self):
+        from cloudtik_tpu.models import generate as G
+        from cloudtik_tpu.models import transformer as T
+        from cloudtik_tpu.serve.server import (
+            ServeServer, transformer_backend)
+
+        backend = transformer_backend("tiny")
+        server = ServeServer([backend], host="127.0.0.1")
+        server.start()
+        try:
+            prompt = [[1, 2, 3, 4], [4, 3, 2, 1]]
+            status, out = _post(server.port, "/v1/generate",
+                                {"tokens": prompt, "max_new_tokens": 4})
+            assert status == 200
+            got = np.asarray(out["tokens"])
+            assert got.shape == (2, 4)
+            cfg = T.config("tiny")
+            params = T.init_params(jax.random.PRNGKey(0), cfg)
+            want = G.generate(params, jnp.asarray(prompt, jnp.int32),
+                              cfg, max_new_tokens=4)
+            np.testing.assert_array_equal(got, np.asarray(want))
+        finally:
+            server.stop()
+
+
+class TestServingRuntime:
+    def test_runtime_boot_registers_discovery(self, tmp_path):
+        from cloudtik_tpu.control.state import (
+            InMemoryStateBackend, StateClient)
+        from cloudtik_tpu.runtimes.discovery.runtime import ServiceRegistry
+        from cloudtik_tpu.runtimes.serving import runtime as R
+
+        state = StateClient(InMemoryStateBackend())
+        rt = R.ServingRuntime({"port": 0})   # ephemeral bind
+        node_context = {
+            "is_head": True, "node_id": "head", "node_ip": "127.0.0.1",
+            "state_client": state,
+            "config": {"cluster_name": "c1", "workspace_name": "w1"},
+            "conf_dir": str(tmp_path),
+        }
+        try:
+            rt.node_services(node_context, "start")
+            port = R._servers[rt.port].port
+            assert _get(port, "/healthz")[1] == {"status": "ok"}
+            registry = ServiceRegistry(state, "c1", "w1")
+            services = registry.query("serving")
+            assert services and services[0]["node_id"] == "head"
+        finally:
+            rt.node_services(node_context, "stop")
