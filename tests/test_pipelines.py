@@ -31,3 +31,15 @@ class TestPipelines:
                    ["--accounts", "600", "--edges", "3000",
                     "--embed-steps", "20", "--trees", "20"])
         assert out["test_auc"] > 0.9
+
+    def test_disease_prediction(self, tmp_path):
+        out = _run("disease_prediction.py",
+                   ["--rows", "1200", "--trees", "15",
+                    "--save", str(tmp_path / "dp.npz")])
+        assert out["test_accuracy"] > 0.9
+        # the saved forest round-trips into the serving backend
+        from cloudtik_tpu.serve.server import gbdt_backend
+        backend = gbdt_backend(str(tmp_path / "dp.npz"))
+        res = backend.endpoints["predict"](
+            {"features": [[0.0] * 256, [1.0] * 256]})
+        assert len(res["probabilities"]) == 2
