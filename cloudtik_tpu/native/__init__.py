@@ -93,9 +93,11 @@ class NativeHostSampler:
         binary = ensure_agent_built()
         if binary is None:
             raise RuntimeError("no C++ compiler for the native host agent")
+        from cloudtik_tpu.utils.fate_sharing import preexec
         self._proc = subprocess.Popen(
             [binary, "--interval-ms", str(self.interval_ms)],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            preexec_fn=preexec())
 
         def _pump():
             for line in self._proc.stdout:  # type: ignore[union-attr]
@@ -142,9 +144,10 @@ class NativeStateServer:
         cmd = [binary, "--host", bind_host, "--port", str(self.port)]
         if self.auth_token:
             cmd += ["--token", self.auth_token]
+        from cloudtik_tpu.utils.fate_sharing import preexec
         self._proc = subprocess.Popen(
             cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True)
+            text=True, preexec_fn=preexec())
         # the binary reports its bound port (supports --port 0)
         deadline = time.time() + timeout_s
         line = ""

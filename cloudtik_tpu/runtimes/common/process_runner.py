@@ -86,9 +86,11 @@ def spawn_service(
         full_env.update({k: str(v) for k, v in env.items()})
     log = open(_logfile(name), "ab")
     try:
+        from cloudtik_tpu.utils.fate_sharing import preexec
         proc = subprocess.Popen(
             cmd, stdout=log, stderr=subprocess.STDOUT, cwd=cwd,
-            env=full_env, start_new_session=True)
+            env=full_env, start_new_session=True,
+            preexec_fn=preexec())
     except OSError as e:
         raise ServiceStartError(f"{name}: cannot exec {cmd[0]!r}: {e}")
     finally:

@@ -80,6 +80,43 @@ class TestEventSummarizer:
         assert s.drain() == ["x 1"]
 
 
+class TestFateSharing:
+    def test_child_dies_with_parent(self, tmp_path):
+        import os
+        import signal
+        import subprocess
+        import sys
+        import textwrap
+
+        script = tmp_path / "parent.py"
+        script.write_text(textwrap.dedent("""
+            import subprocess, sys, time
+            from cloudtik_tpu.utils.fate_sharing import preexec
+            proc = subprocess.Popen(["sleep", "120"],
+                                    preexec_fn=preexec())
+            print(proc.pid, flush=True)
+            time.sleep(120)
+        """))
+        parent = subprocess.Popen(
+            [sys.executable, str(script)], stdout=subprocess.PIPE,
+            text=True)
+        child_pid = int(parent.stdout.readline())
+        # child alive while parent lives
+        os.kill(child_pid, 0)
+        parent.kill()
+        parent.wait(timeout=10)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                os.kill(child_pid, 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.1)
+        else:
+            os.kill(child_pid, signal.SIGKILL)
+            pytest.fail("child survived parent death")
+
+
 class TestStreamingOutput:
     def test_streams_and_captures(self):
         import io
