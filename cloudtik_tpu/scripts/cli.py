@@ -579,6 +579,42 @@ def node_stop():
         os.unlink(pid_file)
 
 
+@node.command(name="run")
+@click.argument("command", nargs=-1, required=True)
+def node_run(command):
+    """Run a command on this node with the runtime environment loaded
+    (reference: node_scripts `run`)."""
+    import subprocess
+
+    from cloudtik_tpu.control.services import load_bootstrap_config
+    from cloudtik_tpu.runtimes.registry import iter_runtimes
+    env = dict(os.environ)
+    try:
+        config = load_bootstrap_config()
+    except FileNotFoundError:
+        config = {}
+    for runtime in iter_runtimes(config):
+        try:
+            extra = runtime.with_environment_variables(
+                config, None, os.environ.get("TIK_NODE_ID", ""))
+        except Exception:
+            extra = None
+        if extra:
+            env.update({k: str(v) for k, v in extra.items()})
+    raise SystemExit(subprocess.call(" ".join(command), shell=True,
+                                     env=env))
+
+
+@node.command(name="dump")
+@click.option("--output", default=None, help="archive path (.tar.gz)")
+def node_dump(output):
+    """Collect this node's logs/configs/processes into an archive
+    (reference: node_scripts `dump`)."""
+    from cloudtik_tpu.control.cluster_dump import create_archive
+    path = create_archive(output_path=output, cluster_name="node")
+    cli_logger.success("Node debug archive written to {}.", path)
+
+
 def main():
     from cloudtik_tpu.control.executor.base import CommandError
     try:
