@@ -74,11 +74,14 @@ def transformer_backend(model: str = "tiny",
         with lock:
             fn = compiled.get(key)
             if fn is None:
-                fn = jax.jit(lambda pr, rng: G.generate(
-                    params, pr, cfg, max_new_tokens=max_new,
+                # params as an argument (closure constants bake large
+                # weights into the program and blow up compilation)
+                fn = jax.jit(lambda p, pr, rng: G.generate(
+                    p, pr, cfg, max_new_tokens=max_new,
                     temperature=temperature, top_k=top_k, rng=rng))
                 compiled[key] = fn
-        out = fn(jnp.asarray(tokens), jax.random.PRNGKey(seed))
+        out = fn(params, jnp.asarray(tokens),
+                 jax.random.PRNGKey(seed))
         return {"tokens": np.asarray(out).tolist()}
 
     return ModelBackend(f"transformer:{model}", {"generate": generate})

@@ -34,15 +34,20 @@ def main():
     prompt = jnp.asarray(np.random.default_rng(0).integers(
         0, cfg.vocab_size, (args.batch, args.prompt_len)), jnp.int32)
 
-    gen = jax.jit(lambda pr, rng: G.generate(
-        params, pr, cfg, max_new_tokens=args.max_new,
+    # params as an ARGUMENT, not a closure constant — closed-over params
+    # get baked into the program as literals and a ~1B-param constant
+    # fold makes compilation pathological
+    gen = jax.jit(lambda p, pr, rng: G.generate(
+        p, pr, cfg, max_new_tokens=args.max_new,
         temperature=args.temperature, top_k=args.top_k, rng=rng))
-    out = gen(prompt, jax.random.PRNGKey(1))        # compile + warmup
-    out.block_until_ready()
+    # device_get, not block_until_ready: remote backends (axon tunnel)
+    # resolve block_until_ready before the computation actually retires,
+    # which inflates throughput ~300x; a host transfer cannot lie
+    jax.device_get(gen(params, prompt, jax.random.PRNGKey(1)))  # warmup
     t0 = time.perf_counter()
     for i in range(args.steps):
-        out = gen(prompt, jax.random.PRNGKey(2 + i))
-        out.block_until_ready()
+        out = jax.device_get(gen(params, prompt,
+                                 jax.random.PRNGKey(2 + i)))
     dt = time.perf_counter() - t0
     tokens = args.batch * args.max_new * args.steps
     print(json.dumps({
