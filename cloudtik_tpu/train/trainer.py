@@ -409,10 +409,14 @@ class Trainer:
                         and self.step % self.config.checkpoint_every == 0):
                     self.checkpointer.save(self.step, self.state)
                 if self.step % self.config.log_every == 0:
-                    jax.block_until_ready(metrics)
+                    # the float() host transfers are the sync point:
+                    # remote backends (axon tunnel) resolve
+                    # block_until_ready before compute retires, so dt
+                    # must be taken AFTER the transfer or tokens/sec
+                    # and MFU inflate
+                    entry = {k: float(v) for k, v in metrics.items()}
                     dt = time.perf_counter() - t_window
                     tokens_s = tokens_per_step * window_steps / dt
-                    entry = {k: float(v) for k, v in metrics.items()}
                     entry.update(step=self.step, tokens_per_sec=tokens_s)
                     if self.spec.flops_per_token and peak:
                         mfu = (self.spec.flops_per_token * tokens_s
