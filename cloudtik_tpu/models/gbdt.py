@@ -38,7 +38,8 @@ class GBDTConfig:
     n_bins: int = 64                 # <= 256 (uint8 bins)
     reg_lambda: float = 1.0
     min_child_hess: float = 1e-3
-    objective: str = "logistic"      # 'logistic' | 'l2'
+    objective: str = "logistic"      # 'logistic' | 'l2' | 'softmax'
+    n_classes: int = 2               # softmax objective only
 
 
 def config(**overrides) -> GBDTConfig:
@@ -172,10 +173,13 @@ def _tree_predict(tree: Dict[str, jax.Array], binned: jax.Array,
 
 def fit(binned: jax.Array, labels: jax.Array, cfg: GBDTConfig,
         *, eval_every: int = 0) -> Dict[str, jax.Array]:
-    """Train a forest.  binned [N, F] uint8, labels [N] (float or {0,1}).
-    Returns stacked tree arrays {split_feat, split_bin [T, 2^d-1],
-    leaf [T, 2^d], base_score []}."""
+    """Train a forest.  binned [N, F] uint8, labels [N] (float targets,
+    {0,1}, or int class ids for 'softmax').  Returns stacked tree arrays
+    {split_feat, split_bin [T, 2^d-1], leaf [T, 2^d], base_score []};
+    the softmax objective adds a class dim ([T, K, ...], base [K])."""
     binned = binned.astype(jnp.int32)
+    if cfg.objective == "softmax":
+        return _fit_softmax(binned, labels.astype(jnp.int32), cfg)
     labels = labels.astype(jnp.float32)
     if cfg.objective == "logistic":
         p0 = jnp.clip(labels.mean(), 1e-4, 1 - 1e-4)
@@ -195,15 +199,56 @@ def fit(binned: jax.Array, labels: jax.Array, cfg: GBDTConfig,
     return trees
 
 
+def _fit_softmax(binned: jax.Array, labels: jax.Array,
+                 cfg: GBDTConfig) -> Dict[str, jax.Array]:
+    """Native multiclass: every round grows K trees (one per class) on
+    the softmax gradients — the xgboost multi:softprob strategy, with
+    the per-class growth vmapped so all K split searches share one
+    traversal of the data."""
+    K = cfg.n_classes
+    onehot = jax.nn.one_hot(labels, K)                       # [N, K]
+    prior = jnp.clip(onehot.mean(axis=0), 1e-4, 1.0)
+    base = jnp.log(prior)
+
+    grow = jax.vmap(lambda g, h: _grow_tree(binned, g, h, cfg),
+                    in_axes=1)
+    predict_k = jax.vmap(
+        lambda tree: _tree_predict(tree, binned, cfg.depth))
+
+    def round_(scores, _):
+        p = jax.nn.softmax(scores, axis=-1)                  # [N, K]
+        g = p - onehot
+        h = jnp.maximum(p * (1 - p), 1e-6)
+        trees = grow(g, h)                                   # [K, ...]
+        scores = scores + predict_k(trees).T                 # [N, K]
+        return scores, trees
+
+    scores0 = jnp.broadcast_to(base, (binned.shape[0], K))
+    _, trees = jax.lax.scan(round_, scores0, None, length=cfg.n_trees)
+    trees["base_score"] = base
+    return trees
+
+
 def predict(forest: Dict[str, jax.Array], binned: jax.Array,
             cfg: GBDTConfig) -> jax.Array:
-    """Raw scores [N] (apply sigmoid for logistic probability)."""
+    """Raw scores: [N] (logistic/l2) or [N, K] (softmax)."""
     binned = binned.astype(jnp.int32)
+    trees = {k: v for k, v in forest.items() if k != "base_score"}
+    if cfg.objective == "softmax":
+        predict_k = jax.vmap(
+            lambda tree: _tree_predict(tree, binned, cfg.depth))
+
+        def one(score, tree):
+            return score + predict_k(tree).T, None
+
+        init = jnp.broadcast_to(forest["base_score"],
+                                (binned.shape[0], cfg.n_classes))
+        score, _ = jax.lax.scan(one, init, trees)
+        return score
 
     def one(score, tree):
         return score + _tree_predict(tree, binned, cfg.depth), None
 
-    trees = {k: v for k, v in forest.items() if k != "base_score"}
     init = jnp.full((binned.shape[0],), forest["base_score"])
     score, _ = jax.lax.scan(one, init, trees)
     return score
@@ -211,7 +256,10 @@ def predict(forest: Dict[str, jax.Array], binned: jax.Array,
 
 def predict_proba(forest: Dict[str, jax.Array], binned: jax.Array,
                   cfg: GBDTConfig) -> jax.Array:
-    return jax.nn.sigmoid(predict(forest, binned, cfg))
+    scores = predict(forest, binned, cfg)
+    if cfg.objective == "softmax":
+        return jax.nn.softmax(scores, axis=-1)
+    return jax.nn.sigmoid(scores)
 
 
 def save(path: str, forest: Dict[str, jax.Array],

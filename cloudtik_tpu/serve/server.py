@@ -95,10 +95,17 @@ def gbdt_backend(model_path: str) -> ModelBackend:
     from cloudtik_tpu.models import gbdt as GB
 
     forest, edges = GB.load(model_path)
-    depth = int(np.log2(forest["leaf"].shape[1]))
+    leaf = forest["leaf"]
     n_bins = int(edges.shape[1]) + 1 if edges is not None else 64
-    cfg = GB.config(n_trees=int(forest["leaf"].shape[0]), depth=depth,
-                    n_bins=n_bins)
+    if leaf.ndim == 3:      # [T, K, 2^d]: native multiclass forest
+        cfg = GB.config(n_trees=int(leaf.shape[0]),
+                        depth=int(np.log2(leaf.shape[2])),
+                        n_bins=n_bins, objective="softmax",
+                        n_classes=int(leaf.shape[1]))
+    else:
+        cfg = GB.config(n_trees=int(leaf.shape[0]),
+                        depth=int(np.log2(leaf.shape[1])),
+                        n_bins=n_bins)
 
     def predict(payload: Dict[str, Any]) -> Dict[str, Any]:
         X = np.asarray(payload["features"], np.float32)

@@ -57,6 +57,22 @@ class TestGBDT:
             GB.predict(forest, Xb, cfg), GB.predict(loaded, Xb, cfg),
             rtol=1e-6)
 
+    def test_softmax_multiclass(self):
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((1200, 6)).astype(np.float32)
+        # 3 classes from sign patterns of two features (needs interactions)
+        y = (2 * (X[:, 0] > 0) + (X[:, 1] > 0)).clip(0, 2).astype(np.int32)
+        cfg = GB.config(n_trees=25, depth=3, n_bins=16,
+                        learning_rate=0.3, objective="softmax",
+                        n_classes=3)
+        edges = GB.quantile_bins(X, cfg.n_bins)
+        Xb = jnp.asarray(GB.apply_bins(X, edges))
+        forest = GB.fit(Xb, jnp.asarray(y), cfg)
+        assert forest["leaf"].shape == (25, 3, 8)
+        proba = np.asarray(GB.predict_proba(forest, Xb, cfg))
+        np.testing.assert_allclose(proba.sum(1), 1.0, rtol=1e-5)
+        assert (proba.argmax(1) == y).mean() > 0.95
+
     def test_binning_is_monotonic(self):
         X = np.linspace(-3, 3, 100, dtype=np.float32)[:, None]
         edges = GB.quantile_bins(X, 8)
