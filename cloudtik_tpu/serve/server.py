@@ -107,11 +107,21 @@ def gbdt_backend(model_path: str) -> ModelBackend:
                         depth=int(np.log2(leaf.shape[1])),
                         n_bins=n_bins)
 
+    import jax
+
+    compiled: Dict[Any, Any] = {}
+    lock = threading.Lock()
+
     def predict(payload: Dict[str, Any]) -> Dict[str, Any]:
         X = np.asarray(payload["features"], np.float32)
         binned = GB.apply_bins(X, edges) if edges is not None \
             else X.astype(np.uint8)
-        proba = GB.predict_proba(forest, jnp.asarray(binned), cfg)
+        with lock:
+            fn = compiled.get(binned.shape)
+            if fn is None:
+                fn = jax.jit(lambda f, b: GB.predict_proba(f, b, cfg))
+                compiled[binned.shape] = fn
+        proba = fn(forest, jnp.asarray(binned))
         return {"probabilities": np.asarray(proba).tolist()}
 
     return ModelBackend("gbdt", {"predict": predict})

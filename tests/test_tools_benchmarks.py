@@ -49,6 +49,19 @@ class TestKafkaPerf:
         assert "kafka-consumer-perf-test.sh" in out[1]
 
 
+class TestServingLatency:
+    def test_self_contained_bench(self, capsys):
+        import json as _json
+
+        latency = _load("serving/latency.py", "serving_latency")
+        rc = latency.main(["--self-contained", "--requests", "10",
+                           "--batch", "4"])
+        assert rc == 0
+        out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["requests"] == 10
+        assert out["p50_ms"] > 0 and out["p99_ms"] >= out["p50_ms"]
+
+
 class TestTPCxAI:
     def test_dry_run_covers_all_families(self, capsys):
         tpcx = _load("ai/tpcx_ai.py", "tpcx_ai")

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Serving latency/throughput harness for tik-serve.
+
+Reference parity: tools/benchmarks (the reference benches its serving
+stacks); measures p50/p95/p99 latency and request throughput against a
+tik-serve endpoint — either an already-running server (--url) or a
+self-contained in-process GBDT server (--self-contained, used by CI).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+import urllib.request
+
+
+def percentile(values, p):
+    values = sorted(values)
+    idx = min(int(len(values) * p / 100), len(values) - 1)
+    return values[idx]
+
+
+def run_load(url: str, payload: dict, requests: int) -> dict:
+    body = json.dumps(payload).encode()
+    lat = []
+    t0 = time.perf_counter()
+    for _ in range(requests):
+        s = time.perf_counter()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            resp.read()
+        lat.append(time.perf_counter() - s)
+    wall = time.perf_counter() - t0
+    return {
+        "requests": requests,
+        "rps": round(requests / wall, 2),
+        "p50_ms": round(percentile(lat, 50) * 1000, 2),
+        "p95_ms": round(percentile(lat, 95) * 1000, 2),
+        "p99_ms": round(percentile(lat, 99) * 1000, 2),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("serving-latency")
+    p.add_argument("--url", default=None,
+                   help="endpoint, e.g. http://head:8200/v1/predict")
+    p.add_argument("--requests", type=int, default=100)
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--self-contained", action="store_true",
+                   help="spin up an in-process GBDT server to bench")
+    args = p.parse_args(argv)
+
+    server = None
+    if args.self_contained or not args.url:
+        # pin the self-contained bench to CPU before any device use —
+        # the env-var route (JAX_PLATFORMS) is overridden by TPU-image
+        # sitecustomize hooks, and a latency bench must not grab the
+        # training chip
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+
+        import jax.numpy as jnp
+        from cloudtik_tpu.models import gbdt as GB
+        from cloudtik_tpu.serve.server import ServeServer, gbdt_backend
+        import tempfile
+
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((500, 8)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        cfg = GB.config(n_trees=20, depth=4, n_bins=16)
+        edges = GB.quantile_bins(X, cfg.n_bins)
+        forest = GB.fit(jnp.asarray(GB.apply_bins(X, edges)),
+                        jnp.asarray(y), cfg)
+        path = tempfile.mktemp(suffix=".npz")
+        GB.save(path, forest, edges)
+        server = ServeServer([gbdt_backend(path)], host="127.0.0.1")
+        server.start()
+        args.url = f"http://127.0.0.1:{server.port}/v1/predict"
+        payload = {"features": X[:args.batch].tolist()}
+    else:
+        payload = {"features": [[0.0] * 8] * args.batch}
+
+    try:
+        # warmup (first request compiles)
+        run_load(args.url, payload, 3)
+        result = run_load(args.url, payload, args.requests)
+        result["batch"] = args.batch
+        print(json.dumps(result))
+    finally:
+        if server is not None:
+            server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
