@@ -1,0 +1,86 @@
+"""Built-in Grafana dashboards (provisioned JSON).
+
+Reference parity: runtime/grafana conf/dashboards — the reference ships
+provisioned dashboards for its metrics stack.  One cluster-overview
+dashboard over the metrics this framework actually emits: nodex
+exporter gauges (per-node cpu/memory/disk), controller reconcile
+gauges, and the prometheus collector's per-instance series.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+
+def _panel(panel_id: int, title: str, expr: str, unit: str,
+           x: int, y: int) -> Dict[str, Any]:
+    return {
+        "id": panel_id,
+        "title": title,
+        "type": "timeseries",
+        "gridPos": {"h": 8, "w": 12, "x": x, "y": y},
+        "fieldConfig": {"defaults": {"unit": unit}},
+        "targets": [{
+            "expr": expr,
+            "legendFormat": "{{instance}}",
+            "refId": "A",
+        }],
+        "datasource": {"type": "prometheus",
+                       "uid": "tik-prometheus"},
+    }
+
+
+def cluster_overview_dashboard() -> Dict[str, Any]:
+    panels: List[Dict[str, Any]] = [
+        _panel(1, "CPU utilization", "tik_node_cpu_percent",
+               "percent", 0, 0),
+        _panel(2, "Memory utilization", "tik_node_memory_percent",
+               "percent", 12, 0),
+        _panel(3, "Disk utilization", "tik_node_disk_percent",
+               "percent", 0, 8),
+        _panel(4, "Network throughput",
+               "rate(tik_node_net_sent_bytes[1m]) "
+               "+ rate(tik_node_net_recv_bytes[1m])", "Bps", 12, 8),
+        _panel(5, "Cluster workers",
+               "tik_cluster_workers", "short", 0, 16),
+        _panel(6, "Pending launches / active updaters",
+               "tik_pending_launches or tik_active_updaters",
+               "short", 12, 16),
+    ]
+    return {
+        "uid": "tik-cluster-overview",
+        "title": "Tik Cluster Overview",
+        "schemaVersion": 39,
+        "refresh": "10s",
+        "time": {"from": "now-1h", "to": "now"},
+        "panels": panels,
+        "templating": {"list": []},
+    }
+
+
+def render_dashboard_provider(dashboards_dir: str) -> str:
+    """provisioning/dashboards provider yaml (file-based)."""
+    import yaml
+    return yaml.safe_dump({
+        "apiVersion": 1,
+        "providers": [{
+            "name": "tik",
+            "type": "file",
+            "options": {"path": dashboards_dir},
+        }],
+    })
+
+
+def write_dashboards(provisioning_dir: str) -> List[str]:
+    """Write provider yaml + dashboard JSONs; returns created paths."""
+    import os
+    dash_dir = os.path.join(provisioning_dir, "dashboards")
+    os.makedirs(dash_dir, exist_ok=True)
+    provider = os.path.join(dash_dir, "tik.yaml")
+    with open(provider, "w") as f:
+        f.write(render_dashboard_provider(dash_dir))
+    dashboard = os.path.join(dash_dir, "cluster-overview.json")
+    with open(dashboard, "w") as f:
+        json.dump(cluster_overview_dashboard(), f, indent=1)
+    return [provider, dashboard]

@@ -54,6 +54,9 @@ class GrafanaRuntime(ServiceRuntimeBase):
                     "isDefault": True,
                 }],
             }, f)
+        from cloudtik_tpu.runtimes.grafana.dashboards import (
+            write_dashboards)
+        write_dashboards(os.path.join(conf_dir, "provisioning"))
         with open(os.path.join(conf_dir, "grafana.ini"), "w") as f:
             f.write("[server]\n"
                     f"http_port = {self.port}\n"

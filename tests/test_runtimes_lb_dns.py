@@ -231,3 +231,43 @@ class TestComputeRuntimes:
         ini = render_pgbouncer_ini("10.0.0.1")
         assert "* = host=10.0.0.1 port=5432" in ini
         assert "pool_mode = transaction" in ini
+
+
+class TestGrafanaDashboards:
+    def test_provisioned_dashboard_matches_real_metrics(self, tmp_path):
+        import json
+
+        from cloudtik_tpu.runtimes.grafana.dashboards import (
+            cluster_overview_dashboard, write_dashboards)
+
+        created = write_dashboards(str(tmp_path))
+        assert any(p.endswith("tik.yaml") for p in created)
+        dash_path = [p for p in created if p.endswith(".json")][0]
+        dash = json.loads(open(dash_path).read())
+        assert dash["uid"] == "tik-cluster-overview"
+        exprs = " ".join(
+            t["expr"] for p in dash["panels"] for t in p["targets"])
+        # every metric the dashboard queries is actually emitted
+        import cloudtik_tpu.runtimes.nodex.exporter as nodex
+        nodex_src = open(nodex.__file__).read()
+        for metric in ("tik_node_cpu_percent", "tik_node_memory_percent",
+                       "tik_node_disk_percent", "tik_node_net_sent_bytes"):
+            assert metric in exprs and metric in nodex_src
+        import cloudtik_tpu.control.controller as controller
+        ctrl_src = open(controller.__file__).read()
+        for metric in ("tik_cluster_workers", "tik_pending_launches"):
+            assert metric in exprs and metric in ctrl_src
+
+    def test_grafana_configure_provisions_dashboards(self, tmp_path):
+        from cloudtik_tpu.runtimes.grafana.runtime import GrafanaRuntime
+
+        rt = GrafanaRuntime({})
+        ctx = {"is_head": True, "conf_dir": str(tmp_path)}
+        rt.node_configure(ctx)
+        conf = tmp_path / "grafana"
+        import os
+        found = []
+        for root, _, files in os.walk(tmp_path):
+            found += files
+        assert "cluster-overview.json" in found
+        assert "grafana.ini" in found
