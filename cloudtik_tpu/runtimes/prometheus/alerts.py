@@ -1,0 +1,80 @@
+"""Built-in Prometheus alerting rules.
+
+Reference parity: runtime/prometheus conf — the reference provisions
+alerting for its metrics stack.  Rules over the series this framework
+emits (nodex node gauges + controller reconcile gauges): node pressure
+(cpu/memory/disk), scrape-target loss (node down), and a stuck
+reconcile loop (pending launches never draining).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import yaml
+
+
+def default_rules(cpu_threshold: float = 95.0,
+                  memory_threshold: float = 90.0,
+                  disk_threshold: float = 85.0) -> Dict[str, Any]:
+    return {
+        "groups": [{
+            "name": "tik-cluster",
+            "rules": [
+                {
+                    "alert": "NodeCpuSaturated",
+                    "expr": f"tik_node_cpu_percent > {cpu_threshold}",
+                    "for": "10m",
+                    "labels": {"severity": "warning"},
+                    "annotations": {"summary":
+                                    "{{ $labels.instance }} CPU "
+                                    f"> {cpu_threshold}% for 10m"},
+                },
+                {
+                    "alert": "NodeMemoryPressure",
+                    "expr": f"tik_node_memory_percent"
+                            f" > {memory_threshold}",
+                    "for": "5m",
+                    "labels": {"severity": "warning"},
+                    "annotations": {"summary":
+                                    "{{ $labels.instance }} memory "
+                                    f"> {memory_threshold}%"},
+                },
+                {
+                    "alert": "NodeDiskFull",
+                    "expr": f"tik_node_disk_percent > {disk_threshold}",
+                    "for": "5m",
+                    "labels": {"severity": "critical"},
+                    "annotations": {"summary":
+                                    "{{ $labels.instance }} disk "
+                                    f"> {disk_threshold}%"},
+                },
+                {
+                    "alert": "NodeExporterDown",
+                    "expr": 'up == 0',
+                    "for": "2m",
+                    "labels": {"severity": "critical"},
+                    "annotations": {"summary":
+                                    "{{ $labels.instance }} stopped "
+                                    "reporting metrics"},
+                },
+                {
+                    "alert": "LaunchesStuck",
+                    "expr": "tik_pending_launches > 0",
+                    "for": "30m",
+                    "labels": {"severity": "warning"},
+                    "annotations": {"summary":
+                                    "node launches pending > 30m "
+                                    "(capacity or quota?)"},
+                },
+            ],
+        }],
+    }
+
+
+def write_rules(conf_dir: str, **thresholds) -> str:
+    import os
+    path = os.path.join(conf_dir, "alerts.yml")
+    with open(path, "w") as f:
+        yaml.safe_dump(default_rules(**thresholds), f, sort_keys=False)
+    return path

@@ -48,8 +48,12 @@ class PrometheusRuntime(ServiceRuntimeBase):
         services = _declared_http_services(config, head_ip)
         if services or not os.path.exists(targets_file):
             write_targets_file(conf_dir, services)
+        from cloudtik_tpu.runtimes.prometheus.alerts import write_rules
+        rules_file = write_rules(
+            conf_dir, **self.runtime_config.get("alert_thresholds", {}))
         prom_config = {
             "global": {"scrape_interval": "15s"},
+            "rule_files": [rules_file],
             "scrape_configs": [{
                 "job_name": "tik",
                 "file_sd_configs": [{"files": [targets_file]}],

@@ -271,3 +271,37 @@ class TestGrafanaDashboards:
             found += files
         assert "cluster-overview.json" in found
         assert "grafana.ini" in found
+
+
+class TestPrometheusAlerts:
+    def test_rules_reference_emitted_metrics(self, tmp_path):
+        import yaml as _yaml
+
+        from cloudtik_tpu.runtimes.prometheus.alerts import write_rules
+
+        path = write_rules(str(tmp_path), cpu_threshold=90.0)
+        doc = _yaml.safe_load(open(path))
+        rules = doc["groups"][0]["rules"]
+        names = {r["alert"] for r in rules}
+        assert {"NodeCpuSaturated", "NodeDiskFull",
+                "NodeExporterDown", "LaunchesStuck"} <= names
+        exprs = " ".join(r["expr"] for r in rules)
+        assert "tik_node_cpu_percent > 90.0" in exprs
+        assert "tik_pending_launches" in exprs
+
+    def test_prometheus_config_includes_rule_file(self, tmp_path):
+        import yaml as _yaml
+
+        from cloudtik_tpu.runtimes.prometheus.runtime import (
+            PrometheusRuntime)
+
+        rt = PrometheusRuntime({})
+        rt.node_configure({"is_head": True, "conf_dir": str(tmp_path),
+                           "config": {}, "head_ip": "127.0.0.1"})
+        import glob
+        prom_yml = glob.glob(str(tmp_path) + "/**/prometheus.yml",
+                             recursive=True)
+        assert prom_yml
+        doc = _yaml.safe_load(open(prom_yml[0]))
+        assert any(p.endswith("alerts.yml")
+                   for p in doc.get("rule_files", []))
