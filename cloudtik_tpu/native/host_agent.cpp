@@ -73,7 +73,10 @@ static void emit_sample(const CpuTimes& prev, const CpuTimes& cur) {
   double cpu_percent = 0.0;
   uint64_t dt = cur.total - prev.total;
   if (dt > 0) {
-    uint64_t busy = dt - (cur.idle - prev.idle);
+    // iowait (folded into idle) is documented non-monotonic (proc(5));
+    // clamp so the unsigned busy delta can't wrap
+    uint64_t idle_d = cur.idle >= prev.idle ? cur.idle - prev.idle : 0;
+    uint64_t busy = idle_d < dt ? dt - idle_d : 0;
     cpu_percent = 100.0 * static_cast<double>(busy) / dt;
   }
   uint64_t mem_total = meminfo_kb("MemTotal:") * 1024;

@@ -86,11 +86,13 @@ def spawn_service(
         full_env.update({k: str(v) for k, v in env.items()})
     log = open(_logfile(name), "ab")
     try:
-        from cloudtik_tpu.utils.fate_sharing import preexec
+        # NOTE: no fate-sharing here — runtime services are spawned by
+        # short-lived CLI invocations (`tik runtime services start`) and
+        # must outlive them; PDEATHSIG belongs only on children of the
+        # long-lived node-services process (native state server/sampler)
         proc = subprocess.Popen(
             cmd, stdout=log, stderr=subprocess.STDOUT, cwd=cwd,
-            env=full_env, start_new_session=True,
-            preexec_fn=preexec())
+            env=full_env, start_new_session=True)
     except OSError as e:
         raise ServiceStartError(f"{name}: cannot exec {cmd[0]!r}: {e}")
     finally:

@@ -49,16 +49,23 @@ class ServingRuntime(ServiceRuntimeBase):
         if not self.runs_on(node_context):
             return
         from cloudtik_tpu.serve.server import ServeServer
-        if command == "start" and self.port not in _servers:
-            server = ServeServer(self._build_backends(), port=self.port)
+        cfg_port = self.port
+        if command == "start" and cfg_port not in _servers:
+            server = ServeServer(self._build_backends(), port=cfg_port)
             server.start()
-            # port 0 binds ephemeral: adopt the bound port so discovery
-            # registration and endpoint listings advertise reality
+            # the registry is keyed by the CONFIGURED port (0 for an
+            # ephemeral bind): delivery re-creates runtime instances per
+            # invocation, so a stop-time instance only knows the config
+            # value.  Registration temporarily adopts the bound port so
+            # discovery advertises reality, then restores the key.
+            _servers[cfg_port] = server
             self.runtime_config["port"] = server.port
-            _servers[self.port] = server
-            self._register(node_context)
+            try:
+                self._register(node_context)
+            finally:
+                self.runtime_config["port"] = cfg_port
         elif command == "stop":
-            server = _servers.pop(self.port, None)
+            server = _servers.pop(cfg_port, None)
             if server is not None:
                 server.stop()
             self._deregister(node_context)

@@ -601,7 +601,8 @@ def node_run(command):
             extra = None
         if extra:
             env.update({k: str(v) for k, v in extra.items()})
-    raise SystemExit(subprocess.call(" ".join(command), shell=True,
+    import shlex
+    raise SystemExit(subprocess.call(shlex.join(command), shell=True,
                                      env=env))
 
 

@@ -95,8 +95,14 @@ def gbdt_backend(model_path: str) -> ModelBackend:
     from cloudtik_tpu.models import gbdt as GB
 
     forest, edges = GB.load(model_path)
+    if edges is None:
+        # raw floats cast to uint8 would wrap/truncate into garbage bin
+        # ids and return confidently wrong probabilities — refuse early
+        raise ValueError(
+            f"{model_path} was saved without bin edges; save with "
+            "GB.save(path, forest, edges) to serve it")
     leaf = forest["leaf"]
-    n_bins = int(edges.shape[1]) + 1 if edges is not None else 64
+    n_bins = int(edges.shape[1]) + 1
     if leaf.ndim == 3:      # [T, K, 2^d]: native multiclass forest
         cfg = GB.config(n_trees=int(leaf.shape[0]),
                         depth=int(np.log2(leaf.shape[2])),
@@ -114,8 +120,7 @@ def gbdt_backend(model_path: str) -> ModelBackend:
 
     def predict(payload: Dict[str, Any]) -> Dict[str, Any]:
         X = np.asarray(payload["features"], np.float32)
-        binned = GB.apply_bins(X, edges) if edges is not None \
-            else X.astype(np.uint8)
+        binned = GB.apply_bins(X, edges)
         with lock:
             fn = compiled.get(binned.shape)
             if fn is None:
