@@ -650,6 +650,66 @@ def monitor_cluster(config: Dict[str, Any], follow: bool = False) -> str:
         provider.cleanup()
 
 
+def tail_cluster_logs(
+    config: Dict[str, Any],
+    node_id: Optional[str] = None,
+    grep: Optional[str] = None,
+    follow: bool = False,
+    max_batches: int = 200,
+    _max_polls: Optional[int] = None,
+) -> "Iterator[str]":
+    """Stream log lines the node log agents published into the head
+    state store (reference: cloudtik monitor's log tail +
+    cloudtik_log_agent.py's Redis pubsub, here the LOG_NS table).
+
+    Yields "node/file: line" strings; with follow=True keeps polling for
+    new batches (Ctrl-C to stop)."""
+    import re as _re
+
+    from cloudtik_tpu.control.log_agent import LOG_NS
+    config = bootstrap_config(config)
+    provider = create_node_provider(
+        config["provider"], config["cluster_name"])
+    pattern = _re.compile(grep) if grep else None
+    try:
+        state = _head_state_client(config, provider)
+        seen: set = set()
+        polls = 0
+        while True:
+            batches = state.table_list(LOG_NS) or {}
+            for key in sorted(batches, key=_log_batch_order):
+                if key in seen:
+                    continue
+                seen.add(key)
+                batch = batches[key]
+                if node_id and batch.get("node_id") != node_id:
+                    continue
+                prefix = (f"{batch.get('node_id', '?')}/"
+                          f"{os.path.basename(batch.get('file', ''))}")
+                for line in batch.get("lines", []):
+                    if pattern is None or pattern.search(line):
+                        yield f"{prefix}: {line}"
+            if not follow:
+                return
+            polls += 1
+            if _max_polls is not None and polls >= _max_polls:
+                return
+            time.sleep(1.0)
+            if len(seen) > max_batches * 10:
+                seen = set(sorted(seen, key=_log_batch_order)
+                           [-max_batches:])
+    finally:
+        provider.cleanup()
+
+
+def _log_batch_order(key: str):
+    node, _, seq = key.rpartition(":")
+    try:
+        return (node, int(seq))
+    except ValueError:
+        return (node, 0)
+
+
 def dump_cluster(
     config: Dict[str, Any],
     output_path: Optional[str] = None,

@@ -169,6 +169,25 @@ def monitor(config_file):
 
 @cli.command()
 @click.argument("config_file", type=click.Path(exists=True))
+@click.option("--node", "node_id", default=None,
+              help="Only this node's logs.")
+@click.option("--grep", default=None, help="Regex filter.")
+@click.option("--follow", "-f", is_flag=True,
+              help="Keep streaming new lines.")
+def logs(config_file, node_id, grep, follow):
+    """Stream log lines published by the node log agents."""
+    from cloudtik_tpu.control import cluster_operator
+    try:
+        for line in cluster_operator.tail_cluster_logs(
+                _load(config_file), node_id=node_id, grep=grep,
+                follow=follow):
+            click.echo(line)
+    except KeyboardInterrupt:
+        pass
+
+
+@cli.command()
+@click.argument("config_file", type=click.Path(exists=True))
 def attach(config_file):
     """Open an interactive shell on the head node."""
     from cloudtik_tpu.control import cluster_operator

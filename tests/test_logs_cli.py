@@ -1,0 +1,61 @@
+"""`tik logs`: streaming the log-agent's published batches."""
+
+import types
+
+import pytest
+
+from cloudtik_tpu.control import cluster_operator
+from cloudtik_tpu.control.log_agent import LOG_NS
+from cloudtik_tpu.control.state import InMemoryStateBackend, StateClient
+
+
+class _Provider:
+    def cleanup(self):
+        pass
+
+
+@pytest.fixture
+def wired(monkeypatch):
+    state = StateClient(InMemoryStateBackend())
+    monkeypatch.setattr(cluster_operator, "bootstrap_config",
+                        lambda c: c)
+    monkeypatch.setattr(cluster_operator, "create_node_provider",
+                        lambda *a, **k: _Provider())
+    monkeypatch.setattr(cluster_operator, "_head_state_client",
+                        lambda c, p: state)
+    return state
+
+
+def _publish(state, node, seq, file, lines):
+    state.table_put(LOG_NS, f"{node}:{seq}", {
+        "node_id": node, "file": file, "time": 0.0, "lines": lines})
+
+
+CONFIG = {"provider": {"type": "mock"}, "cluster_name": "c"}
+
+
+class TestTailClusterLogs:
+    def test_orders_and_prefixes(self, wired):
+        _publish(wired, "n1", 1, "/l/ctl.log", ["second"])
+        _publish(wired, "n1", 0, "/l/ctl.log", ["first"])
+        _publish(wired, "n2", 0, "/l/agent.log", ["other-node"])
+        out = list(cluster_operator.tail_cluster_logs(dict(CONFIG)))
+        assert out.index("n1/ctl.log: first") \
+            < out.index("n1/ctl.log: second")
+        assert "n2/agent.log: other-node" in out
+
+    def test_node_and_grep_filters(self, wired):
+        _publish(wired, "n1", 0, "/l/a.log", ["ERROR boom", "ok line"])
+        _publish(wired, "n2", 0, "/l/b.log", ["ERROR elsewhere"])
+        out = list(cluster_operator.tail_cluster_logs(
+            dict(CONFIG), node_id="n1", grep="ERROR"))
+        assert out == ["n1/a.log: ERROR boom"]
+
+    def test_follow_picks_up_new_batches(self, wired):
+        _publish(wired, "n1", 0, "/l/a.log", ["early"])
+        gen = cluster_operator.tail_cluster_logs(
+            dict(CONFIG), follow=True, _max_polls=2)
+        assert next(gen) == "n1/a.log: early"
+        _publish(wired, "n1", 1, "/l/a.log", ["late"])
+        rest = list(gen)
+        assert "n1/a.log: late" in rest
