@@ -54,6 +54,8 @@ _LOAD_BALANCER_PROVIDERS: Dict[str, str] = {
     "gcp": "cloudtik_tpu.providers.gcp.load_balancer_provider:GCPLoadBalancerProvider",
     "aws": "cloudtik_tpu.providers.aws.load_balancer_provider:AWSLoadBalancerProvider",
     "azure": "cloudtik_tpu.providers.azure.load_balancer_provider:AzureLoadBalancerProvider",
+    "aliyun": "cloudtik_tpu.providers.aliyun.load_balancer_provider:AliyunLoadBalancerProvider",
+    "huaweicloud": "cloudtik_tpu.providers.huaweicloud.load_balancer_provider:HuaweiCloudLoadBalancerProvider",
 }
 
 

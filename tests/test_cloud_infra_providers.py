@@ -756,3 +756,150 @@ class TestAliyunHuaweiDatabaseProviders:
         assert type(create_database_provider(
             {"type": "huaweicloud", "rds_client": FakeHuaweiRDS()},
             "ws", "db")).__name__ == "HuaweiCloudDatabaseProvider"
+
+
+# ---------------------------------------------------------------------------
+# Aliyun SLB + Huawei ELB (snake_case fake clients)
+# ---------------------------------------------------------------------------
+
+class FakeAliyunSLB:
+    def __init__(self):
+        self._lbs = {}
+        self._n = 0
+
+    def create_load_balancer(self, **kw):
+        self._n += 1
+        lb_id = f"lb-{self._n}"
+        self._lbs[lb_id] = {
+            "LoadBalancerId": lb_id,
+            "LoadBalancerName": kw["load_balancer_name"],
+            "Address": f"10.9.0.{self._n}",
+            "AddressType": kw["address_type"],
+            "ListenerPorts": [], "BackendServers": []}
+        return {"LoadBalancerId": lb_id}
+
+    def describe_load_balancers(self, region_id):
+        return {"LoadBalancers": list(self._lbs.values())}
+
+    def describe_load_balancer_attribute(self, load_balancer_id):
+        return self._lbs[load_balancer_id]
+
+    def create_load_balancer_tcp_listener(self, load_balancer_id,
+                                          listener_port,
+                                          backend_server_port, bandwidth):
+        self._lbs[load_balancer_id]["ListenerPorts"].append(listener_port)
+
+    def add_backend_servers(self, load_balancer_id, backend_servers):
+        self._lbs[load_balancer_id]["BackendServers"].extend(
+            dict(s, Port=s.get("Port")) for s in backend_servers)
+
+    def remove_backend_servers(self, load_balancer_id, backend_servers):
+        gone = {(s["ServerIp"], s["Port"]) for s in backend_servers}
+        lb = self._lbs[load_balancer_id]
+        lb["BackendServers"] = [
+            s for s in lb["BackendServers"]
+            if (s["ServerIp"], s["Port"]) not in gone]
+
+    def delete_load_balancer(self, load_balancer_id):
+        self._lbs.pop(load_balancer_id, None)
+
+
+class FakeHuaweiELB:
+    def __init__(self):
+        self._lbs = {}
+        self._pools = {}
+        self._n = 0
+
+    def create_load_balancer(self, **kw):
+        self._n += 1
+        lb = {"id": f"elb-{self._n}", "name": kw["name"],
+              "vip_address": f"192.168.9.{self._n}",
+              "listeners": [], "pools": []}
+        self._lbs[lb["id"]] = lb
+        return lb
+
+    def list_load_balancers(self, region):
+        return {"loadbalancers": list(self._lbs.values())}
+
+    def create_listener(self, loadbalancer_id, protocol, protocol_port):
+        self._n += 1
+        listener = {"id": f"lis-{self._n}",
+                    "protocol_port": protocol_port,
+                    "lb": loadbalancer_id}
+        self._lbs[loadbalancer_id]["listeners"].append(listener)
+        return listener
+
+    def create_pool(self, listener_id, protocol, lb_algorithm):
+        self._n += 1
+        pool = {"id": f"pool-{self._n}", "members": []}
+        self._pools[pool["id"]] = pool
+        for lb in self._lbs.values():
+            if any(l["id"] == listener_id for l in lb["listeners"]):
+                lb["pools"].append(pool)
+        return pool
+
+    def list_members(self, pool_id):
+        return {"members": list(self._pools[pool_id]["members"])}
+
+    def create_member(self, pool_id, address, protocol_port):
+        self._n += 1
+        self._pools[pool_id]["members"].append(
+            {"id": f"m-{self._n}", "address": address,
+             "protocol_port": protocol_port})
+
+    def delete_member(self, pool_id, member_id):
+        p = self._pools[pool_id]
+        p["members"] = [m for m in p["members"] if m["id"] != member_id]
+
+    def delete_load_balancer(self, load_balancer_id, cascade):
+        self._lbs.pop(load_balancer_id, None)
+
+
+class TestAliyunHuaweiLoadBalancers:
+    def test_aliyun_cycle(self):
+        from cloudtik_tpu.providers.aliyun.load_balancer_provider import (
+            AliyunLoadBalancerProvider)
+
+        lbp = AliyunLoadBalancerProvider(
+            {"type": "aliyun", "slb_client": FakeAliyunSLB()}, "ws")
+        lbp.create({"name": "svc", "port": 9000,
+                    "targets": [{"ip": "10.0.0.4", "port": 9000}]})
+        info = lbp.list()["svc"]
+        assert info["port"] == 9000
+        assert info["targets"] == [{"ip": "10.0.0.4", "port": 9000}]
+        lbp.update(info, {"name": "svc", "port": 9000,
+                          "targets": [{"ip": "10.0.0.5", "port": 9000}]})
+        info = lbp.list()["svc"]
+        assert [t["ip"] for t in info["targets"]] == ["10.0.0.5"]
+        lbp.delete(info)
+        assert lbp.list() == {}
+
+    def test_huawei_cycle(self):
+        from cloudtik_tpu.providers.huaweicloud.load_balancer_provider \
+            import HuaweiCloudLoadBalancerProvider
+
+        lbp = HuaweiCloudLoadBalancerProvider(
+            {"type": "huaweicloud", "elb_client": FakeHuaweiELB()}, "ws")
+        lbp.create({"name": "svc", "port": 8080,
+                    "targets": [{"ip": "192.168.0.4", "port": 8080},
+                                {"ip": "192.168.0.5", "port": 8080}]})
+        info = lbp.list()["svc"]
+        assert info["port"] == 8080
+        assert len(info["targets"]) == 2
+        lbp.update(info, {"name": "svc", "port": 8080,
+                          "targets": [{"ip": "192.168.0.5", "port": 8080}]})
+        info = lbp.list()["svc"]
+        assert info["targets"] == [{"ip": "192.168.0.5", "port": 8080}]
+        lbp.delete(info)
+        assert lbp.list() == {}
+
+    def test_factory_dispatch(self):
+        from cloudtik_tpu.providers.factory import (
+            create_load_balancer_provider)
+
+        assert type(create_load_balancer_provider(
+            {"type": "aliyun", "slb_client": FakeAliyunSLB()},
+            "ws")).__name__ == "AliyunLoadBalancerProvider"
+        assert type(create_load_balancer_provider(
+            {"type": "huaweicloud", "elb_client": FakeHuaweiELB()},
+            "ws")).__name__ == "HuaweiCloudLoadBalancerProvider"
