@@ -1,0 +1,47 @@
+"""Byte tokenizer + corpus prep -> tokenized_file_batches round trip."""
+
+import numpy as np
+
+from cloudtik_tpu.train.tokenizer import (
+    ByteTokenizer, EOS_ID, encode_corpus, get_tokenizer)
+
+
+class TestByteTokenizer:
+    def test_roundtrip(self):
+        tok = ByteTokenizer()
+        text = "hello tpu — ünïcode ok"
+        ids = tok.encode(text, add_bos=True, add_eos=True)
+        assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
+        assert tok.decode(ids) == text
+
+    def test_get_tokenizer_default(self):
+        assert isinstance(get_tokenizer(None), ByteTokenizer)
+        assert isinstance(get_tokenizer("byte"), ByteTokenizer)
+
+
+class TestEncodeCorpus:
+    def test_corpus_feeds_data_pipeline(self, tmp_path):
+        text = tmp_path / "corpus.txt"
+        text.write_text("doc one text\n\ndoc two text\n\ndoc three")
+        out = tmp_path / "tokens.npy"
+        total = encode_corpus(str(text), str(out))
+        tokens = np.load(out)
+        assert total == len(tokens) > 0
+        # three documents -> three EOS separators
+        assert (tokens == EOS_ID).sum() == 3
+        assert tokens.dtype == np.int32
+
+        from cloudtik_tpu.train.data import tokenized_file_batches
+        it = tokenized_file_batches(
+            str(out), batch_size=1, seq_len=8,
+            shard_index=0, shard_count=1, repeat=False)
+        batch = next(it)
+        assert batch["tokens"].shape == (1, 8)
+        assert batch["labels"].shape == (1, 8)
+
+    def test_empty_corpus(self, tmp_path):
+        text = tmp_path / "empty.txt"
+        text.write_text("   \n  ")
+        out = tmp_path / "tokens.npy"
+        assert encode_corpus(str(text), str(out)) == 0
+        assert len(np.load(out)) == 0

@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Prepare an LM training corpus: text file -> flat token .npy.
+
+The output feeds `train.data.tokenized_file_batches` (each host reads a
+disjoint strided shard).  Default tokenizer is the byte-level one (no
+downloads); pass --tokenizer <local-hf-dir> for a subword vocab.
+
+  python tools/prepare_corpus.py corpus.txt tokens.npy
+"""
+
+import argparse
+import json
+
+from cloudtik_tpu.train.tokenizer import encode_corpus, get_tokenizer
+
+
+def main():
+    p = argparse.ArgumentParser("prepare_corpus")
+    p.add_argument("text_path")
+    p.add_argument("out_path")
+    p.add_argument("--tokenizer", default="byte",
+                   help="'byte' or a local transformers snapshot dir")
+    p.add_argument("--doc-separator", default="\n\n")
+    args = p.parse_args()
+
+    tok = get_tokenizer(args.tokenizer)
+    total = encode_corpus(args.text_path, args.out_path, tok,
+                          doc_separator=args.doc_separator)
+    print(json.dumps({"tokens": total, "vocab_size": tok.vocab_size,
+                      "out": args.out_path}))
+
+
+if __name__ == "__main__":
+    main()
