@@ -125,6 +125,14 @@ PRESETS: Dict[str, TransformerConfig] = {
     "tiny": TransformerConfig(
         vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
         d_ff=128, max_seq_len=128, remat=False),
+    # Pod-scale presets (GQA, long context): shapes for tp/pp/fsdp
+    # meshes on v5p slices — dryrun-compilable on the CPU mesh.
+    "tpu_70b": TransformerConfig(
+        vocab_size=32_000, d_model=8192, n_layers=80, n_heads=64,
+        n_kv_heads=8, d_ff=28_672, max_seq_len=4096),
+    "tpu_405b": TransformerConfig(
+        vocab_size=128_256, d_model=16_384, n_layers=126, n_heads=128,
+        n_kv_heads=8, d_ff=53_248, max_seq_len=8192),
     # Expert-parallel flagship: ~8x1B-style sparse model.
     "tpu_moe_8x1b": TransformerConfig(
         vocab_size=32_000, d_model=2048, n_layers=16, n_heads=16,
