@@ -37,6 +37,61 @@ def build_proxy_command(head_ip: str, auth_config: Dict[str, Any],
     return cmd
 
 
+def build_tunnel_command(head_ip: str, auth_config: Dict[str, Any],
+                         forwards: List[Tuple[int, str, int]]
+                         ) -> List[str]:
+    """`ssh -L` port-forward command (pure, testable).
+
+    forwards: [(local_port, remote_host, remote_port)] — remote_host is
+    resolved on the head (so in-cluster service IPs/names work).
+    Reference parity: core/_private/cluster/cluster_tunnel_request.py:114
+    (per-service tunnels to cluster endpoints)."""
+    cmd = ["ssh", "-o", "StrictHostKeyChecking=no",
+           "-o", "ServerAliveInterval=30", "-N"]
+    for local, host, remote in forwards:
+        cmd += ["-L", f"{local}:{host}:{remote}"]
+    key = auth_config.get("ssh_private_key")
+    if key:
+        cmd += ["-i", os.path.expanduser(key)]
+    user = auth_config.get("ssh_user", "")
+    cmd.append(f"{user}@{head_ip}" if user else head_ip)
+    return cmd
+
+
+def start_tunnel(cluster_name: str, head_ip: str,
+                 auth_config: Dict[str, Any],
+                 forwards: List[Tuple[int, str, int]],
+                 process_runner=subprocess) -> int:
+    """Start a port-forward tunnel; returns the pid (pidfile-tracked per
+    cluster under tunnel-<name>.pid so it can be stopped later)."""
+    cmd = build_tunnel_command(head_ip, auth_config, forwards)
+    proc = process_runner.Popen(
+        cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True)
+    pid_file = os.path.join(os.path.expanduser(TIK_RUN_DIR),
+                            f"tunnel-{cluster_name}.pid")
+    os.makedirs(os.path.dirname(pid_file), exist_ok=True)
+    with open(pid_file, "w") as f:
+        f.write(str(proc.pid))
+    return proc.pid
+
+
+def stop_tunnel(cluster_name: str) -> bool:
+    pid_file = os.path.join(os.path.expanduser(TIK_RUN_DIR),
+                            f"tunnel-{cluster_name}.pid")
+    try:
+        with open(pid_file) as f:
+            pid = int(f.read().strip())
+        os.kill(pid, signal.SIGTERM)
+    except (OSError, ValueError):
+        return False
+    try:
+        os.unlink(pid_file)
+    except OSError:
+        pass
+    return True
+
+
 def start_proxy(cluster_name: str, head_ip: str,
                 auth_config: Dict[str, Any],
                 port: int = DEFAULT_PROXY_PORT,

@@ -169,6 +169,59 @@ def monitor(config_file):
 
 @cli.command()
 @click.argument("config_file", type=click.Path(exists=True))
+@click.option("--service", "services", multiple=True,
+              help="Runtime service to tunnel (port from its "
+                   "declaration); repeatable.")
+@click.option("--forward", "forwards", multiple=True,
+              help="Explicit local:remote_host:remote_port; repeatable.")
+@click.option("--stop", "stop_", is_flag=True,
+              help="Stop the cluster's tunnel.")
+def tunnel(config_file, services, forwards, stop_):
+    """Port-forward local ports to in-cluster services via the head
+    (reference: cluster tunnel requests / enable-local-proxy)."""
+    from cloudtik_tpu.control import cluster_operator, proxy
+    config = cluster_operator.bootstrap_config(_load(config_file))
+    if stop_:
+        if proxy.stop_tunnel(config["cluster_name"]):
+            cli_logger.success("Tunnel stopped.")
+        else:
+            cli_logger.info("No tunnel running.")
+        return
+    fwd = []
+    for spec in forwards:
+        local, host, remote = spec.split(":")
+        fwd.append((int(local), host, int(remote)))
+    if services:
+        from cloudtik_tpu.runtimes.registry import iter_runtimes
+        declared = {}
+        for runtime in iter_runtimes(config):
+            declared.update(
+                runtime.get_runtime_services(config, "127.0.0.1") or {})
+        for name in services:
+            svc = declared.get(name)
+            if svc is None:
+                raise click.ClickException(
+                    f"unknown service {name!r}; declared: "
+                    f"{sorted(declared)}")
+            fwd.append((svc["port"], "localhost", svc["port"]))
+    if not fwd:
+        raise click.ClickException("nothing to forward "
+                                   "(--service or --forward)")
+    from cloudtik_tpu.providers.factory import create_node_provider
+    provider = create_node_provider(
+        config["provider"], config["cluster_name"])
+    head_id, _ = cluster_operator.head_executor(config, provider)
+    head_ip = provider.external_ip(head_id) \
+        or provider.internal_ip(head_id)
+    pid = proxy.start_tunnel(
+        config["cluster_name"], head_ip, config.get("auth", {}), fwd)
+    for local, host, remote in fwd:
+        cli_logger.info("localhost:{} -> {}:{}", local, host, remote)
+    cli_logger.success("Tunnel running (pid {}).", pid)
+
+
+@cli.command()
+@click.argument("config_file", type=click.Path(exists=True))
 @click.option("--node", "node_id", default=None,
               help="Only this node's logs.")
 @click.option("--grep", default=None, help="Regex filter.")

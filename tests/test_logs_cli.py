@@ -59,3 +59,41 @@ class TestTailClusterLogs:
         _publish(wired, "n1", 1, "/l/a.log", ["late"])
         rest = list(gen)
         assert "n1/a.log: late" in rest
+
+
+class TestTunnelCommand:
+    def test_build_tunnel_command(self):
+        from cloudtik_tpu.control.proxy import build_tunnel_command
+
+        cmd = build_tunnel_command(
+            "10.0.0.2", {"ssh_user": "tik", "ssh_private_key": "/k.pem"},
+            [(8200, "localhost", 8200), (9090, "10.0.0.5", 9090)])
+        assert cmd[0] == "ssh" and cmd[-1] == "tik@10.0.0.2"
+        assert "-L" in cmd
+        assert "8200:localhost:8200" in cmd
+        assert "9090:10.0.0.5:9090" in cmd
+        assert "-i" in cmd
+
+    def test_start_stop_tunnel_pidfile(self, tmp_path, monkeypatch):
+        import os
+
+        monkeypatch.setenv("TIK_HOME", str(tmp_path))
+        from cloudtik_tpu.control import proxy
+
+        class FakeRunner:
+            class Popen:
+                def __init__(self, cmd, **kw):
+                    self.cmd = cmd
+                    self.pid = os.getpid()   # a live pid we may signal
+
+        monkeypatch.setattr(proxy, "TIK_RUN_DIR",
+                            str(tmp_path / "run"))
+        pid = proxy.start_tunnel(
+            "c1", "10.0.0.2", {}, [(8200, "localhost", 8200)],
+            process_runner=FakeRunner)
+        assert pid == os.getpid()
+        pidfile = tmp_path / "run" / "tunnel-c1.pid"
+        assert pidfile.exists()
+        # stop: our own pid ignores SIGTERM? no — use a dead pidfile
+        pidfile.write_text("999999")
+        assert proxy.stop_tunnel("c1") is False
