@@ -62,10 +62,45 @@ class MLflowRuntime(Runtime):
             "mlflow", "server",
             "--host", "0.0.0.0",
             "--port", str(port),
-            "--backend-store-uri", f"sqlite:///{backend_dir}/mlflow.db",
-            "--default-artifact-root", f"{backend_dir}/artifacts",
+            "--backend-store-uri",
+            self.backend_store_uri(node_context, backend_dir),
+            "--default-artifact-root",
+            self.artifact_root(backend_dir),
         ])
         process_runner.wait_for_port("mlflow", int(port), timeout_s=60)
+
+    def backend_store_uri(self, node_context: Dict[str, Any],
+                          backend_dir: str) -> str:
+        """Discovered postgres (HA run store, the reference's production
+        shape) when the cluster runs one; sqlite fallback otherwise."""
+        explicit = self.runtime_config.get("backend_store_uri")
+        if explicit:
+            return explicit
+        state = node_context.get("state_client")
+        if state is not None:
+            try:
+                from cloudtik_tpu.runtimes.discovery.runtime import (
+                    ServiceRegistry)
+                config = node_context.get("config", {})
+                registry = ServiceRegistry(
+                    state, config.get("cluster_name", ""),
+                    config.get("workspace_name", ""))
+                pg = [s for s in registry.query("postgres")
+                      if s.get("tags", {}).get("role") == "primary"] \
+                    or registry.query("postgres")
+                if pg:
+                    return (f"postgresql://tik@{pg[0]['ip']}:"
+                            f"{pg[0]['port']}/mlflow")
+            except Exception:
+                pass
+        return f"sqlite:///{backend_dir}/mlflow.db"
+
+    def artifact_root(self, backend_dir: str) -> str:
+        """Managed cloud storage (mount runtime / workload identity env)
+        when present, local disk otherwise."""
+        return (self.runtime_config.get("artifact_root")
+                or os.environ.get("TIK_CLOUD_STORAGE_URI")
+                or f"{backend_dir}/artifacts")
 
     def get_processes(self) -> Optional[List[Tuple[str, bool, str, str]]]:
         return [("mlflow", True, "MLflow", "head")]

@@ -11,10 +11,46 @@ from typing import Any, Dict
 
 from cloudtik_tpu.runtimes.common.runtime_base import (
     ALL_NODES, ServiceRuntimeBase)
-from cloudtik_tpu.runtimes.trino.runtime import (
-    render_hive_catalog, render_trino_config)
+from cloudtik_tpu.runtimes.trino.runtime import render_hive_catalog
 
 PRESTO_PORT = 8082
+
+
+def render_presto_config(is_coordinator: bool, head_ip: str, *,
+                         port: int = PRESTO_PORT, heap_gb: int = 4,
+                         node_id: str = "node",
+                         environment: str = "tik") -> Dict[str, str]:
+    """etc/ files for a PrestoDB server.  Differs from trino's renderer
+    where the engines diverge: presto keeps the built-in discovery
+    server on the coordinator (discovery-server.enabled + discovery.uri)
+    and the PrestoServer main class in jvm.config."""
+    config = [
+        f"coordinator={'true' if is_coordinator else 'false'}",
+        f"http-server.http.port={port}",
+        f"discovery.uri=http://{head_ip}:{port}",
+        f"query.max-memory={max(heap_gb - 1, 1)}GB",
+        f"query.max-memory-per-node={max(heap_gb // 2, 1)}GB",
+    ]
+    if is_coordinator:
+        config.insert(1, "node-scheduler.include-coordinator=false")
+        config.insert(1, "discovery-server.enabled=true")
+    node = [
+        f"node.environment={environment}",
+        f"node.id={node_id}",
+        "node.data-dir=/tmp/presto-data",
+    ]
+    jvm = [
+        "-server",
+        f"-Xmx{heap_gb}G",
+        "-XX:+UseG1GC",
+        "-XX:+ExplicitGCInvokesConcurrent",
+        "-Djdk.attach.allowAttachSelf=true",
+    ]
+    return {
+        "config.properties": "\n".join(config) + "\n",
+        "node.properties": "\n".join(node) + "\n",
+        "jvm.config": "\n".join(jvm) + "\n",
+    }
 
 
 class PrestoRuntime(ServiceRuntimeBase):
@@ -37,10 +73,21 @@ class PrestoRuntime(ServiceRuntimeBase):
     def node_configure(self, node_context: Dict[str, Any]) -> None:
         import os
         conf_dir = self.conf_dir(node_context)
-        files = render_trino_config(
+        files = render_presto_config(
             bool(node_context.get("is_head")),
             node_context.get("head_ip", ""), port=self.port,
-            heap_gb=int(self.runtime_config.get("heap_gb", 4)))
+            heap_gb=int(self.runtime_config.get("heap_gb", 4)),
+            node_id=node_context.get("node_id", "node"),
+            environment=node_context.get("config", {}).get(
+                "workspace_name", "tik") or "tik")
+        metastore = self.runtime_config.get("metastore_uri")
+        if metastore:
+            # accept thrift://host:port, host:port, or bare host
+            hostport = metastore.split("://", 1)[-1]
+            host, _, port_s = hostport.partition(":")
+            os.makedirs(os.path.join(conf_dir, "catalog"), exist_ok=True)
+            files[os.path.join("catalog", "hive.properties")] = \
+                render_hive_catalog(host, int(port_s or 9083))
         for fname, content in files.items():
             with open(os.path.join(conf_dir, fname), "w") as f:
                 f.write(content)

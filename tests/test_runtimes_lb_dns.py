@@ -305,3 +305,66 @@ class TestPrometheusAlerts:
         doc = _yaml.safe_load(open(prom_yml[0]))
         assert any(p.endswith("alerts.yml")
                    for p in doc.get("rule_files", []))
+
+
+class TestPrestoMLflowDepth:
+    def test_presto_renderer_diverges_from_trino(self, tmp_path):
+        from cloudtik_tpu.runtimes.presto.runtime import (
+            PrestoRuntime, render_presto_config)
+
+        coord = render_presto_config(True, "10.0.0.2", port=8082,
+                                     node_id="head-1", environment="ws")
+        assert "discovery-server.enabled=true" in coord[
+            "config.properties"]
+        assert "discovery.uri=http://10.0.0.2:8082" in coord[
+            "config.properties"]
+        assert "node.id=head-1" in coord["node.properties"]
+        worker = render_presto_config(False, "10.0.0.2")
+        assert "coordinator=false" in worker["config.properties"]
+        assert "discovery-server.enabled" not in worker[
+            "config.properties"]
+
+        rt = PrestoRuntime({"metastore_uri": "thrift://ms:9083"})
+        rt.node_configure({"is_head": True, "head_ip": "10.0.0.2",
+                           "node_id": "h", "conf_dir": str(tmp_path),
+                           "config": {"workspace_name": "ws"}})
+        import glob
+        assert glob.glob(str(tmp_path) + "/**/config.properties",
+                         recursive=True)
+        cats = glob.glob(str(tmp_path) + "/**/hive.properties",
+                         recursive=True)
+        content = open(cats[0]).read()
+        assert "hive.metastore.uri=thrift://ms:9083" in content
+        assert "thrift://thrift" not in content
+
+    def test_mlflow_backend_store_resolution(self):
+        from cloudtik_tpu.control.state import (
+            InMemoryStateBackend, StateClient)
+        from cloudtik_tpu.runtimes.discovery.runtime import ServiceRegistry
+        from cloudtik_tpu.runtimes.mlflow.runtime import MLflowRuntime
+
+        rt = MLflowRuntime({})
+        # no state client -> sqlite fallback
+        assert rt.backend_store_uri({}, "/b").startswith("sqlite:///")
+        # discovered postgres primary wins
+        state = StateClient(InMemoryStateBackend())
+        registry = ServiceRegistry(state, "c", "w")
+        registry.register("postgres", "n1", "10.0.0.9", 5432,
+                          tags={"role": "primary"})
+        ctx = {"state_client": state,
+               "config": {"cluster_name": "c", "workspace_name": "w"}}
+        assert rt.backend_store_uri(ctx, "/b") == \
+            "postgresql://tik@10.0.0.9:5432/mlflow"
+        # explicit config always wins
+        rt2 = MLflowRuntime({"backend_store_uri": "postgresql://x/y"})
+        assert rt2.backend_store_uri(ctx, "/b") == "postgresql://x/y"
+
+    def test_mlflow_artifact_root(self, monkeypatch):
+        from cloudtik_tpu.runtimes.mlflow.runtime import MLflowRuntime
+
+        rt = MLflowRuntime({})
+        assert rt.artifact_root("/b") == "/b/artifacts"
+        monkeypatch.setenv("TIK_CLOUD_STORAGE_URI", "gs://bucket/ml")
+        assert rt.artifact_root("/b") == "gs://bucket/ml"
+        assert MLflowRuntime({"artifact_root": "s3://x"}).artifact_root(
+            "/b") == "s3://x"
