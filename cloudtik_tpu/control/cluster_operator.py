@@ -655,7 +655,6 @@ def tail_cluster_logs(
     node_id: Optional[str] = None,
     grep: Optional[str] = None,
     follow: bool = False,
-    max_batches: int = 200,
     _max_polls: Optional[int] = None,
 ) -> "Iterator[str]":
     """Stream log lines the node log agents published into the head
@@ -673,14 +672,18 @@ def tail_cluster_logs(
     pattern = _re.compile(grep) if grep else None
     try:
         state = _head_state_client(config, provider)
-        seen: set = set()
+        # per-node high-water sequence: bounded state, no duplicate
+        # replay regardless of how much history the table holds (the
+        # log agents prune their own old batches — LogAgent retention)
+        high: Dict[str, int] = {}
         polls = 0
         while True:
             batches = state.table_list(LOG_NS) or {}
             for key in sorted(batches, key=_log_batch_order):
-                if key in seen:
+                node, seq = _log_batch_order(key)
+                if seq <= high.get(node, -1):
                     continue
-                seen.add(key)
+                high[node] = seq
                 batch = batches[key]
                 if node_id and batch.get("node_id") != node_id:
                     continue
@@ -695,9 +698,6 @@ def tail_cluster_logs(
             if _max_polls is not None and polls >= _max_polls:
                 return
             time.sleep(1.0)
-            if len(seen) > max_batches * 10:
-                seen = set(sorted(seen, key=_log_batch_order)
-                           [-max_batches:])
     finally:
         provider.cleanup()
 

@@ -19,6 +19,11 @@ logger = logging.getLogger(__name__)
 
 LOG_NS = "logs"
 MAX_LINES_PER_PUBLISH = 200
+# Each node keeps a bounded window of its own published batches in the
+# head table (consumers tail with per-node high-water marks, so pruning
+# old batches never causes replay — it only caps the table's size and
+# `tik logs`' per-poll transfer).
+RETAINED_BATCHES = 500
 
 
 class LogAgent:
@@ -28,11 +33,13 @@ class LogAgent:
         node_id: str,
         log_dirs: Dict[str, str],
         poll_period_s: float = 2.0,
+        retained_batches: int = RETAINED_BATCHES,
     ):
         self.state = state_client
         self.node_id = node_id
         self.log_dirs = log_dirs              # name -> directory
         self.poll_period_s = poll_period_s
+        self.retained_batches = retained_batches
         self._offsets: Dict[str, int] = {}    # file path -> read offset
         self._stop = threading.Event()
         self._seq = 0
@@ -72,6 +79,11 @@ class LogAgent:
                     })
                     self._seq += 1
                     published += len(batch)
+                    # just published seq-1: retain [seq-retained, seq-1]
+                    old = self._seq - 1 - self.retained_batches
+                    if old >= 0:
+                        self.state.table_delete(
+                            LOG_NS, f"{self.node_id}:{old}")
             except OSError:
                 continue
         return published

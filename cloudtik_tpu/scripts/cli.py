@@ -189,8 +189,17 @@ def tunnel(config_file, services, forwards, stop_):
         return
     fwd = []
     for spec in forwards:
-        local, host, remote = spec.split(":")
-        fwd.append((int(local), host, int(remote)))
+        # local:host:port where host may itself contain colons (IPv6):
+        # local is the first field, the remote port the last
+        local_s, _, rest = spec.partition(":")
+        host, _, remote_s = rest.rpartition(":")
+        try:
+            fwd.append((int(local_s), host or "localhost",
+                        int(remote_s)))
+        except ValueError:
+            raise click.ClickException(
+                f"bad --forward {spec!r}; expected "
+                "local_port:remote_host:remote_port")
     if services:
         from cloudtik_tpu.runtimes.registry import iter_runtimes
         declared = {}

@@ -79,12 +79,24 @@ def get_tokenizer(spec: Optional[str] = None):
 def encode_corpus(text_path: str, out_path: str,
                   tokenizer=None, *, doc_separator: str = "\n\n",
                   chunk_chars: int = 1 << 20) -> int:
-    """Stream a text file into a flat int32 .npy token file (documents
-    separated by EOS).  Returns the token count."""
+    """Stream a text file into a flat int32 token file (documents
+    separated by EOS).  Returns the token count.
+
+    Genuinely streaming: tokens append to disk as they are produced
+    (peak memory is one text chunk + one document's ids), so multi-GB
+    corpora for the large presets prepare in flat memory.  `.bin`
+    outputs are raw int32 (np.memmap-readable); `.npy` outputs are
+    finalized from the streamed data without loading it back whole."""
+    import os
+
     tok = tokenizer or ByteTokenizer()
-    pieces: List[np.ndarray] = []
     total = 0
-    with open(text_path, "r", errors="replace") as f:
+    if not out_path.endswith((".npy", ".bin")):
+        out_path = out_path + ".npy"
+    raw_path = out_path if out_path.endswith(".bin") else \
+        out_path + ".tmp.bin"
+    with open(text_path, "r", errors="replace") as f, \
+            open(raw_path, "wb") as out:
         buffer = ""
         while True:
             chunk = f.read(chunk_chars)
@@ -95,13 +107,15 @@ def encode_corpus(text_path: str, out_path: str,
             for doc in docs:
                 if not doc.strip():
                     continue
-                ids = tok.encode(doc, add_eos=True)
-                pieces.append(np.asarray(ids, np.int32))
+                ids = np.asarray(tok.encode(doc, add_eos=True), np.int32)
+                out.write(ids.tobytes())
                 total += len(ids)
             if done:
                 break
-    tokens = (np.concatenate(pieces) if pieces
-              else np.zeros((0,), np.int32))
-    np.save(out_path if out_path.endswith(".npy")
-            else out_path + ".npy", tokens)
+    if out_path.endswith(".npy"):
+        src = (np.memmap(raw_path, dtype=np.int32, mode="r")
+               if total else np.zeros((0,), np.int32))
+        np.save(out_path, src)       # tofile streams from the memmap
+        del src
+        os.unlink(raw_path)
     return total

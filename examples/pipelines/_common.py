@@ -1,0 +1,16 @@
+"""Shared pipeline helpers."""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_platform(default: str = "cpu") -> None:
+    """Pipelines are host-side workloads: default to CPU so a wedged or
+    absent accelerator tunnel can never hang them (env JAX_PLATFORMS is
+    overridden by TPU-image sitecustomize hooks, so pin via jax.config).
+    TIK_PLATFORM overrides (e.g. TIK_PLATFORM=axon to use the chip)."""
+    import jax
+
+    jax.config.update("jax_platforms",
+                      os.environ.get("TIK_PLATFORM", default))

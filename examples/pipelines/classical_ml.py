@@ -16,16 +16,7 @@ import json
 
 import numpy as np
 
-def _pin_platform(default="cpu"):
-    """Pipelines are host-side workloads: default to CPU so a wedged or
-    absent accelerator tunnel can never hang them (env JAX_PLATFORMS is
-    overridden by TPU-image sitecustomize hooks, so pin via jax.config).
-    TIK_PLATFORM overrides (e.g. TIK_PLATFORM=axon to use the chip)."""
-    import os
-
-    import jax
-    jax.config.update("jax_platforms",
-                      os.environ.get("TIK_PLATFORM", default))
+from _common import pin_platform
 
 
 def synth_frame(n: int, seed: int = 0):
@@ -49,7 +40,7 @@ def main():
     p.add_argument("--depth", type=int, default=6)
     p.add_argument("--out", default="/tmp/tik-gbdt-model.npz")
     args = p.parse_args()
-    _pin_platform()
+    pin_platform()
 
     import jax.numpy as jnp
 

@@ -17,16 +17,7 @@ import json
 
 import numpy as np
 
-def _pin_platform(default="cpu"):
-    """Pipelines are host-side workloads: default to CPU so a wedged or
-    absent accelerator tunnel can never hang them (env JAX_PLATFORMS is
-    overridden by TPU-image sitecustomize hooks, so pin via jax.config).
-    TIK_PLATFORM overrides (e.g. TIK_PLATFORM=axon to use the chip)."""
-    import os
-
-    import jax
-    jax.config.update("jax_platforms",
-                      os.environ.get("TIK_PLATFORM", default))
+from _common import pin_platform
 
 CONDITIONS = {
     0: ["cough", "fever", "congestion", "sore", "throat"],
@@ -66,7 +57,7 @@ def main():
     p.add_argument("--save", default=None,
                    help="write the forest (.npz) for tik-serve --gbdt")
     args = p.parse_args()
-    _pin_platform()
+    pin_platform()
 
     import jax.numpy as jnp
 

@@ -18,16 +18,7 @@ import json
 
 import numpy as np
 
-def _pin_platform(default="cpu"):
-    """Pipelines are host-side workloads: default to CPU so a wedged or
-    absent accelerator tunnel can never hang them (env JAX_PLATFORMS is
-    overridden by TPU-image sitecustomize hooks, so pin via jax.config).
-    TIK_PLATFORM overrides (e.g. TIK_PLATFORM=axon to use the chip)."""
-    import os
-
-    import jax
-    jax.config.update("jax_platforms",
-                      os.environ.get("TIK_PLATFORM", default))
+from _common import pin_platform
 
 
 def synth_transactions(n_accounts: int, n_edges: int, seed: int = 0):
@@ -79,7 +70,7 @@ def main():
     p.add_argument("--embed-steps", type=int, default=60)
     p.add_argument("--trees", type=int, default=60)
     args = p.parse_args()
-    _pin_platform()
+    pin_platform()
 
     import jax
     import jax.numpy as jnp

@@ -97,3 +97,26 @@ class TestTunnelCommand:
         # stop: our own pid ignores SIGTERM? no — use a dead pidfile
         pidfile.write_text("999999")
         assert proxy.stop_tunnel("c1") is False
+
+
+class TestLogRetention:
+    def test_agent_prunes_old_batches(self, tmp_path):
+        import os
+
+        from cloudtik_tpu.control.log_agent import LOG_NS, LogAgent
+        from cloudtik_tpu.control.state import (
+            InMemoryStateBackend, StateClient)
+
+        state = StateClient(InMemoryStateBackend())
+        log_dir = tmp_path / "logs"
+        log_dir.mkdir()
+        agent = LogAgent(state, "n1", {"d": str(log_dir)},
+                         retained_batches=3)
+        f = log_dir / "svc.log"
+        for i in range(8):
+            with open(f, "a") as fh:
+                fh.write(f"line-{i}\n")
+            agent.poll_once()
+        keys = sorted(state.table_list(LOG_NS))
+        assert len(keys) == 3                   # window holds
+        assert keys[-1] == "n1:7"               # newest retained
