@@ -167,6 +167,40 @@ def monitor(config_file):
     click.echo(cluster_operator.monitor_cluster(_load(config_file)))
 
 
+@cli.command(name="enable-local-proxy")
+@click.argument("config_file", type=click.Path(exists=True))
+@click.option("--port", type=int, default=None,
+              help="Local SOCKS5 port (default 6860).")
+def enable_local_proxy(config_file, port):
+    """Start a SOCKS5 proxy through the head so local tools reach
+    in-cluster services (reference: cloudtik enable-local-proxy)."""
+    from cloudtik_tpu.control import cluster_operator, proxy
+    from cloudtik_tpu.providers.factory import create_node_provider
+    config = cluster_operator.bootstrap_config(_load(config_file))
+    provider = create_node_provider(
+        config["provider"], config["cluster_name"])
+    head_id, _ = cluster_operator.head_executor(config, provider)
+    head_ip = provider.external_ip(head_id) \
+        or provider.internal_ip(head_id)
+    pid, bound = proxy.start_proxy(
+        config["cluster_name"], head_ip, config.get("auth", {}),
+        port=port or proxy.DEFAULT_PROXY_PORT)
+    cli_logger.success(
+        "SOCKS5 proxy on localhost:{} (pid {}).", bound, pid)
+
+
+@cli.command(name="disable-local-proxy")
+@click.argument("config_file", type=click.Path(exists=True))
+def disable_local_proxy(config_file):
+    """Stop the cluster's local SOCKS5 proxy."""
+    from cloudtik_tpu.control import cluster_operator, proxy
+    config = cluster_operator.bootstrap_config(_load(config_file))
+    if proxy.stop_proxy(config["cluster_name"]):
+        cli_logger.success("Proxy stopped.")
+    else:
+        cli_logger.info("No proxy running.")
+
+
 @cli.command()
 @click.argument("config_file", type=click.Path(exists=True))
 @click.option("--service", "services", multiple=True,
