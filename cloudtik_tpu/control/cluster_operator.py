@@ -112,7 +112,14 @@ def create_or_update_cluster(
     restart_only: bool = False,
     no_restart: bool = False,
 ) -> Dict[str, Any]:
+    from cloudtik_tpu.utils.event_system import (
+        CreateClusterEvent, global_event_system)
+    global_event_system.execute_callback(
+        CreateClusterEvent.up_started,
+        {"cluster_name": config.get("cluster_name")})
     config = bootstrap_config(config)
+    global_event_system.execute_callback(
+        CreateClusterEvent.cluster_config_validated)
     cluster_name = config["cluster_name"]
     provider = create_node_provider(config["provider"], cluster_name)
     try:
@@ -121,6 +128,9 @@ def create_or_update_cluster(
             no_restart=no_restart)
         cli_logger.success(
             "Cluster {} is up (head: {}).", cluster_name, head_id)
+        global_event_system.execute_callback(
+            CreateClusterEvent.cluster_booting_completed,
+            {"head_node_id": head_id})
         return {"head_node_id": head_id}
     finally:
         provider.cleanup()
@@ -148,6 +158,10 @@ def get_or_create_head_node(
             head_id = None
 
     if head_id is None:
+        from cloudtik_tpu.utils.event_system import (
+            CreateClusterEvent, global_event_system)
+        global_event_system.execute_callback(
+            CreateClusterEvent.acquiring_new_head_node)
         cli_logger.info("Creating new head node...")
         from cloudtik_tpu.utils.log_timer import LogTimer
         with LogTimer(f"head node create ({cluster_name})"):
@@ -166,6 +180,9 @@ def get_or_create_head_node(
                 time.sleep(2)
         if head_id is None:
             raise RuntimeError("head node did not appear after create")
+        global_event_system.execute_callback(
+            CreateClusterEvent.head_node_acquired,
+            {"head_node_id": head_id})
 
     # Config stored on the head for on-head tools + the controller.
     remote_config = provider.prepare_for_head_node(config, dict(config))

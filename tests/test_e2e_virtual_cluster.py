@@ -71,11 +71,23 @@ class TestVirtualClusterEndToEnd:
     def test_start_status_teardown(self, isolated_home, tmp_path):
         state_port = _free_port()
         config = _config(tmp_path, state_port)
+        # lifecycle events fire in order during creation (reference
+        # event_system parity: up_started ... cluster_booting_completed)
+        from cloudtik_tpu.utils.event_system import (
+            CreateClusterEvent, global_event_system)
+        events = []
+        for ev in CreateClusterEvent:
+            global_event_system.add_callback_handler(
+                ev, lambda d: events.append(d["event_name"]))
         try:
             result = cluster_operator.create_or_update_cluster(
                 dict(config))
             head_id = result["head_node_id"]
             assert head_id
+            assert events.index("up_started") \
+                < events.index("acquiring_new_head_node") \
+                < events.index("head_node_acquired") \
+                < events.index("cluster_booting_completed")
 
             # the daemonized `tik node start --head` boots the real
             # state server; cluster info lands in its tables
@@ -108,6 +120,8 @@ class TestVirtualClusterEndToEnd:
                 dict(config), no_restart=True)
             assert result2["head_node_id"] == head_id
         finally:
+            for ev in CreateClusterEvent:
+                global_event_system.clear_callbacks_for_event(ev)
             _kill_node_services(tmp_path)
 
         cluster_operator.teardown_cluster(dict(config), hard=True)
