@@ -82,10 +82,11 @@ class NodeAgent:
         self.process_specs = process_specs or []
         self.heartbeat_period_s = heartbeat_period_s
         self.metrics_period_s = metrics_period_s
-        self.total_resources = total_resources or {
-            "CPU": float(psutil.cpu_count() or 1),
-            "memory": float(psutil.virtual_memory().total),
-        }
+        if total_resources is None:
+            from cloudtik_tpu.utils.resource_spec import (
+                detect_node_resources)
+            total_resources = detect_node_resources()
+        self.total_resources = total_resources
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # TIK_NATIVE_AGENT=1: /proc-reading C++ sampler (SURVEY §2.4 —

@@ -1,0 +1,69 @@
+"""Node resource detection (CPU / memory / TPU).
+
+Reference parity: core/_private/resource_spec.py (ResourceSpec — node
+CPU/GPU/memory detection feeding resource advertisement).  The TPU
+twist: accelerators are detected WITHOUT importing jax — initializing
+the runtime would grab the chip this node is supposed to be serving to
+the training program.  Detection order:
+
+1. `TIK_NODE_RESOURCES` env (JSON) — explicit override, e.g. set by
+   the provider's node bootstrap for pod-slice hosts;
+2. `TPU_CHIPS_PER_HOST_BOUNDS` / `TPU_ACCELERATOR_TYPE` env (set by
+   the TPU VM runtime environment);
+3. /dev/accel* and /dev/vfio device nodes (TPU VMs expose one accel
+   device per chip).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, Optional
+
+import psutil
+
+
+def detect_tpu_chips(dev_root: str = "/dev",
+                     env: Optional[Dict[str, str]] = None) -> int:
+    """Chips on this host, without touching the runtime."""
+    env = dict(os.environ if env is None else env)
+    bounds = env.get("TPU_CHIPS_PER_HOST_BOUNDS")
+    if bounds:
+        try:   # "2,2,1" -> 4
+            dims = [int(x) for x in bounds.split(",")]
+            chips = 1
+            for d in dims:
+                chips *= d
+            return chips
+        except ValueError:
+            pass
+    accel = glob.glob(os.path.join(dev_root, "accel*"))
+    if accel:
+        return len(accel)
+    return 0
+
+
+def detect_node_resources(
+        dev_root: str = "/dev",
+        env: Optional[Dict[str, str]] = None) -> Dict[str, float]:
+    """{"CPU": n, "memory": bytes, "TPU": chips?} for this host."""
+    env = dict(os.environ if env is None else env)
+    override = env.get("TIK_NODE_RESOURCES")
+    if override:
+        try:
+            parsed = json.loads(override)
+            return {str(k): float(v) for k, v in parsed.items()}
+        except (ValueError, TypeError, AttributeError):
+            pass
+    resources: Dict[str, float] = {
+        "CPU": float(psutil.cpu_count() or 1),
+        "memory": float(psutil.virtual_memory().total),
+    }
+    chips = detect_tpu_chips(dev_root, env)
+    if chips:
+        resources["TPU"] = float(chips)
+        accel_type = env.get("TPU_ACCELERATOR_TYPE")
+        if accel_type:
+            resources[f"accelerator_type:{accel_type}"] = 1.0
+    return resources

@@ -174,6 +174,42 @@ class TestStreamingOutput:
         assert "doomed" in str(err.value)
 
 
+class TestResourceSpec:
+    def test_tpu_from_bounds_env(self, tmp_path):
+        from cloudtik_tpu.utils.resource_spec import detect_node_resources
+
+        res = detect_node_resources(
+            dev_root=str(tmp_path),
+            env={"TPU_CHIPS_PER_HOST_BOUNDS": "2,2,1",
+                 "TPU_ACCELERATOR_TYPE": "v5p-16"})
+        assert res["TPU"] == 4.0
+        assert res["accelerator_type:v5p-16"] == 1.0
+        assert res["CPU"] >= 1.0 and res["memory"] > 0
+
+    def test_tpu_from_device_nodes(self, tmp_path):
+        from cloudtik_tpu.utils.resource_spec import detect_tpu_chips
+
+        for i in range(4):
+            (tmp_path / f"accel{i}").touch()
+        assert detect_tpu_chips(str(tmp_path), env={}) == 4
+        assert detect_tpu_chips(str(tmp_path / "nope"), env={}) == 0
+
+    def test_explicit_override_wins(self, tmp_path):
+        from cloudtik_tpu.utils.resource_spec import detect_node_resources
+
+        res = detect_node_resources(
+            dev_root=str(tmp_path),
+            env={"TIK_NODE_RESOURCES":
+                 '{"CPU": 8, "TPU": 4, "memory": 1000}'})
+        assert res == {"CPU": 8.0, "TPU": 4.0, "memory": 1000.0}
+
+    def test_cpu_only_host(self, tmp_path):
+        from cloudtik_tpu.utils.resource_spec import detect_node_resources
+
+        res = detect_node_resources(dev_root=str(tmp_path), env={})
+        assert "TPU" not in res
+
+
 class TestAIDataAPI:
     def test_engine_switch_and_batches(self):
         import pandas as pd
