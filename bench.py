@@ -109,8 +109,26 @@ def main():
         raise TimeoutError("bench watchdog expired (device grant wedged?)")
 
     signal.signal(signal.SIGALRM, _alarm)
-    signal.alarm(int(os.environ.get("TIK_BENCH_TIMEOUT_S", "2700")))
     try:
+        # fast device probe in a SUBPROCESS first: a dead tunnel (the
+        # axon relay can die outright, round-4 observation) hangs
+        # jax.devices() inside native code where SIGALRM can't preempt,
+        # so only a killable child gives a bounded probe.  Fail in
+        # minutes with a clear record instead of consuming the bench
+        # budget.
+        import subprocess
+        probe_s = float(os.environ.get("TIK_BENCH_PROBE_TIMEOUT_S",
+                                       "300"))
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices())"],
+            capture_output=True, text=True, timeout=probe_s)
+        if probe.returncode != 0:
+            raise RuntimeError(
+                f"device probe failed: {probe.stderr[-500:]}")
+        print(f"# devices: {probe.stdout.strip().splitlines()[-1]}",
+              file=sys.stderr)
+        signal.alarm(int(os.environ.get("TIK_BENCH_TIMEOUT_S", "2700")))
         result = run_bench()
         signal.alarm(0)
     except Exception:
