@@ -232,29 +232,34 @@ def get_or_create_head_node(
     return head_id
 
 
-def _reap_local_node_services() -> None:
+def _reap_local_node_services(cluster_name: str) -> None:
     """Hard teardown skips the graceful on-head `node stop`; on providers
     whose "head" shares this filesystem (virtual/local) the daemonized
     services process (`node start --daemonize`, its own session) survives
-    node termination — reap it via the pidfile `node stop` would use."""
+    node termination — reap it via the pidfile `node stop` would use.
+    The pidfile is cluster-scoped, so tearing one cluster down on an
+    operator machine that also runs another local cluster never signals
+    the other cluster's daemon (advisor round-4 medium)."""
     import signal
 
-    from cloudtik_tpu.utils.constants import TIK_RUN_DIR
-    pid_file = os.path.join(os.path.expanduser(TIK_RUN_DIR),
-                            "node-services.pid")
-    if not os.path.exists(pid_file):
-        return
-    try:
-        with open(pid_file) as f:
-            pid = int(f.read().strip())
-        os.kill(pid, signal.SIGTERM)
-        logger.info("reaped local node services (pid %d)", pid)
-    except (ValueError, ProcessLookupError, PermissionError):
-        pass
-    try:
-        os.unlink(pid_file)
-    except OSError:
-        pass
+    from cloudtik_tpu.control.services import node_services_pid_file
+    # legacy fallback: a daemon started by pre-scoping code wrote the
+    # bare name — reap that too (same as `tik node stop`)
+    for pid_file in (node_services_pid_file(cluster_name),
+                     node_services_pid_file(None)):
+        if not os.path.exists(pid_file):
+            continue
+        try:
+            with open(pid_file) as f:
+                pid = int(f.read().strip())
+            os.kill(pid, signal.SIGTERM)
+            logger.info("reaped local node services (pid %d)", pid)
+        except (ValueError, ProcessLookupError, PermissionError):
+            pass
+        try:
+            os.unlink(pid_file)
+        except OSError:
+            pass
 
 
 def teardown_cluster(
@@ -313,7 +318,7 @@ def teardown_cluster(
         if not workers_only and head_id:
             provider.terminate_node(head_id)
             if hard:
-                _reap_local_node_services()
+                _reap_local_node_services(cluster_name)
         cli_logger.success("Cluster {} torn down.", cluster_name)
     finally:
         provider.cleanup()
@@ -682,26 +687,43 @@ def tail_cluster_logs(
     new batches (Ctrl-C to stop)."""
     import re as _re
 
-    from cloudtik_tpu.control.log_agent import LOG_NS
+    from cloudtik_tpu.control.log_agent import LOG_NS, batch_key
     config = bootstrap_config(config)
     provider = create_node_provider(
         config["provider"], config["cluster_name"])
     pattern = _re.compile(grep) if grep else None
     try:
         state = _head_state_client(config, provider)
-        # per-node high-water sequence: bounded state, no duplicate
+        # Per-node high-water sequence: bounded state, no duplicate
         # replay regardless of how much history the table holds (the
-        # log agents prune their own old batches — LogAgent retention)
+        # log agents prune their own old batches — LogAgent retention).
+        # Steady-state polls are RANGED reads (`keys(after=high-water)`
+        # + get of only the new batches): O(new data) over the wire,
+        # not a refetch of every retained batch (round-4 weak #4).
         high: Dict[str, int] = {}
         polls = 0
         while True:
-            batches = state.table_list(LOG_NS) or {}
-            for key in sorted(batches, key=_log_batch_order):
+            if polls % 10 == 0:
+                # names-only listing to discover (new) publisher nodes;
+                # the common path below never lists the whole table
+                for key in state.table_keys(LOG_NS):
+                    high.setdefault(_log_batch_order(key)[0], -1)
+            new_keys: List[str] = []
+            for node in high:
+                after = (batch_key(node, high[node])
+                         if high[node] >= 0 else f"{node}:")
+                new_keys.extend(state.table_keys(
+                    LOG_NS, prefix=f"{node}:", after=after))
+            for key in sorted(new_keys, key=_log_batch_order):
                 node, seq = _log_batch_order(key)
+                # client-side dedup backstop: a legacy unpadded key (or a
+                # server that ignores `after`) must not replay every poll
                 if seq <= high.get(node, -1):
                     continue
                 high[node] = seq
-                batch = batches[key]
+                batch = state.table_get(LOG_NS, key)
+                if batch is None:     # pruned between keys() and get()
+                    continue
                 if node_id and batch.get("node_id") != node_id:
                     continue
                 prefix = (f"{batch.get('node_id', '?')}/"

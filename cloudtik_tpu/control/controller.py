@@ -54,6 +54,7 @@ class ClusterController:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.ticks = 0
+        self.event_retention_ticks = 500
         self.last_error: Optional[str] = None
         if metrics_port:
             self._start_metrics_server(metrics_port)
@@ -170,6 +171,14 @@ class ClusterController:
             self.state.table_put(
                 "events", f"{self.ticks:08d}:{i:03d}",
                 {"time": now, "message": line})
+        if events:
+            # bounded-window retention, same stance as the log agent: a
+            # recurring per-tick event (e.g. a recycle warning) must not
+            # grow the head state store without bound
+            cutoff = f"{max(self.ticks - self.event_retention_ticks, 0):08d}"
+            for key in self.state.table_keys("events"):
+                if key[:8] < cutoff:
+                    self.state.table_delete("events", key)
         summary = self.scaler.summary()
         summary["events"] = events
         self.state.table_put("controller", "status", {

@@ -19,6 +19,14 @@ logger = logging.getLogger(__name__)
 
 LOG_NS = "logs"
 MAX_LINES_PER_PUBLISH = 200
+# Zero-padded so batch keys sort lexicographically == numerically; this
+# is what lets consumers use the state store's ranged key reads
+# (`keys(after=high_water_key)`) instead of refetching the table.
+SEQ_KEY_WIDTH = 12
+
+
+def batch_key(node_id: str, seq: int) -> str:
+    return f"{node_id}:{seq:0{SEQ_KEY_WIDTH}d}"
 # Each node keeps a bounded window of its own published batches in the
 # head table (consumers tail with per-node high-water marks, so pruning
 # old batches never causes replay — it only caps the table's size and
@@ -71,19 +79,20 @@ class LogAgent:
                 lines = chunk.decode(errors="replace").splitlines()
                 for start in range(0, len(lines), MAX_LINES_PER_PUBLISH):
                     batch = lines[start:start + MAX_LINES_PER_PUBLISH]
-                    self.state.table_put(LOG_NS, f"{self.node_id}:{self._seq}", {
-                        "node_id": self.node_id,
-                        "file": path,
-                        "time": time.time(),
-                        "lines": batch,
-                    })
+                    self.state.table_put(
+                        LOG_NS, batch_key(self.node_id, self._seq), {
+                            "node_id": self.node_id,
+                            "file": path,
+                            "time": time.time(),
+                            "lines": batch,
+                        })
                     self._seq += 1
                     published += len(batch)
                     # just published seq-1: retain [seq-retained, seq-1]
                     old = self._seq - 1 - self.retained_batches
                     if old >= 0:
                         self.state.table_delete(
-                            LOG_NS, f"{self.node_id}:{old}")
+                            LOG_NS, batch_key(self.node_id, old))
             except OSError:
                 continue
         return published

@@ -82,14 +82,24 @@ def stop_tunnel(cluster_name: str) -> bool:
     try:
         with open(pid_file) as f:
             pid = int(f.read().strip())
-        os.kill(pid, signal.SIGTERM)
     except (OSError, ValueError):
         return False
     try:
-        os.unlink(pid_file)
+        os.kill(pid, signal.SIGTERM)
+        stopped = True
+    except ProcessLookupError:
+        # already dead: the stale pidfile is the thing to clean up —
+        # leaving it would make every later --stop report a phantom
+        # tunnel (advisor round-4 low)
+        stopped = True
     except OSError:
-        pass
-    return True
+        stopped = False
+    if stopped:
+        try:
+            os.unlink(pid_file)
+        except OSError:
+            pass
+    return stopped
 
 
 def start_proxy(cluster_name: str, head_ip: str,

@@ -50,6 +50,15 @@ def write_bootstrap_config(config: Dict[str, Any],
     return path
 
 
+def node_services_pid_file(cluster_name: Optional[str] = None) -> str:
+    """Pidfile for the daemonized node-services process, scoped per
+    cluster so hard teardown of one cluster can never reap another
+    cluster's daemon sharing this machine (advisor round-4 medium)."""
+    name = (f"node-services-{cluster_name}.pid" if cluster_name
+            else "node-services.pid")
+    return os.path.join(os.path.expanduser(TIK_RUN_DIR), name)
+
+
 def load_bootstrap_config(path: Optional[str] = None) -> Dict[str, Any]:
     if path is None:
         path = _bootstrap_config_path()
@@ -240,8 +249,8 @@ class NodeServicesStarter:
 
         signal.signal(signal.SIGTERM, _handler)
         signal.signal(signal.SIGINT, _handler)
-        pid_file = os.path.join(os.path.expanduser(TIK_RUN_DIR),
-                                "node-services.pid")
+        pid_file = node_services_pid_file(
+            self.config.get("cluster_name"))
         os.makedirs(os.path.dirname(pid_file), exist_ok=True)
         with open(pid_file, "w") as f:
             f.write(str(os.getpid()))

@@ -246,8 +246,7 @@ def greedy_decode(params: Params, features: jax.Array, cfg: RNNTConfig,
     emb = p["embed"].astype(cfg.dtype)
     H = cfg.pred_hidden
 
-    def pred_step(tok, states):
-        x = emb[tok]
+    def stack_step(x, states):
         new_states = []
         for layer, (h, c) in zip(p["layers"], states):
             w = layer["w"].astype(cfg.dtype)
@@ -261,11 +260,18 @@ def greedy_decode(params: Params, features: jax.Array, cfg: RNNTConfig,
             x = h.astype(cfg.dtype)
         return x, new_states
 
-    init_states = [(jnp.zeros((B, H), jnp.float32),
+    def pred_step(tok, states):
+        return stack_step(emb[tok], states)
+
+    zero_states = [(jnp.zeros((B, H), jnp.float32),
                     jnp.zeros((B, H), jnp.float32))
                    for _ in p["layers"]]
+    # Training's predict() feeds the zero SOS input THROUGH the LSTM
+    # stack to produce the U=0 predictor output; seed decode with that
+    # same output (and post-SOS states), not the raw zero vector, so
+    # first-frame joint scores match training.
     sos = jnp.zeros((B, emb.shape[-1]), cfg.dtype)
-    g0, _ = sos, init_states
+    g0, init_states = stack_step(sos, zero_states)
 
     def frame(carry, e_t):
         g, states, out, n = carry

@@ -188,14 +188,20 @@ class Store {
     return nsit->second.erase(key) > 0;
   }
 
+  // `after` is the ranged-read primitive (keys strictly greater than it,
+  // lexicographic): pollers of seq-keyed tables pass their high-water key
+  // and receive only new entries instead of the whole table.
   std::vector<std::string> keys(const std::string& ns,
-                                const std::string& prefix) const {
+                                const std::string& prefix,
+                                const std::string& after) const {
     std::shared_lock lock(mu_);
     std::vector<std::string> out;
     auto nsit = data_.find(ns);
     if (nsit == data_.end()) return out;
-    for (const auto& [k, _] : nsit->second)
-      if (k.rfind(prefix, 0) == 0) out.push_back(k);
+    auto it = after.empty() ? nsit->second.begin()
+                            : nsit->second.upper_bound(after);
+    for (; it != nsit->second.end(); ++it)
+      if (it->first.rfind(prefix, 0) == 0) out.push_back(it->first);
     return out;  // std::map iteration is already sorted
   }
 
@@ -310,7 +316,7 @@ static void serve_connection(int fd, Store* store,
       enc.str("ok"); enc.boolean(true);
       enc.str("deleted"); enc.boolean(store->erase(ns, key));
     } else if (op == "keys") {
-      auto keys = store->keys(ns, str_field("prefix"));
+      auto keys = store->keys(ns, str_field("prefix"), str_field("after"));
       enc.map_header(2);
       enc.str("ok"); enc.boolean(true);
       enc.str("keys");

@@ -39,7 +39,16 @@ class DBFailoverDaemon:
                  promote: Callable[[], None],
                  *, initially_primary: bool = False,
                  cluster_name: str = "", workspace_name: str = "",
-                 ttl_s: float = 15.0):
+                 ttl_s: float = 15.0,
+                 follow: Optional[Callable[[Dict[str, Any]], None]] = None,
+                 follow_poll_s: float = 1.0):
+        """`follow(primary_meta)` (optional) is the replica-side half of a
+        failover: invoked whenever the elected primary CHANGES to another
+        member, so replicas re-point their replication stream (mysql
+        CHANGE REPLICATION SOURCE / redis REPLICAOF / postgres
+        primary_conninfo) at the new primary instead of replicating from
+        a corpse.  Called once per distinct primary; must be idempotent
+        (it also fires for the boot primary the member already follows)."""
         self.service_name = service_name
         self.member_id = member_id
         self.node_ip = node_ip
@@ -50,6 +59,10 @@ class DBFailoverDaemon:
         self._state = state
         self._cluster_name = cluster_name
         self._workspace_name = workspace_name
+        self._follow = follow
+        self._follow_poll_s = follow_poll_s
+        self._followed: Optional[str] = None
+        self._follow_stop = threading.Event()
         self.service = ActiveStandbyService(
             state, f"{service_name}-primary", member_id,
             metadata={"ip": node_ip, "port": port},
@@ -80,8 +93,34 @@ class DBFailoverDaemon:
 
     def start(self, poll_s: float = 0.5) -> None:
         self.service.election.start(poll_s=poll_s)
+        if self._follow is not None:
+            threading.Thread(
+                target=self._follow_loop,
+                name=f"tik-{self.service_name}-follow",
+                daemon=True).start()
+
+    def _follow_loop(self) -> None:
+        while not self._follow_stop.wait(self._follow_poll_s):
+            try:
+                active = self.current_primary()
+                if not active:
+                    continue
+                mid = active.get("member_id")
+                if mid == self.member_id:
+                    # we are (or just became) the primary: nothing to
+                    # follow, but remember it so losing the lease to a
+                    # NEW primary later still triggers follow
+                    self._followed = mid
+                    continue
+                if mid != self._followed:
+                    self._follow(dict(active))
+                    self._followed = mid
+            except Exception:
+                logger.exception("%s: follow re-point failed",
+                                 self.service_name)
 
     def stop(self) -> None:
+        self._follow_stop.set()
         self.service.stop()
 
     @property
@@ -92,9 +131,67 @@ class DBFailoverDaemon:
         return self.service.get_active()
 
 
-def spawn_db_failover(runtime, node_context: Dict[str, Any],
-                      promote: Callable[[], None],
-                      *, ttl_s: float = 15.0) -> Optional[DBFailoverDaemon]:
+class PrimaryWatchDaemon:
+    """For engines with NATIVE elections (mongodb replica sets): the
+    engine picks its own primary, so there is nothing to promote — the
+    cluster's job is to keep the discovery registry's primary record
+    pointed at whatever the engine elected.  Polls `get_primary()` (an
+    engine-specific callable returning {"ip", "port", "member_id"} or
+    None) and re-registers on change."""
+
+    def __init__(self, state, service_name: str,
+                 get_primary: Callable[[], Optional[Dict[str, Any]]],
+                 *, cluster_name: str = "", workspace_name: str = "",
+                 poll_s: float = 2.0):
+        self.service_name = service_name
+        self._get_primary = get_primary
+        self._state = state
+        self._cluster_name = cluster_name
+        self._workspace_name = workspace_name
+        self._poll_s = poll_s
+        self._stop = threading.Event()
+        self._advertised: Optional[str] = None
+
+    def poll_once(self) -> None:
+        primary = self._get_primary()
+        if not primary:
+            return
+        key = f"{primary.get('ip')}:{primary.get('port')}"
+        if key == self._advertised:
+            return
+        from cloudtik_tpu.runtimes.discovery.runtime import ServiceRegistry
+        registry = ServiceRegistry(
+            self._state, self._cluster_name, self._workspace_name)
+        registry.register(
+            self.service_name,
+            str(primary.get("member_id") or primary.get("ip", "")),
+            str(primary.get("ip", "")), int(primary.get("port", 0)),
+            tags={"role": "primary"})
+        logger.info("%s: primary now %s", self.service_name, key)
+        self._advertised = key
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            try:
+                self.poll_once()
+            except Exception:
+                logger.exception("%s: primary watch failed",
+                                 self.service_name)
+
+    def start(self) -> None:
+        threading.Thread(target=self._loop, daemon=True,
+                         name=f"tik-{self.service_name}-watch").start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def spawn_db_failover(
+        runtime, node_context: Dict[str, Any],
+        promote: Callable[[], None],
+        *, ttl_s: float = 15.0,
+        follow: Optional[Callable[[Dict[str, Any]], None]] = None,
+) -> Optional[DBFailoverDaemon]:
     """Shared post-start wiring for DB runtimes: start the daemon when a
     state client is present and `failover` isn't disabled in the
     runtime's config.  Returns the daemon (kept on the runtime so stop
@@ -111,6 +208,7 @@ def spawn_db_failover(runtime, node_context: Dict[str, Any],
         initially_primary=bool(node_context.get("is_head")),
         cluster_name=config.get("cluster_name", ""),
         workspace_name=config.get("workspace_name", ""),
-        ttl_s=float(runtime.runtime_config.get("failover_ttl_s", ttl_s)))
+        ttl_s=float(runtime.runtime_config.get("failover_ttl_s", ttl_s)),
+        follow=follow)
     daemon.start()
     return daemon

@@ -81,3 +81,75 @@ class MongoDBRuntime(ServiceRuntimeBase):
                         if m["name"] != node_context.get("node_id")]
             with open(os.path.join(conf_dir, "initiate.json"), "w") as f:
                 f.write(render_replset_initiate(members, port=self.port))
+
+    def _mongosh(self, script: str) -> str:
+        """Eval a script via mongosh against the local member; "" when
+        the shell is absent (renders stay testable without mongod)."""
+        import os
+        import shutil
+        import subprocess
+        binary = self.find_binary()
+        shell = None
+        if binary is not None:
+            cand = os.path.join(os.path.dirname(binary), "mongosh")
+            if os.access(cand, os.X_OK):
+                shell = cand
+        shell = shell or shutil.which("mongosh")
+        if shell is None:
+            return ""
+        out = subprocess.run(
+            [shell, "--quiet", "--port", str(self.port),
+             "--eval", script], capture_output=True, text=True)
+        return out.stdout
+
+    def query_primary(self) -> "Any":
+        """The replica set's elected primary as {"ip","port","member_id"}
+        (None before the set has one) — mongo's `hello` command
+        (reference: mongodb utils' primary discovery for service
+        registration, runtime/mongodb/utils.py:33)."""
+        out = self._mongosh(
+            "const h = db.hello(); if (h.primary) print(h.primary)")
+        host = out.strip().splitlines()[-1] if out.strip() else ""
+        if ":" not in host:
+            return None
+        ip, _, port = host.rpartition(":")
+        return {"ip": ip, "port": int(port), "member_id": host}
+
+    def post_start(self, node_context: Dict[str, Any]) -> None:
+        """Replica-set lifecycle: the head runs rs.initiate() exactly
+        once (marker-file idempotent); every member then mirrors the
+        set's NATIVE election into the discovery registry via a primary
+        watch — mongod needs no lease-failover daemon because raft-style
+        elections are built in."""
+        import os
+
+        from cloudtik_tpu.runtimes.common.failover import PrimaryWatchDaemon
+
+        conf_dir = self.conf_dir(node_context)
+        if node_context.get("is_head"):
+            marker = os.path.join(conf_dir, ".rs-initiated")
+            initiate = os.path.join(conf_dir, "initiate.json")
+            if not os.path.exists(marker) and os.path.exists(initiate):
+                with open(initiate) as f:
+                    doc = f.read()
+                if self._mongosh(f"rs.initiate({doc})") or \
+                        self.runtime_config.get("assume_initiated"):
+                    with open(marker, "w") as f:
+                        f.write("1")
+
+        state = node_context.get("state_client")
+        if state is None:
+            return
+        config = node_context.get("config", {})
+        self._watch = PrimaryWatchDaemon(
+            state, self.SERVICE_NAME, self.query_primary,
+            cluster_name=config.get("cluster_name", ""),
+            workspace_name=config.get("workspace_name", ""),
+            poll_s=float(self.runtime_config.get("watch_poll_s", 2.0)))
+        self._watch.start()
+
+    def post_stop(self, node_context: Dict[str, Any]) -> None:
+        watch = getattr(self, "_watch", None)
+        if watch is not None:
+            watch.stop()
+            self._watch = None

@@ -36,10 +36,40 @@ def render_my_cnf(server_id: int, port: int = MYSQL_PORT,
         lines += [
             "relay-log = relay-bin",
             "read_only = ON",
+            "super_read_only = ON",
             f"# replicate from {source_ip}:{port} (CHANGE REPLICATION "
-            "SOURCE issued by the services script)",
+            "SOURCE issued at post_start — see replica-setup.sql)",
         ]
     return "\n".join(lines) + "\n"
+
+
+def render_change_source_sql(source_ip: str, port: int = MYSQL_PORT,
+                             user: str = "replicator",
+                             password: str = "") -> str:
+    """GTID auto-position replication re-point (reference: mysql group
+    replication / source-replica setup, runtime/mysql/utils.py:27 — here
+    the CHANGE REPLICATION SOURCE flow with GTID auto-position, which is
+    what makes re-pointing at a promoted source safe without binlog
+    coordinates)."""
+    return (
+        "STOP REPLICA;\n"
+        "CHANGE REPLICATION SOURCE TO\n"
+        f"  SOURCE_HOST='{source_ip}',\n"
+        f"  SOURCE_PORT={port},\n"
+        f"  SOURCE_USER='{user}',\n"
+        f"  SOURCE_PASSWORD='{password}',\n"
+        "  SOURCE_AUTO_POSITION=1;\n"
+        "START REPLICA;\n")
+
+
+def render_promote_sql() -> str:
+    """Replica -> writable source: stop applying, drop replica state,
+    open writes."""
+    return (
+        "STOP REPLICA;\n"
+        "RESET REPLICA ALL;\n"
+        "SET GLOBAL super_read_only = OFF;\n"
+        "SET GLOBAL read_only = OFF;\n")
 
 
 class MySQLRuntime(ServiceRuntimeBase):
@@ -56,14 +86,38 @@ class MySQLRuntime(ServiceRuntimeBase):
         import os
         is_head = bool(node_context.get("is_head"))
         seq = int(node_context.get("seq_id", 0))
+        conf_dir = self.conf_dir(node_context)
         conf = render_my_cnf(
             server_id=seq + 1, port=self.port, is_source=is_head,
             source_ip=node_context.get("head_ip"),
             buffer_pool_mb=int(
                 self.runtime_config.get("buffer_pool_mb", 256)))
-        with open(os.path.join(self.conf_dir(node_context),
-                               "my.cnf"), "w") as f:
+        with open(os.path.join(conf_dir, "my.cnf"), "w") as f:
             f.write(conf)
+        if not is_head:
+            with open(os.path.join(conf_dir,
+                                   "replica-setup.sql"), "w") as f:
+                f.write(render_change_source_sql(
+                    node_context.get("head_ip", ""), port=self.port,
+                    user=self.runtime_config.get(
+                        "replication_user", "replicator"),
+                    password=self.runtime_config.get(
+                        "replication_password", "")))
+
+    def run_sql(self, sql: str) -> None:
+        """Feed SQL to the local server via the mysql client (no-op when
+        the binary is absent — renders stay testable without mysqld)."""
+        import os
+        import subprocess
+        binary = self.find_binary()
+        if binary is None:
+            return
+        client = os.path.join(os.path.dirname(binary), "mysql")
+        if not os.access(client, os.X_OK):
+            return
+        subprocess.run([client, "--port", str(self.port),
+                        "--protocol", "tcp", "-u", "root"],
+                       input=sql.encode(), capture_output=True)
 
     def get_runtime_services(self, cluster_config, cluster_head_ip):
         return {
@@ -73,3 +127,36 @@ class MySQLRuntime(ServiceRuntimeBase):
                               "node_kind": "worker",
                               "tags": {"role": "replica"}},
         }
+
+    def post_start(self, node_context: Dict[str, Any]) -> None:
+        """HA (reference: runtime/mysql replication, utils.py:27): a
+        replica starts its GTID replication stream, campaigns for the
+        source lease, promotes itself when the lease lapses (promote
+        SQL), and re-points CHANGE REPLICATION SOURCE when another
+        member is promoted."""
+        from cloudtik_tpu.runtimes.common.failover import spawn_db_failover
+
+        if not node_context.get("is_head"):
+            self.run_sql(render_change_source_sql(
+                node_context.get("head_ip", ""), port=self.port,
+                user=self.runtime_config.get(
+                    "replication_user", "replicator"),
+                password=self.runtime_config.get(
+                    "replication_password", "")))
+
+        self._failover = spawn_db_failover(
+            self, node_context,
+            promote=lambda: self.run_sql(render_promote_sql()),
+            follow=lambda meta: self.run_sql(render_change_source_sql(
+                str(meta.get("ip", "")),
+                port=int(meta.get("port", self.port)),
+                user=self.runtime_config.get(
+                    "replication_user", "replicator"),
+                password=self.runtime_config.get(
+                    "replication_password", ""))))
+
+    def post_stop(self, node_context: Dict[str, Any]) -> None:
+        daemon = getattr(self, "_failover", None)
+        if daemon is not None:
+            daemon.stop()
+            self._failover = None

@@ -120,7 +120,10 @@ class PostgresRuntime(ServiceRuntimeBase):
     def post_start(self, node_context: Dict[str, Any]) -> None:
         """HA: campaign for the primary lease; on takeover run
         `pg_ctl promote` (reference: postgres HA failover via
-        consul/etcd leader election)."""
+        consul/etcd leader election).  Surviving standbys re-render
+        primary_conninfo at the new primary and signal a conf reload
+        (a returning OLD primary additionally needs pg_rewind before it
+        can rejoin as a standby — documented in docs/operations.md)."""
         from cloudtik_tpu.runtimes.common.failover import spawn_db_failover
 
         def promote():
@@ -136,7 +139,28 @@ class PostgresRuntime(ServiceRuntimeBase):
                 subprocess.run([pg_ctl, "promote", "-D", data_dir],
                                capture_output=True)
 
-        self._failover = spawn_db_failover(self, node_context, promote)
+        def follow(meta):
+            import os
+            import subprocess
+            conf_dir = self.conf_dir(node_context)
+            with open(os.path.join(conf_dir, "standby.conf"), "w") as f:
+                f.write(render_replica_conninfo(
+                    str(meta.get("ip", "")),
+                    port=int(meta.get("port", self.port)),
+                    password=self.runtime_config.get(
+                        "replication_password", "")))
+            binary = self.find_binary()
+            if binary is None:
+                return
+            data_dir = os.path.expanduser(self.runtime_config.get(
+                "data_dir", "~/.tik/postgres/data"))
+            pg_ctl = os.path.join(os.path.dirname(binary), "pg_ctl")
+            if os.access(pg_ctl, os.X_OK):
+                subprocess.run([pg_ctl, "reload", "-D", data_dir],
+                               capture_output=True)
+
+        self._failover = spawn_db_failover(
+            self, node_context, promote, follow=follow)
 
     def post_stop(self, node_context: Dict[str, Any]) -> None:
         daemon = getattr(self, "_failover", None)

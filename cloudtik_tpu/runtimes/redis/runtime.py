@@ -82,28 +82,37 @@ class RedisRuntime(ServiceRuntimeBase):
                               "tags": {"role": "replica"}},
         }
 
+    def run_cli(self, *args: str) -> None:
+        """redis-cli against the local server (no-op when the binary is
+        absent — config renders are still testable without redis)."""
+        import os
+        import subprocess
+        binary = self.find_binary()
+        if binary is None:
+            return
+        cli = os.path.join(os.path.dirname(binary), "redis-cli")
+        if not os.access(cli, os.X_OK):
+            return
+        cmd = [cli, "-p", str(self.port)]
+        password = self.runtime_config.get("password")
+        if password:
+            cmd += ["-a", password]
+        subprocess.run(cmd + list(args), capture_output=True)
+
     def post_start(self, node_context: Dict[str, Any]) -> None:
-        """HA: campaign for the primary lease; a promoted replica runs
-        REPLICAOF NO ONE (reference: redis HA + sentinel-style
-        promotion via leader election)."""
+        """HA: campaign for the primary lease.  A promoted replica runs
+        REPLICAOF NO ONE; surviving replicas re-point REPLICAOF at the
+        new primary (reference: redis HA + sentinel-style promotion via
+        leader election — sentinel's promote + reconfigure roles both
+        ride the lease here)."""
         from cloudtik_tpu.runtimes.common.failover import spawn_db_failover
 
-        def promote():
-            import os
-            import subprocess
-            binary = self.find_binary()
-            if binary is None:
-                return
-            cli = os.path.join(os.path.dirname(binary), "redis-cli")
-            if os.access(cli, os.X_OK):
-                cmd = [cli, "-p", str(self.port)]
-                password = self.runtime_config.get("password")
-                if password:
-                    cmd += ["-a", password]
-                subprocess.run(cmd + ["replicaof", "no", "one"],
-                               capture_output=True)
-
-        self._failover = spawn_db_failover(self, node_context, promote)
+        self._failover = spawn_db_failover(
+            self, node_context,
+            promote=lambda: self.run_cli("replicaof", "no", "one"),
+            follow=lambda meta: self.run_cli(
+                "replicaof", str(meta.get("ip", "")),
+                str(meta.get("port", self.port))))
 
     def post_stop(self, node_context: Dict[str, Any]) -> None:
         daemon = getattr(self, "_failover", None)

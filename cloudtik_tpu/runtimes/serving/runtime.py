@@ -23,8 +23,8 @@ from cloudtik_tpu.runtimes.common.runtime_base import (
 SERVE_PORT = 8200
 
 # live servers must outlive runtime instances (delivery re-creates them
-# per start/stop invocation)
-_servers: Dict[int, Any] = {}
+# per start/stop invocation); keyed by ServiceRuntimeBase.instance_key
+_servers: Dict[Tuple[str, str], Any] = {}
 
 
 class ServingRuntime(ServiceRuntimeBase):
@@ -49,23 +49,22 @@ class ServingRuntime(ServiceRuntimeBase):
         if not self.runs_on(node_context):
             return
         from cloudtik_tpu.serve.server import ServeServer
-        cfg_port = self.port
-        if command == "start" and cfg_port not in _servers:
-            server = ServeServer(self._build_backends(), port=cfg_port)
+        key = self.instance_key(node_context)
+        if command == "start" and key not in _servers:
+            server = ServeServer(self._build_backends(), port=self.port)
             server.start()
-            # the registry is keyed by the CONFIGURED port (0 for an
-            # ephemeral bind): delivery re-creates runtime instances per
-            # invocation, so a stop-time instance only knows the config
-            # value.  Registration temporarily adopts the bound port so
-            # discovery advertises reality, then restores the key.
-            _servers[cfg_port] = server
+            _servers[key] = server
+            # Registration temporarily adopts the BOUND port (the config
+            # may say 0 for an ephemeral bind) so discovery advertises
+            # reality, then restores the configured value.
+            cfg_port = self.port
             self.runtime_config["port"] = server.port
             try:
                 self._register(node_context)
             finally:
                 self.runtime_config["port"] = cfg_port
         elif command == "stop":
-            server = _servers.pop(cfg_port, None)
+            server = _servers.pop(key, None)
             if server is not None:
                 server.stop()
             self._deregister(node_context)

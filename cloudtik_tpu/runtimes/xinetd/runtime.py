@@ -35,8 +35,9 @@ def build_health_server(config: Dict[str, Any], host: str = "0.0.0.0",
 
 # Process-wide server registry: runtime instances are re-created per
 # start/stop invocation (services.py builds runtimes afresh in stop()), so
-# the live server must outlive any one instance.
-_servers: Dict[int, HealthCheckServer] = {}
+# the live server must outlive any one instance; keyed by
+# ServiceRuntimeBase.instance_key.
+_servers: Dict[tuple, HealthCheckServer] = {}
 
 
 class XinetdRuntime(ServiceRuntimeBase):
@@ -48,13 +49,14 @@ class XinetdRuntime(ServiceRuntimeBase):
 
     def node_services(self, node_context: Dict[str, Any],
                       command: str) -> None:
-        if command == "start" and self.port not in _servers:
+        key = self.instance_key(node_context)
+        if command == "start" and key not in _servers:
             server = build_health_server(
-                node_context.get("config", {}), port=self.port)
+                node_context.get("config") or {}, port=self.port)
             server.start()
-            _servers[self.port] = server
+            _servers[key] = server
         elif command == "stop":
-            server = _servers.pop(self.port, None)
+            server = _servers.pop(key, None)
             if server is not None:
                 server.stop()
 

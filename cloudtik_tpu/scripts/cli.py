@@ -677,21 +677,35 @@ def node_start(is_head, node_id, head_ip, daemonize):
 @node.command(name="stop")
 def node_stop():
     """Stop this node's services."""
+    import glob
     import signal
     from cloudtik_tpu.utils.constants import TIK_RUN_DIR
-    pid_file = os.path.join(os.path.expanduser(TIK_RUN_DIR),
-                            "node-services.pid")
-    if not os.path.exists(pid_file):
+    run_dir = os.path.expanduser(TIK_RUN_DIR)
+    # pidfiles are cluster-scoped (node-services-<cluster>.pid); the bare
+    # name is the pre-scoping legacy spelling
+    pid_files = sorted(glob.glob(
+        os.path.join(run_dir, "node-services-*.pid")))
+    legacy = os.path.join(run_dir, "node-services.pid")
+    if os.path.exists(legacy):
+        pid_files.append(legacy)
+    if not pid_files:
         cli_logger.info("No node services running.")
         return
-    with open(pid_file) as f:
-        pid = int(f.read().strip())
-    try:
-        os.kill(pid, signal.SIGTERM)
-        cli_logger.success("Node services (pid {}) stopped.", pid)
-    except ProcessLookupError:
-        cli_logger.info("Process {} already gone.", pid)
-        os.unlink(pid_file)
+    for pid_file in pid_files:
+        try:
+            with open(pid_file) as f:
+                pid = int(f.read().strip())
+        except (OSError, ValueError):
+            continue
+        try:
+            os.kill(pid, signal.SIGTERM)
+            cli_logger.success("Node services (pid {}) stopped.", pid)
+        except ProcessLookupError:
+            cli_logger.info("Process {} already gone.", pid)
+            try:
+                os.unlink(pid_file)
+            except OSError:
+                pass
 
 
 @node.command(name="run")

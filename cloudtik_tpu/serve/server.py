@@ -55,8 +55,10 @@ def transformer_backend(model: str = "tiny",
         from cloudtik_tpu.train.checkpoint import (
             CheckpointConfig, Checkpointer)
         ckpt = Checkpointer(CheckpointConfig(directory=checkpoint_dir))
-        # trainer checkpoints hold {"params": ..., ...} train state
-        restored = ckpt.restore({"params": params})
+        # trainer checkpoints hold the full {"params", "opt_state"} train
+        # state; partial=True rebuilds the opt_state template from the
+        # checkpoint's own metadata so only params come back here
+        restored = ckpt.restore({"params": params}, partial=True)
         params = restored["params"]
         ckpt.close()
 

@@ -55,7 +55,12 @@ class Checkpointer:
             keep_period=config.keep_period or None,
             enable_async_checkpointing=config.async_save,
         )
-        self._manager = ocp.CheckpointManager(path, options=options)
+        # item_handlers lets a FRESH manager (one that never saved) read
+        # item_metadata — without it orbax can't type the "state" item
+        # and partial restores have no template source
+        self._manager = ocp.CheckpointManager(
+            path, options=options,
+            item_handlers={"state": ocp.StandardCheckpointHandler()})
         self._ocp = ocp
 
     # -- save --------------------------------------------------------------
@@ -79,24 +84,61 @@ class Checkpointer:
     def all_steps(self):
         return list(self._manager.all_steps())
 
-    def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
+    def restore(self, state_like: Any, step: Optional[int] = None,
+                partial: bool = False) -> Any:
         """Restore into the sharding/structure of `state_like`.
 
         `state_like` may be a live pytree of (possibly sharded) arrays or a
         pytree of jax.ShapeDtypeStruct with `.sharding` set; each host loads
         only its local shards.
+
+        With `partial=True`, `state_like` may name only some subtrees of
+        the saved state (e.g. {"params": ...} out of a trainer's
+        {"params", "opt_state"}): ONLY the named subtrees are read and
+        materialized — the opt_state of a big model never touches memory
+        — which is what lets `tik-serve --checkpoint-dir` load weights
+        out of a full train-state checkpoint on a host sized for params
+        alone.
         """
         step = step if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.config.directory}")
         abstract = jax.tree.map(_as_abstract, state_like)
+        if partial:
+            return self._restore_partial(abstract, step)
         restored = self._manager.restore(
             step,
             args=self._ocp.args.Composite(
                 state=self._ocp.args.StandardRestore(abstract)),
         )
         return restored["state"]
+
+    def _restore_partial(self, abstract: Any, step: int) -> Any:
+        """Subtree restore via PyTreeRestore(partial_restore=True) against
+        the step's item directory (StandardSave writes the same on-disk
+        PyTree layout, so the PyTree handler reads it directly)."""
+        ocp = self._ocp
+        path = os.path.join(str(self._manager.directory), str(step),
+                            "state")
+
+        def _restore_arg(x):
+            sharding = getattr(x, "sharding", None)
+            if sharding is not None:
+                return ocp.ArrayRestoreArgs(
+                    sharding=sharding, global_shape=x.shape, dtype=x.dtype)
+            return ocp.RestoreArgs()
+
+        ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+        try:
+            return ckptr.restore(
+                path,
+                args=ocp.args.PyTreeRestore(
+                    item=abstract,
+                    restore_args=jax.tree.map(_restore_arg, abstract),
+                    partial_restore=True))
+        finally:
+            ckptr.close()
 
     def close(self) -> None:
         self._manager.close()

@@ -74,3 +74,30 @@ def test_restore_missing_raises(tmp_path):
     ckpt = Checkpointer(CheckpointConfig(directory=str(tmp_path / "none")))
     with pytest.raises(FileNotFoundError):
         ckpt.restore({"x": jnp.zeros((2,))})
+
+
+def test_partial_restore_params_only(tmp_path):
+    """Serving loads params out of a full {params, opt_state} checkpoint:
+    partial=True must rebuild the opt_state template from checkpoint
+    metadata instead of raising orbax's tree-structure mismatch."""
+    cfg, trainer = tiny_trainer(tmp_path)
+    data = synthetic_lm_batches(8, 32, cfg.vocab_size)
+    trainer.fit(data, num_steps=2)
+    trainer.checkpointer.wait()
+    expect = jax.device_get(trainer.state["params"])
+
+    ckpt = Checkpointer(CheckpointConfig(
+        directory=str(tmp_path / "ckpt")))
+    template = jax.eval_shape(
+        lambda: trainer.spec.init(jax.random.PRNGKey(0)))
+    restored = ckpt.restore({"params": template}, partial=True)
+    ckpt.close()
+    assert set(restored) == {"params"}
+    jax.tree.map(np.testing.assert_array_equal,
+                 expect, jax.device_get(restored["params"]))
+
+    # without partial=True the mismatch is still an error (not silent)
+    ckpt2 = Checkpointer(CheckpointConfig(directory=str(tmp_path / "ckpt")))
+    with pytest.raises(Exception):
+        ckpt2.restore({"params": template})
+    ckpt2.close()

@@ -62,6 +62,50 @@ class TestDBFailover:
         assert by_node["node-b"]["tags"]["role"] == "primary"
         standby.stop()
 
+    def test_replicas_follow_new_primary(self):
+        """The replica-side half of a failover: when the primary changes,
+        surviving replicas re-point their replication stream (REPLICAOF /
+        CHANGE REPLICATION SOURCE) at the new primary."""
+        state = StateClient(InMemoryStateBackend())
+        followed = {"b": [], "c": []}
+
+        primary = DBFailoverDaemon(
+            state, "mysql", "node-a", "10.0.0.1", 3306,
+            promote=lambda: None, initially_primary=True,
+            cluster_name="c1", ttl_s=1.0)
+        standby_b = DBFailoverDaemon(
+            state, "mysql", "node-b", "10.0.0.2", 3306,
+            promote=lambda: None, initially_primary=False,
+            cluster_name="c1", ttl_s=1.0,
+            follow=lambda meta: followed["b"].append(meta["ip"]),
+            follow_poll_s=0.05)
+        standby_c = DBFailoverDaemon(
+            state, "mysql", "node-c", "10.0.0.3", 3306,
+            promote=lambda: None, initially_primary=False,
+            cluster_name="c1", ttl_s=1.0,
+            follow=lambda meta: followed["c"].append(meta["ip"]),
+            follow_poll_s=0.05)
+
+        primary.start(poll_s=0.05)
+        assert _wait(lambda: primary.is_primary)
+        standby_b.start(poll_s=0.05)
+        standby_c.start(poll_s=0.05)
+        # boot: both replicas observe (and idempotently re-follow) a
+        assert _wait(lambda: followed["b"] == ["10.0.0.1"]
+                     and followed["c"] == ["10.0.0.1"])
+
+        primary.stop()
+        assert _wait(lambda: standby_b.is_primary or standby_c.is_primary)
+        winner, loser = (("b", "c") if standby_b.is_primary else ("c", "b"))
+        winner_ip = {"b": "10.0.0.2", "c": "10.0.0.3"}[winner]
+        # the surviving replica re-points at the new primary...
+        assert _wait(lambda: followed[loser][-1] == winner_ip)
+        # ...and the new primary never follows itself
+        time.sleep(0.3)
+        assert winner_ip not in followed[winner]
+        standby_b.stop()
+        standby_c.stop()
+
     def test_failover_disabled_by_config(self):
         from cloudtik_tpu.runtimes.common.failover import spawn_db_failover
 
@@ -84,3 +128,169 @@ class TestDBFailover:
             port = 6379
 
         assert spawn_db_failover(FakeRuntime(), {}, lambda: None) is None
+
+
+def _node_context(state, node_id, ip, *, is_head, tmp_path):
+    return {
+        "is_head": is_head, "node_id": node_id, "node_ip": ip,
+        "head_ip": "10.0.0.1", "state_client": state,
+        "config": {"cluster_name": "c1", "workspace_name": "w1"},
+        "conf_dir": str(tmp_path / node_id),
+    }
+
+
+class TestMySQLFailover:
+    """Kill-the-primary on the real MySQLRuntime: the promoted replica
+    issues the promote SQL; the survivor re-points CHANGE REPLICATION
+    SOURCE at the new source (reference: runtime/mysql/utils.py:27)."""
+
+    def _runtime(self, monkeypatch, sql_log):
+        from cloudtik_tpu.runtimes.mysql.runtime import MySQLRuntime
+        rt = MySQLRuntime({"failover_ttl_s": 1.0})
+        monkeypatch.setattr(rt, "run_sql",
+                            lambda sql: sql_log.append(sql))
+        return rt
+
+    def test_promote_and_repoint(self, monkeypatch, tmp_path):
+        state = StateClient(InMemoryStateBackend())
+        logs = {"a": [], "b": [], "c": []}
+        rts = {}
+        for name, is_head, ip in (("a", True, "10.0.0.1"),
+                                  ("b", False, "10.0.0.2"),
+                                  ("c", False, "10.0.0.3")):
+            rt = self._runtime(monkeypatch, logs[name])
+            rt.post_start(_node_context(
+                state, f"node-{name}", ip, is_head=is_head,
+                tmp_path=tmp_path))
+            rt._failover._follow_poll_s = 0.05
+            rts[name] = rt
+
+        # boot: replicas started their GTID stream at the head
+        assert any("SOURCE_HOST='10.0.0.1'" in s for s in logs["b"])
+        assert _wait(lambda: rts["a"]._failover.is_primary)
+
+        rts["a"]._failover.stop()
+        assert _wait(lambda: rts["b"]._failover.is_primary
+                     or rts["c"]._failover.is_primary)
+        winner = "b" if rts["b"]._failover.is_primary else "c"
+        loser = "c" if winner == "b" else "b"
+        winner_ip = {"b": "10.0.0.2", "c": "10.0.0.3"}[winner]
+        assert _wait(lambda: any(
+            "SET GLOBAL read_only = OFF" in s for s in logs[winner]))
+        assert _wait(lambda: any(
+            f"SOURCE_HOST='{winner_ip}'" in s for s in logs[loser]))
+        for rt in rts.values():
+            rt.post_stop({})
+
+    def test_renders(self, tmp_path):
+        from cloudtik_tpu.runtimes.mysql.runtime import (
+            render_change_source_sql, render_promote_sql)
+        sql = render_change_source_sql("10.0.0.9", port=3307,
+                                       user="rep", password="pw")
+        assert "SOURCE_HOST='10.0.0.9'" in sql
+        assert "SOURCE_PORT=3307" in sql
+        assert "SOURCE_AUTO_POSITION=1" in sql
+        assert "START REPLICA" in sql
+        promote = render_promote_sql()
+        assert "RESET REPLICA ALL" in promote
+        assert "super_read_only = OFF" in promote
+
+    def test_replica_setup_sql_rendered(self, tmp_path):
+        from cloudtik_tpu.runtimes.mysql.runtime import MySQLRuntime
+        rt = MySQLRuntime({"replication_user": "rep"})
+        ctx = _node_context(StateClient(InMemoryStateBackend()),
+                            "node-b", "10.0.0.2", is_head=False,
+                            tmp_path=tmp_path)
+        ctx["seq_id"] = 3
+        rt.node_configure(ctx)
+        conf = (tmp_path / "node-b" / "my.cnf").read_text()
+        assert "server-id = 4" in conf and "read_only = ON" in conf
+        setup = (tmp_path / "node-b" / "replica-setup.sql").read_text()
+        assert "SOURCE_HOST='10.0.0.1'" in setup
+        assert "SOURCE_USER='rep'" in setup
+
+
+class TestRedisFailover:
+    """Kill-the-primary on the real RedisRuntime: promotion runs
+    REPLICAOF NO ONE; the survivor re-points REPLICAOF (reference:
+    runtime/redis/utils.py:23 sentinel-style promotion)."""
+
+    def test_promote_and_repoint(self, monkeypatch, tmp_path):
+        from cloudtik_tpu.runtimes.redis.runtime import RedisRuntime
+        state = StateClient(InMemoryStateBackend())
+        logs = {"a": [], "b": [], "c": []}
+        rts = {}
+        for name, is_head, ip in (("a", True, "10.0.0.1"),
+                                  ("b", False, "10.0.0.2"),
+                                  ("c", False, "10.0.0.3")):
+            rt = RedisRuntime({"failover_ttl_s": 1.0})
+            log = logs[name]
+            monkeypatch.setattr(
+                rt, "run_cli", lambda *a, _log=log: _log.append(a))
+            rt.post_start(_node_context(
+                state, f"node-{name}", ip, is_head=is_head,
+                tmp_path=tmp_path))
+            rt._failover._follow_poll_s = 0.05
+            rts[name] = rt
+
+        assert _wait(lambda: rts["a"]._failover.is_primary)
+        rts["a"]._failover.stop()
+        assert _wait(lambda: rts["b"]._failover.is_primary
+                     or rts["c"]._failover.is_primary)
+        winner = "b" if rts["b"]._failover.is_primary else "c"
+        loser = "c" if winner == "b" else "b"
+        winner_ip = {"b": "10.0.0.2", "c": "10.0.0.3"}[winner]
+        assert _wait(lambda: ("replicaof", "no", "one") in logs[winner])
+        assert _wait(lambda: any(
+            a[:2] == ("replicaof", winner_ip) for a in logs[loser]))
+        for rt in rts.values():
+            rt.post_stop({})
+
+
+class TestMongoDBPrimaryWatch:
+    """MongoDB elects natively; the runtime mirrors the set's primary
+    into discovery (reference: runtime/mongodb/utils.py:33 replica-set
+    member config + primary discovery)."""
+
+    def test_watch_follows_election(self):
+        from cloudtik_tpu.runtimes.common.failover import PrimaryWatchDaemon
+        state = StateClient(InMemoryStateBackend())
+        primary = {"now": {"ip": "10.0.0.1", "port": 27017,
+                           "member_id": "10.0.0.1:27017"}}
+        watch = PrimaryWatchDaemon(
+            state, "mongodb", lambda: primary["now"],
+            cluster_name="c1", workspace_name="w1")
+        watch.poll_once()
+        registry = ServiceRegistry(state, "c1", "w1")
+        rec = registry.query("mongodb")
+        assert rec and rec[0]["ip"] == "10.0.0.1"
+
+        # the set elects a new primary -> registry follows
+        primary["now"] = {"ip": "10.0.0.2", "port": 27017,
+                          "member_id": "10.0.0.2:27017"}
+        watch.poll_once()
+        by_node = {s["node_id"]: s for s in registry.query("mongodb")}
+        assert by_node["10.0.0.2:27017"]["tags"]["role"] == "primary"
+
+    def test_initiate_idempotent(self, monkeypatch, tmp_path):
+        from cloudtik_tpu.runtimes.mongodb.runtime import MongoDBRuntime
+        state = StateClient(InMemoryStateBackend())
+        rt = MongoDBRuntime({"assume_initiated": True})
+        calls = []
+        monkeypatch.setattr(
+            rt, "_mongosh", lambda script: calls.append(script) or "ok")
+        ctx = _node_context(state, "head", "10.0.0.1", is_head=True,
+                            tmp_path=tmp_path)
+        rt.node_configure(ctx)
+        rt.post_start(ctx)
+        rt.post_stop(ctx)
+        initiates = [c for c in calls if c.startswith("rs.initiate")]
+        assert len(initiates) == 1
+        # marker prevents a second initiate on restart
+        rt2 = MongoDBRuntime({"assume_initiated": True})
+        calls2 = []
+        monkeypatch.setattr(
+            rt2, "_mongosh", lambda script: calls2.append(script) or "ok")
+        rt2.post_start(ctx)
+        rt2.post_stop(ctx)
+        assert not [c for c in calls2 if c.startswith("rs.initiate")]

@@ -57,9 +57,9 @@ def _config(tmp_path, state_port):
 
 
 def _kill_node_services(home):
-    pid_file = os.path.join(str(home), ".tik", "run",
-                            "node-services.pid")
-    if os.path.exists(pid_file):
+    import glob
+    run_dir = os.path.join(str(home), ".tik", "run")
+    for pid_file in glob.glob(os.path.join(run_dir, "node-services*.pid")):
         try:
             with open(pid_file) as f:
                 os.kill(int(f.read().strip()), signal.SIGTERM)

@@ -94,6 +94,26 @@ class TestRNNT:
         flat, _ = jax.tree_util.tree_flatten(g)
         assert all(np.isfinite(np.asarray(x)).all() for x in flat)
 
+    def test_decode_first_frame_matches_training_lattice(self):
+        """Decode must seed the joint with the predictor's LSTM output on
+        the SOS input — the same U=0 state training's predict() builds —
+        not a raw zero vector (advisor round-4 low)."""
+        cfg = N.config("tiny")
+        params = N.init_params(jax.random.PRNGKey(3), cfg)
+        batch = next(iter_n(synthetic_speech_batches(
+            3, 8, cfg.feature_dim, cfg.vocab_size, max_labels=4)))
+        feats = jnp.asarray(batch["features"])
+        enc = N.encode(params, feats, cfg)
+        pred0 = N.predict(
+            params, jnp.zeros((3, 0), jnp.int32), cfg)     # [B, 1, H]
+        lattice = N.joint(params, enc[:, :1], pred0, cfg)  # [B,1,1,V]
+        tok0 = np.asarray(lattice.argmax(-1))[:, 0, 0]
+        hyp = np.asarray(N.greedy_decode(params, feats, cfg,
+                                         max_symbols=6))
+        for b in range(3):
+            if tok0[b] != 0:   # frame 0 emits: decode's first symbol
+                assert hyp[b, 0] == tok0[b]
+
 
 # -------------------------------------------------------------------------
 # SSD

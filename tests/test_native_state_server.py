@@ -63,6 +63,18 @@ class TestWireCompatibility:
         keys = client.kv_keys(prefix="svc:")
         assert keys == ["svc:a", "svc:b"]
 
+    def test_ranged_keys_after(self, client):
+        """`keys(after=...)` — the ranged-read primitive `tik logs -f`
+        polls with — must match the Python backend's semantics."""
+        for seq in range(4):
+            client.table_put("rlogs", f"n1:{seq:012d}", {"s": seq})
+        client.table_put("rlogs", "n2:000000000000", {"s": 0})
+        got = client.table_keys("rlogs", prefix="n1:",
+                                after="n1:000000000001")
+        assert got == ["n1:000000000002", "n1:000000000003"]
+        # empty after = all keys (backwards-compatible default)
+        assert len(client.table_keys("rlogs")) == 5
+
     def test_binary_values(self, client):
         blob = bytes(range(256)) * 300  # > bin8, exercises bin16
         client.kv_put("blob", blob)
