@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# Build the tik image stack: tik-base -> tik-deps -> tik -> tik-runtime.
+#
+# Reference parity: build-docker.sh at the reference root (cloudtik-base /
+# cloudtik-deps / cloudtik layering).  The final `tik:<tag>` image is what
+# the helm chart deploys by default
+# (tools/kubernetes/helm/tik-operator/values.yaml image.repository=tik).
+#
+# Usage:
+#   ./build-docker.sh [--tag TAG] [--device tpu|cpu] [--base-image IMG]
+#                     [--runtimes "name ..."] [--skip-runtime-image]
+set -euo pipefail
+
+SCRIPT_DIR="$(cd -- "$(dirname -- "${BASH_SOURCE[0]}")" &>/dev/null && pwd)"
+cd "${SCRIPT_DIR}"
+
+IMAGE_TAG="latest"
+DEVICE="tpu"
+BASE_IMAGE="ubuntu:22.04"
+RUNTIMES="prometheus nodex"
+BUILD_RUNTIME_IMAGE=1
+
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --tag)        IMAGE_TAG="$2"; shift 2 ;;
+    --device)     DEVICE="$2"; shift 2 ;;
+    --base-image) BASE_IMAGE="$2"; shift 2 ;;
+    --runtimes)   RUNTIMES="$2"; shift 2 ;;
+    --skip-runtime-image) BUILD_RUNTIME_IMAGE=0; shift ;;
+    *) echo "unknown option: $1" >&2; exit 2 ;;
+  esac
+done
+
+echo "== building wheel =="
+rm -rf docker/.build
+mkdir -p docker/.build
+python -m pip wheel . --no-deps --no-build-isolation -w docker/.build
+
+echo "== tik-base:${IMAGE_TAG} =="
+docker build -t "tik-base:${IMAGE_TAG}" \
+  --build-arg "BASE_IMAGE=${BASE_IMAGE}" \
+  docker/tik-base
+
+echo "== tik-deps:${IMAGE_TAG} (device=${DEVICE}) =="
+docker build -t "tik-deps:${IMAGE_TAG}" \
+  --build-arg "IMAGE_TAG=${IMAGE_TAG}" \
+  --build-arg "DEVICE=${DEVICE}" \
+  docker/tik-deps
+
+echo "== tik:${IMAGE_TAG} =="
+# wheel is COPY'd from docker/.build, so the build context is docker/
+cp -r docker/.build docker/tik/.build
+docker build -t "tik:${IMAGE_TAG}" \
+  --build-arg "IMAGE_TAG=${IMAGE_TAG}" \
+  docker/tik
+rm -rf docker/tik/.build
+
+if [[ "${BUILD_RUNTIME_IMAGE}" == "1" ]]; then
+  echo "== tik-runtime:${IMAGE_TAG} (runtimes: ${RUNTIMES}) =="
+  docker build -t "tik-runtime:${IMAGE_TAG}" \
+    --build-arg "IMAGE_TAG=${IMAGE_TAG}" \
+    --build-arg "RUNTIMES=${RUNTIMES}" \
+    docker/tik-runtime
+fi
+
+BUILT="tik-base tik-deps tik"
+if [[ "${BUILD_RUNTIME_IMAGE}" == "1" ]]; then
+  BUILT="${BUILT} tik-runtime"
+fi
+echo "done: ${BUILT} tagged :${IMAGE_TAG}"

@@ -65,6 +65,9 @@ class TransformerConfig:
     # the pipe-axis stage schedule; 0 = auto (the pipe axis size).  Only
     # consulted when the ambient mesh has pipe > 1.
     pipeline_microbatches: int = 0
+    # "gpipe" (autodiff; simplest) or "1f1b" (custom-vjp recompute
+    # schedule with the 1F1B activation footprint — use at pipe >= 4)
+    pipeline_schedule: str = "gpipe"
 
     @property
     def head_dim(self) -> int:
@@ -368,7 +371,8 @@ def hidden_states(
         result = pipeline_apply(
             stage, params["layers"], x,
             n_microbatches=n_micro,
-            extras=positions, aux_init=aux_init)
+            extras=positions, aux_init=aux_init,
+            schedule=cfg.pipeline_schedule)
         if cfg.is_moe:
             x, aux_sum = result
             # summed over layers and microbatches -> mean over both,

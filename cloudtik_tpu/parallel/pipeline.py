@@ -14,6 +14,26 @@ partial-auto mode (`axis_names={"pipe"}`).
 
 Bubble fraction is the GPipe (P-1)/(M+P-1); pick n_microbatches a few
 multiples of the stage count to amortize.
+
+Two schedules (round-4 verdict item 4):
+
+* "gpipe" — autodiff through the forward scan.  Simple and fully
+  differentiable (extras included), but the scan saves every tick's
+  stage residuals, so activation memory grows with M + P - 1 ticks
+  times the per-stage layer slice: fine at pipe=2, prohibitive at
+  pipe>=4 on the 70B/405B presets.
+* "1f1b" — custom-vjp schedule with the 1F1B activation footprint: the
+  forward saves ONLY each microbatch's stage-boundary input (one
+  activation per microbatch per stage, in compute dtype); the backward
+  is a hand-written reverse pipeline that recomputes one stage slice at
+  a time (jax.vjp per tick) and ppermutes cotangents upstream.  Peak
+  activation memory drops from O(ticks * layers/stage) residuals to
+  O(M) boundaries + one live recompute window.  The pipeline bubble is
+  the same (P-1)/(M+P-1) as GPipe — that is true of non-interleaved
+  1F1B in general; the schedule's win is memory, which is what lets M
+  grow (and the relative bubble shrink) at deep pipe.  Limitation:
+  `extras` receive no cotangents under "1f1b" (they ride as data —
+  positions are integers everywhere this is used today).
 """
 
 from __future__ import annotations
@@ -42,6 +62,7 @@ def pipeline_apply(
     extras: Any = None,
     aux_init: Any = None,
     axis: str = "pipe",
+    schedule: str = "gpipe",
 ):
     """Apply a pipe-sharded layer stack to x with a GPipe schedule.
 
@@ -60,9 +81,15 @@ def pipeline_apply(
     return becomes (y, aux_sum) where aux_sum is summed over stages AND
     microbatches (divide by layers * microbatches for a mean).
 
+    schedule: "gpipe" (autodiff through the scan) or "1f1b" (custom-vjp
+    recompute schedule with the 1F1B activation footprint — see module
+    docstring for the trade).
+
     With no `pipe` axis on the mesh (or size 1) this reduces to running
     all layers locally — same code, any mesh.
     """
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     n_stages = pipe_axis_size(axis)
     M = n_microbatches
     B = x.shape[0]
@@ -84,9 +111,13 @@ def pipeline_apply(
     aux_zero = jax.tree.map(
         lambda a: jnp.zeros((), jnp.float32), aux_init)
 
-    inner = functools.partial(
-        _staged, stage_fn, n_stages=n_stages, n_micro=M, axis=axis,
-        dtype=x.dtype, with_aux=with_aux)
+    if schedule == "1f1b":
+        inner = _make_1f1b(stage_fn, n_stages=n_stages, n_micro=M,
+                           axis=axis, dtype=x.dtype, with_aux=with_aux)
+    else:
+        inner = functools.partial(
+            _staged, stage_fn, n_stages=n_stages, n_micro=M, axis=axis,
+            dtype=x.dtype, with_aux=with_aux)
     # Manual over `pipe` only: params enter stage-sliced on the stacked
     # layer dim; activations replicated across pipe (other axes stay auto).
     out, aux = jax.shard_map(
@@ -173,3 +204,170 @@ def _staged(stage_fn, params_local, xs, extras_s, aux_zero, *, n_stages,
             jnp.where(idx == n_stages - 1, total, 0.0), axis),
         aux_total)
     return out, aux
+
+
+# ---------------------------------------------------------------------------
+# 1F1B schedule (custom-vjp recompute pipeline)
+# ---------------------------------------------------------------------------
+
+def _ct_zero(e):
+    """Cotangent zero for a non-differentiated rider (int extras)."""
+    import numpy as np
+    if jnp.issubdtype(e.dtype, jnp.inexact):
+        return jnp.zeros_like(e)
+    return np.zeros(e.shape, jax.dtypes.float0)
+
+
+def _make_1f1b(stage_fn, *, n_stages, n_micro, axis, dtype, with_aux):
+    """Build the per-pipe-group body with the 1F1B memory profile.
+
+    Runs INSIDE the shard_map region (manual over `axis`).  Forward: same
+    M + P - 1 tick loop as GPipe, but under custom_vjp so the scan is
+    never differentiated — the only residuals kept are each stage's
+    per-microbatch INPUT boundary activation (`saved`, [M, ...] in
+    compute dtype).  Backward: a reverse pipeline of the same length;
+    each tick recomputes one stage slice via jax.vjp from the saved
+    boundary (one live recompute window) and ppermutes input cotangents
+    to the upstream stage; parameter cotangents accumulate locally
+    (each stage owns its layer slice).  The stage-0 input cotangents are
+    emitted with zeros elsewhere — the shard_map transpose's psum over
+    `axis` for the replicated boundary then yields the global value,
+    exactly as in the GPipe path (and in f32, for the same partitioner
+    reason)."""
+    M = n_micro
+    perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    perm_bwd = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+    def _forward(params_local, xs_f32, extras_s, aux_zero):
+        xs = xs_f32.astype(dtype)
+        idx = lax.axis_index(axis)
+
+        def tick(carry, t):
+            state, outputs, saved, aux_tot = carry
+            m = t - idx                      # microbatch at this stage
+            valid = (m >= 0) & (m < M)
+            mslot = jnp.clip(m, 0, M - 1)
+            x_in = jnp.where(
+                idx == 0,
+                lax.dynamic_index_in_dim(xs, jnp.clip(t, 0, M - 1), 0,
+                                         keepdims=False),
+                state)
+            e_in = jax.tree.map(
+                lambda e: lax.dynamic_index_in_dim(e, mslot, 0,
+                                                   keepdims=False),
+                extras_s)
+            prev = lax.dynamic_index_in_dim(saved, mslot, 0,
+                                            keepdims=False)
+            saved = lax.dynamic_update_index_in_dim(
+                saved, jnp.where(valid, x_in, prev), mslot, 0)
+            if with_aux:
+                y, aux_local = stage_fn(params_local, x_in, e_in)
+                aux_tot = jax.tree.map(
+                    lambda tot, a: tot + jnp.where(
+                        valid, a.astype(jnp.float32), 0.0),
+                    aux_tot, aux_local)
+            else:
+                y = stage_fn(params_local, x_in, e_in)
+            emit = (idx == n_stages - 1) & valid
+            cur = lax.dynamic_index_in_dim(outputs, mslot, 0,
+                                           keepdims=False)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(emit, y, cur), mslot, 0)
+            state = lax.ppermute(y, axis, perm_fwd)
+            return (state, outputs, saved, aux_tot), None
+
+        carry0 = (
+            jnp.zeros(xs.shape[1:], xs.dtype),
+            jnp.zeros_like(xs),
+            jnp.zeros_like(xs),                       # saved boundaries
+            jax.tree.map(lambda a: jnp.zeros((), jnp.float32), aux_zero),
+        )
+        (_, outputs, saved, aux_tot), _ = lax.scan(
+            tick, carry0, jnp.arange(M + n_stages - 1))
+        out = lax.all_gather(
+            outputs.astype(jnp.float32), axis)[n_stages - 1]
+        # every stage accumulated its own microbatches: psum = total
+        aux = jax.tree.map(lambda a: lax.psum(a, axis), aux_tot)
+        return out, aux, saved
+
+    @jax.custom_vjp
+    def run(params_local, xs_f32, extras_s, aux_zero):
+        out, aux, _ = _forward(params_local, xs_f32, extras_s, aux_zero)
+        return out, aux
+
+    def run_fwd(params_local, xs_f32, extras_s, aux_zero):
+        out, aux, saved = _forward(params_local, xs_f32, extras_s,
+                                   aux_zero)
+        return (out, aux), (params_local, extras_s, saved)
+
+    def run_bwd(res, cts):
+        params_local, extras_s, saved = res
+        g_out, g_aux = cts          # [M, ...] f32, scalars
+        # Under check_vma=False, shard_map delivers a replicated output's
+        # cotangent as a 1/P share per device (the dual of psumming
+        # replicated-input cotangents).  The GPipe path recovers the full
+        # value through the all_gather transpose (a reduce-scatter over
+        # the P shares); this hand-written backward must do the same
+        # explicitly — in f32, like every cross-boundary collective here.
+        g_out = lax.psum(g_out, axis)
+        g_aux = jax.tree.map(lambda g: lax.psum(g, axis), g_aux)
+        idx = lax.axis_index(axis)
+        g_out_c = g_out.astype(dtype)
+
+        def btick(carry, u):
+            gstate, dparams, dxs = carry
+            # reverse pipeline: cotangents enter at the LAST stage and
+            # flow upstream; stage s handles microbatch u - (P-1-s)
+            m = u - (n_stages - 1 - idx)
+            valid = (m >= 0) & (m < M)
+            mslot = jnp.clip(m, 0, M - 1)
+            g_in = jnp.where(
+                idx == n_stages - 1,
+                lax.dynamic_index_in_dim(g_out_c, mslot, 0,
+                                         keepdims=False),
+                gstate)
+            x_in = lax.dynamic_index_in_dim(saved, mslot, 0,
+                                            keepdims=False)
+            e_in = jax.tree.map(
+                lambda e: lax.dynamic_index_in_dim(e, mslot, 0,
+                                                   keepdims=False),
+                extras_s)
+            if with_aux:
+                (y, aux_local), vjp = jax.vjp(
+                    lambda p, xv: stage_fn(p, xv, e_in),
+                    params_local, x_in)
+                aux_ct = jax.tree.map(
+                    lambda g, a: g.astype(a.dtype), g_aux, aux_local)
+                dp, dx = vjp((g_in, aux_ct))
+            else:
+                y, vjp = jax.vjp(
+                    lambda p, xv: stage_fn(p, xv, e_in),
+                    params_local, x_in)
+                dp, dx = vjp(g_in)
+            dparams = jax.tree.map(
+                lambda acc, d: acc + jnp.where(valid, d, 0),
+                dparams, dp)
+            dx = jnp.where(valid, dx, 0)
+            bank = (idx == 0) & valid     # stage 0 banks input cotangent
+            cur = lax.dynamic_index_in_dim(dxs, mslot, 0, keepdims=False)
+            dxs = lax.dynamic_update_index_in_dim(
+                dxs, jnp.where(bank, dx, cur), mslot, 0)
+            gstate = lax.ppermute(dx, axis, perm_bwd)
+            return (gstate, dparams, dxs), None
+
+        carry0 = (
+            jnp.zeros(saved.shape[1:], dtype),
+            jax.tree.map(jnp.zeros_like, params_local),
+            jnp.zeros_like(saved),
+        )
+        (_, dparams, dxs), _ = lax.scan(
+            btick, carry0, jnp.arange(M + n_stages - 1))
+        # boundary cotangent in f32, zeros off stage 0: the shard_map
+        # transpose psums replicated-input cotangents over `axis`
+        dxs_f32 = jnp.where(idx == 0, dxs.astype(jnp.float32),
+                            jnp.zeros_like(dxs, jnp.float32))
+        d_extras = jax.tree.map(_ct_zero, extras_s)
+        return dparams, dxs_f32, d_extras, g_aux
+
+    run.defvjp(run_fwd, run_bwd)
+    return run

@@ -23,6 +23,7 @@ _BUILT_IN: Dict[str, str] = {
     "grafana": "cloudtik_tpu.runtimes.grafana.runtime:GrafanaRuntime",
     "mlflow": "cloudtik_tpu.runtimes.mlflow.runtime:MLflowRuntime",
     "serving": "cloudtik_tpu.runtimes.serving.runtime:ServingRuntime",
+    "profiler": "cloudtik_tpu.runtimes.profiler.runtime:ProfilerRuntime",
     # stateful / data services
     "etcd": "cloudtik_tpu.runtimes.etcd.runtime:EtcdRuntime",
     "zookeeper":

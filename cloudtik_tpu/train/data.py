@@ -9,6 +9,12 @@ corpora go through the grain-backed loader when available.
 
 from __future__ import annotations
 
+import glob as _glob
+import os
+import queue
+import re
+import threading
+import time
 from typing import Dict, Iterator, Optional
 
 import jax
@@ -239,6 +245,161 @@ def global_batches(
             global_batch[name] = jax.make_array_from_process_local_data(
                 sharding, arr, global_shape)
         yield global_batch
+
+
+# ---------------------------------------------------------------------------
+# Streaming ETL -> TPU hand-off (round-4 verdict item 3)
+# ---------------------------------------------------------------------------
+#
+# The ETL cluster (spark runtime) exports tokenized shards to shared
+# storage while the TPU cluster trains; the trainer must start before the
+# last shard exists and stream shards as they land (SURVEY.md §7 stage 7;
+# BASELINE DLRM config's cross-cluster hand-off).  Protocol:
+#   * writers publish `shard-NNNNN.npy` (flat int32 token ids) ATOMICALLY
+#     via export_token_shard (write hidden tmp, os.replace) so a reader
+#     never observes a half-written file;
+#   * the writer of the LAST shard drops `_SUCCESS` (spark's own
+#     completion-marker convention) via finish_export.
+
+SHARD_DONE_MARKER = "_SUCCESS"
+_SHARD_RE = re.compile(r"shard-(\d+)\.npy$")
+
+
+def export_token_shard(export_dir: str, index: int,
+                       tokens: np.ndarray) -> str:
+    """Atomically publish one tokenized shard (the writer half of the
+    streaming hand-off; a spark executor calls this per partition —
+    tools/spark_export_job.py)."""
+    os.makedirs(export_dir, exist_ok=True)
+    final = os.path.join(export_dir, f"shard-{index:05d}.npy")
+    # unique tmp per attempt: a speculative/zombie re-execution of the
+    # same partition must never write into the inode another attempt is
+    # about to publish (the reader's contract is visible == complete)
+    tmp = os.path.join(
+        export_dir,
+        f".tmp-shard-{index:05d}.{os.getpid()}.{id(tokens):x}.npy")
+    np.save(tmp, np.asarray(tokens, np.int32))
+    os.replace(tmp, final)
+    return final
+
+
+def finish_export(export_dir: str) -> None:
+    """Drop the completion marker after every shard is published."""
+    with open(os.path.join(export_dir, SHARD_DONE_MARKER), "w") as f:
+        f.write("ok\n")
+
+
+def streaming_shard_batches(
+    export_dir: str,
+    batch_size: int,
+    seq_len: int,
+    *,
+    readahead: int = 2,
+    poll_s: float = 0.25,
+    timeout_s: float = 600.0,
+    shard_index: Optional[int] = None,
+    shard_count: Optional[int] = None,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Stream LM batches from an export directory WHILE it is being
+    written.
+
+    A watcher thread polls for newly published shards, loads up to
+    `readahead` of them ahead of the consumer (IO overlaps the train
+    step), and finishes when the `_SUCCESS` marker exists and every
+    published shard is consumed.  Raises TimeoutError if no new shard
+    and no marker appear for `timeout_s` (a dead ETL job must fail the
+    trainer, not hang it).
+
+    Multi-host: host h consumes shards with index % shard_count == h —
+    disjoint strided ownership, same as tokenized_file_batches.  Hosts
+    must see the same number of batches to stay in SPMD lockstep, so
+    exporters should publish equal-size shards in multiples of
+    shard_count (tools/prepare_corpus.py's strided export does).
+    Trailing tokens that don't fill a complete batch are dropped.
+    """
+    shard_index = jax.process_index() if shard_index is None else shard_index
+    shard_count = jax.process_count() if shard_count is None else shard_count
+    q: "queue.Queue" = queue.Queue(maxsize=max(readahead, 1))
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        """Queue put that never deadlocks a departed consumer: the
+        consumer's finally drains once, but the watcher may refill —
+        poll `stop` instead of blocking forever."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def watch():
+        seen = set()
+        last_progress = time.monotonic()
+        try:
+            while not stop.is_set():
+                # marker checked BEFORE the glob: shards published
+                # between a glob and a later marker check would be
+                # dropped; this order guarantees the final scan happens
+                # after the marker (writers drop it last)
+                done = os.path.exists(
+                    os.path.join(export_dir, SHARD_DONE_MARKER))
+                files = sorted(
+                    _glob.glob(os.path.join(export_dir, "shard-*.npy")))
+                new = [f for f in files if f not in seen]
+                for f in new:
+                    seen.add(f)
+                    last_progress = time.monotonic()
+                    m = _SHARD_RE.search(f)
+                    if m is None:
+                        continue
+                    if int(m.group(1)) % shard_count != shard_index:
+                        continue
+                    # rename-published: the file is complete once visible
+                    if not put(np.load(f).astype(np.int32)):
+                        return
+                if done and not new:
+                    put(None)
+                    return
+                if time.monotonic() - last_progress > timeout_s:
+                    put(TimeoutError(
+                        f"no new shard in {export_dir} for "
+                        f"{timeout_s:.0f}s and no {SHARD_DONE_MARKER}"))
+                    return
+                # back off only when nothing new landed this scan
+                if not new:
+                    stop.wait(poll_s)
+        except Exception as e:   # surface loader errors to the consumer
+            put(e)
+
+    watcher = threading.Thread(target=watch, daemon=True,
+                               name="tik-shard-watch")
+    watcher.start()
+    per = seq_len + 1
+    buf = np.zeros((0,), np.int32)
+    try:
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            if isinstance(item, Exception):
+                raise item
+            buf = np.concatenate([buf, item]) if buf.size else item
+            need = batch_size * per
+            while buf.size >= need:
+                rows = buf[:need].reshape(batch_size, per)
+                buf = buf[need:]
+                yield {"tokens": rows[:, :-1].astype(np.int32),
+                       "labels": rows[:, 1:].astype(np.int32)}
+    finally:
+        stop.set()
+        # unblock a watcher stuck on a full queue
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
 
 
 def tokenized_file_batches(

@@ -129,6 +129,126 @@ class TestPipelineApply:
         assert pipe_axis_size() == 1
 
 
+class Test1F1BSchedule:
+    """The 1F1B option (round-4 verdict item 4): grad parity with GPipe /
+    local execution, and a strictly smaller compiled activation
+    footprint at pipe=4."""
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_fwd_parity(self, dtype):
+        mesh = _mesh((2, 2), ("data", "pipe"))
+        L, d = 4, 16
+        params = jax.random.normal(jax.random.PRNGKey(0), (L, d, d)) * 0.1
+        x = jax.random.normal(
+            jax.random.PRNGKey(1), (8, d)).astype(dtype)
+        with jax.sharding.set_mesh(mesh):
+            p_s = jax.device_put(params, NamedSharding(mesh, P("pipe")))
+            x_s = jax.device_put(x, NamedSharding(mesh, P("data")))
+            y = jax.jit(lambda p, x: pipeline_apply(
+                _stage, p, x, n_microbatches=4,
+                schedule="1f1b"))(p_s, x_s)
+        np.testing.assert_allclose(
+            np.asarray(y, dtype=np.float32),
+            np.asarray(_ref(params, x), dtype=np.float32),
+            rtol=2e-2 if dtype == jnp.bfloat16 else 1e-4)
+
+    def test_grad_parity_including_inputs(self):
+        """Param AND input cotangents match the local reference — the
+        hand-written reverse pipeline must reproduce what autodiff
+        gives the GPipe path, including the replicated-boundary psum."""
+        mesh = _mesh((2, 2, 2), ("data", "fsdp", "pipe"))
+        L, d = 4, 16
+        params = jax.random.normal(jax.random.PRNGKey(0), (L, d, d)) * 0.1
+        x = jax.random.normal(
+            jax.random.PRNGKey(1), (8, 6, d)).astype(jnp.bfloat16)
+
+        def loss_1f1b(p, x):
+            y = pipeline_apply(_stage, p, x, n_microbatches=4,
+                               schedule="1f1b")
+            return (y.astype(jnp.float32) ** 2).sum()
+
+        def loss_ref(p, x):
+            return (_ref(p, x).astype(jnp.float32) ** 2).sum()
+
+        with jax.sharding.set_mesh(mesh):
+            p_s = jax.device_put(
+                params, NamedSharding(mesh, P("pipe", "fsdp")))
+            x_s = jax.device_put(
+                x, NamedSharding(mesh, P(("data", "fsdp"))))
+            gp, gx = jax.jit(
+                jax.grad(loss_1f1b, argnums=(0, 1)))(p_s, x_s)
+        gp_ref, gx_ref = jax.grad(loss_ref, argnums=(0, 1))(params, x)
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gp_ref),
+                                   rtol=5e-2, atol=1e-3)
+        np.testing.assert_allclose(
+            np.asarray(gx, dtype=np.float32),
+            np.asarray(gx_ref, dtype=np.float32), rtol=5e-2, atol=1e-3)
+
+    def test_lower_activation_memory_than_gpipe(self):
+        """The schedule's point: at pipe=4 with a deep stack, the
+        compiled grad program's temp allocation (activation residuals)
+        must be well below GPipe's autodiff-through-scan."""
+        mesh = _mesh((2, 4), ("data", "pipe"))
+        L, d, B, S = 16, 64, 8, 32
+        params = jax.random.normal(
+            jax.random.PRNGKey(0), (L, d, d)) * 0.05
+        x = jax.random.normal(
+            jax.random.PRNGKey(1), (B, S, d)).astype(jnp.bfloat16)
+
+        def loss(schedule):
+            def f(p, x):
+                y = pipeline_apply(_stage, p, x, n_microbatches=8,
+                                   schedule=schedule)
+                return (y.astype(jnp.float32) ** 2).sum()
+            return f
+
+        with jax.sharding.set_mesh(mesh):
+            p_s = jax.device_put(params, NamedSharding(mesh, P("pipe")))
+            x_s = jax.device_put(x, NamedSharding(mesh, P("data")))
+            temps = {}
+            for schedule in ("gpipe", "1f1b"):
+                compiled = jax.jit(
+                    jax.grad(loss(schedule))).lower(p_s, x_s).compile()
+                mem = compiled.memory_analysis()
+                if mem is None:
+                    pytest.skip("backend exposes no memory analysis")
+                temps[schedule] = mem.temp_size_in_bytes
+        assert temps["1f1b"] < temps["gpipe"], temps
+
+    def test_moe_aux_parity_with_gpipe(self):
+        """Router aux losses (the aux_init path) flow through the
+        custom-vjp schedule identically to GPipe."""
+        mesh = _mesh((2, 2), ("data", "pipe"))
+        L, d = 4, 8
+        params = jax.random.normal(jax.random.PRNGKey(0), (L, d, d)) * 0.1
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, d))
+
+        def stage_aux(p_local, xm, _extras):
+            def body(c, w):
+                return jnp.tanh(c @ w.astype(c.dtype)), None
+            out, _ = jax.lax.scan(body, xm, p_local)
+            return out, {"aux": (out.astype(jnp.float32) ** 2).mean()}
+
+        def run(schedule):
+            def f(p, x):
+                y, aux = pipeline_apply(
+                    stage_aux, p, x, n_microbatches=4,
+                    aux_init={"aux": 0.0}, schedule=schedule)
+                return (y.astype(jnp.float32) ** 2).sum() \
+                    + 0.5 * aux["aux"]
+            with jax.sharding.set_mesh(mesh):
+                p_s = jax.device_put(
+                    params, NamedSharding(mesh, P("pipe")))
+                val, grad = jax.jit(
+                    jax.value_and_grad(f))(p_s, x)
+            return np.asarray(val), np.asarray(grad)
+
+        v_g, g_g = run("gpipe")
+        v_o, g_o = run("1f1b")
+        np.testing.assert_allclose(v_o, v_g, rtol=1e-5)
+        np.testing.assert_allclose(g_o, g_g, rtol=1e-4, atol=1e-6)
+
+
 class TestTrainerPipelineParity:
     def _losses(self, cfg, spec, mesh_cfg, steps=2):
         mesh = build_mesh(mesh_cfg, devices=jax.devices()[:8])
@@ -151,6 +271,15 @@ class TestTrainerPipelineParity:
     def test_pipe4_matches_dp(self):
         cfg = T.config("tiny", n_layers=4, n_heads=8, n_kv_heads=8,
                        d_ff=256, remat=False)
+        spec = transformer_spec(cfg)
+        l_ref = self._losses(cfg, spec, MeshConfig(data=8, fsdp=1))
+        l_pipe4 = self._losses(
+            cfg, spec, MeshConfig(data=1, fsdp=2, pipe=4, tensor=1))
+        np.testing.assert_allclose(l_ref, l_pipe4, rtol=2e-2)
+
+    def test_pipe4_1f1b_matches_dp(self):
+        cfg = T.config("tiny", n_layers=4, n_heads=8, n_kv_heads=8,
+                       d_ff=256, remat=False, pipeline_schedule="1f1b")
         spec = transformer_spec(cfg)
         l_ref = self._losses(cfg, spec, MeshConfig(data=8, fsdp=1))
         l_pipe4 = self._losses(

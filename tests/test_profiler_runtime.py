@@ -1,0 +1,106 @@
+"""Profiler runtime: TensorBoard/xprof served over captured traces.
+
+Round-4 verdict item 6 done-bar: the runtime boots (real tensorboard
+process through the delivery spawn path) and serves a trace the trainer
+captured — a perf regression becomes diagnosable from a URL.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import urllib.request
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get(port, path, timeout=30):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as resp:
+        body = resp.read()
+        if body[:2] == b"\x1f\x8b":      # xprof gzips unconditionally
+            import gzip
+            body = gzip.decompress(body)
+        return resp.status, body
+
+
+@pytest.fixture(scope="module")
+def captured_trace(tmp_path_factory):
+    """A real (tiny) xprof capture, as Trainer.fit(profile_dir=...) makes."""
+    import jax
+    import jax.numpy as jnp
+
+    profile_dir = tmp_path_factory.mktemp("profiles")
+    jax.profiler.start_trace(str(profile_dir))
+    jax.block_until_ready(
+        jax.jit(lambda x: (x @ x).sum())(jnp.ones((64, 64))))
+    jax.profiler.stop_trace()
+    return profile_dir
+
+
+class TestProfilerRuntime:
+    def test_boots_and_serves_captured_trace(self, captured_trace,
+                                             tmp_path):
+        import shutil
+        if shutil.which("xprof") is None:
+            pytest.skip("no xprof server binary")
+        from cloudtik_tpu.runtimes.profiler.runtime import ProfilerRuntime
+
+        port = _free_port()
+        rt = ProfilerRuntime({
+            "profile_dir": str(captured_trace),
+            "port": port,
+            "start_timeout_s": 180,
+        })
+        ctx = {"is_head": True, "node_id": "head",
+               "node_ip": "127.0.0.1",
+               "config": {"cluster_name": "c1", "workspace_name": "w1"},
+               "conf_dir": str(tmp_path)}
+        try:
+            rt.node_services(ctx, "start")
+            status, _ = _get(port, "/", timeout=60)
+            assert status == 200
+            # the server sees the trainer's captured run
+            status, body = _get(port, "/runs", timeout=60)
+            assert status == 200
+            runs = json.loads(body)
+            assert runs, "profiler server lists no captured runs"
+        finally:
+            rt.node_services(ctx, "stop")
+
+    def test_registered_and_endpoint(self):
+        from cloudtik_tpu.runtimes.profiler.runtime import ProfilerRuntime
+        from cloudtik_tpu.runtimes.registry import get_runtime_cls
+
+        assert get_runtime_cls("profiler") is ProfilerRuntime
+        rt = ProfilerRuntime({})
+        eps = rt.get_runtime_endpoints({}, "10.0.0.1")
+        assert eps["profiler"]["url"] == "http://10.0.0.1:6006"
+        svcs = rt.get_runtime_services({}, "10.0.0.1")
+        assert svcs["profiler"]["node_kind"] == "head"
+
+    def test_no_server_available_degrades_to_none(self, monkeypatch,
+                                                  tmp_path):
+        """Without xprof or tensorboard installed the runtime renders no
+        command (delivery skips the spawn) instead of crashing node
+        boot."""
+        import builtins
+
+        from cloudtik_tpu.runtimes.profiler import runtime as prt
+        real_import = builtins.__import__
+
+        def fake_import(name, *a, **k):
+            if name == "tensorboard":
+                raise ImportError(name)
+            return real_import(name, *a, **k)
+
+        monkeypatch.setattr(prt.shutil, "which", lambda _name: None)
+        monkeypatch.setattr(builtins, "__import__", fake_import)
+        rt = prt.ProfilerRuntime({"profile_dir": str(tmp_path)})
+        assert rt.service_command({"is_head": True}) is None

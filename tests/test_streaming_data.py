@@ -1,0 +1,142 @@
+"""Streaming ETL->TPU shard hand-off (round-4 verdict item 3).
+
+The trainer must start BEFORE the last shard exists and finish with all
+data: an exporter (the spark job's writer path) publishes shards with
+delays while a real Trainer consumes them concurrently.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cloudtik_tpu.train.data import (
+    export_token_shard, finish_export, streaming_shard_batches)
+
+
+def _write_shards(export_dir, shards, delay_s=0.0, publish_times=None):
+    for i, tokens in enumerate(shards):
+        if delay_s:
+            time.sleep(delay_s)
+        export_token_shard(str(export_dir), i, tokens)
+        if publish_times is not None:
+            publish_times.append(time.monotonic())
+    finish_export(str(export_dir))
+
+
+class TestStreamingShardBatches:
+    def test_reads_all_tokens_exactly(self, tmp_path):
+        rng = np.random.default_rng(0)
+        shards = [rng.integers(0, 100, (50,), dtype=np.int32)
+                  for _ in range(4)]
+        _write_shards(tmp_path, shards)
+        batches = list(streaming_shard_batches(
+            str(tmp_path), batch_size=2, seq_len=9,
+            shard_index=0, shard_count=1, timeout_s=10))
+        stream = np.concatenate(shards)
+        per, bs = 10, 2
+        n_batches = len(stream) // (per * bs)
+        assert len(batches) == n_batches
+        got = np.concatenate(
+            [b["tokens"].reshape(-1) for b in batches])
+        # tokens are the stream minus each row's shifted-off last token
+        rows = stream[:n_batches * bs * per].reshape(-1, per)
+        np.testing.assert_array_equal(
+            got, rows[:, :-1].reshape(-1))
+        np.testing.assert_array_equal(
+            batches[0]["labels"][0], rows[0, 1:])
+
+    def test_consumes_while_producing(self, tmp_path):
+        """First batch must arrive before the last shard is published."""
+        rng = np.random.default_rng(1)
+        shards = [rng.integers(0, 100, (40,), dtype=np.int32)
+                  for _ in range(5)]
+        publish_times = []
+        writer = threading.Thread(
+            target=_write_shards,
+            args=(tmp_path, shards, 0.3, publish_times), daemon=True)
+        writer.start()
+        it = streaming_shard_batches(
+            str(tmp_path), batch_size=2, seq_len=9,
+            shard_index=0, shard_count=1, poll_s=0.05, timeout_s=30)
+        first = next(it)
+        t_first = time.monotonic()
+        rest = list(it)
+        writer.join(timeout=10)
+        assert t_first < publish_times[-1], \
+            "reader should start before the export finishes"
+        total = 1 + len(rest)
+        assert total == (5 * 40) // (10 * 2)
+        assert first["tokens"].shape == (2, 9)
+
+    def test_strided_multi_host_ownership(self, tmp_path):
+        shards = [np.full((20,), i, dtype=np.int32) for i in range(4)]
+        _write_shards(tmp_path, shards)
+        seen = set()
+        for b in streaming_shard_batches(
+                str(tmp_path), batch_size=1, seq_len=9,
+                shard_index=1, shard_count=2, timeout_s=10):
+            seen.update(np.unique(b["tokens"]).tolist())
+        assert seen == {1, 3}       # only odd shard indices
+
+    def test_timeout_without_marker(self, tmp_path):
+        with pytest.raises(TimeoutError):
+            list(streaming_shard_batches(
+                str(tmp_path), batch_size=1, seq_len=3,
+                shard_index=0, shard_count=1,
+                poll_s=0.05, timeout_s=0.3))
+
+    def test_atomic_publication_never_reads_partial(self, tmp_path):
+        """A half-written tmp file must be invisible to the reader."""
+        np.save(os.path.join(str(tmp_path), ".tmp-shard-00000.npy"),
+                np.zeros((10,), np.int32))
+        finish_export(str(tmp_path))
+        assert list(streaming_shard_batches(
+            str(tmp_path), batch_size=1, seq_len=3,
+            shard_index=0, shard_count=1, timeout_s=5)) == []
+
+
+class TestTrainerStreamsFromExport:
+    def test_trainer_starts_before_export_finishes(self, tmp_path):
+        """The verdict's done-bar: a real Trainer consumes the export
+        directory while the (spark-job) writer is still producing, and
+        finishes having seen all the data."""
+        import jax
+
+        from cloudtik_tpu.models import transformer as T
+        from cloudtik_tpu.train.trainer import (
+            Trainer, TrainerConfig, transformer_spec)
+
+        cfg = T.config("tiny", attention_impl="reference", remat=False)
+        seq, bs = 16, 8
+        rng = np.random.default_rng(2)
+        # 6 shards x 4 batches worth of tokens each
+        shard_tokens = bs * (seq + 1) * 4
+        shards = [rng.integers(0, cfg.vocab_size, (shard_tokens,),
+                               dtype=np.int32) for _ in range(6)]
+        publish_times = []
+        writer = threading.Thread(
+            target=_write_shards,
+            args=(tmp_path, shards, 0.5, publish_times), daemon=True)
+
+        trainer = Trainer(
+            transformer_spec(cfg),
+            TrainerConfig(global_batch_size=bs, seq_len=seq,
+                          log_every=100))
+        data = streaming_shard_batches(
+            str(tmp_path), batch_size=bs, seq_len=seq,
+            shard_index=0, shard_count=1, poll_s=0.05, timeout_s=60)
+        writer.start()
+        t0 = time.monotonic()
+        out = trainer.fit(data, num_steps=24)    # exactly all batches
+        t_done = time.monotonic()
+        writer.join(timeout=10)
+        assert out["final_step"] == 24
+        # training overlapped the export: it began (t0) well before the
+        # final shard landed
+        assert t0 < publish_times[-1]
+        assert t_done >= publish_times[2]

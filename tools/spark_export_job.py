@@ -1,0 +1,87 @@
+"""Spark ETL job: tokenize a text corpus and stream shards to a TPU
+cluster's export directory.
+
+Reference parity: the reference's Spark data-prep stage feeding its AI
+cluster (SURVEY.md §7 stage 7; BASELINE DLRM "Spark-runtime ETL ->
+TPU ... (cross-cluster)").  Submit through the spark runtime's routing
+(`tik submit cluster.yaml tools/spark_export_job.py -- <args>` — the
+runtime's get_runnable_command wraps it in spark-submit), or run with
+--local for a sparkless smoke of the exact same writer path.
+
+Each partition's tokens are published ATOMICALLY with
+`train.data.export_token_shard`, and `_SUCCESS` is dropped when every
+shard is out — the contract `train.data.streaming_shard_batches`
+consumes WHILE this job is still running: the trainer starts as soon as
+shard 0 lands.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+
+
+def _tokenize(text: str):
+    """Byte-level tokens (tools/prepare_corpus.py's default tokenizer)."""
+    import numpy as np
+    return np.frombuffer(text.encode("utf-8"), dtype=np.uint8).astype(
+        np.int32)
+
+
+def export_partition(index: int, lines, export_dir: str) -> int:
+    """One executor task: tokenize its partition, publish one shard."""
+    import numpy as np
+
+    from cloudtik_tpu.train.data import export_token_shard
+    tokens = np.concatenate(
+        [_tokenize(line) for line in lines]
+        or [np.zeros((0,), np.int32)])
+    export_token_shard(export_dir, index, tokens)
+    return int(tokens.size)
+
+
+def run_spark(input_glob: str, export_dir: str, n_shards: int) -> None:
+    from pyspark.sql import SparkSession
+
+    from cloudtik_tpu.train.data import finish_export
+    spark = SparkSession.builder.appName("tik-export").getOrCreate()
+    rdd = spark.sparkContext.textFile(input_glob).repartition(n_shards)
+    sizes = rdd.mapPartitionsWithIndex(
+        lambda i, it: [export_partition(i, it, export_dir)]).collect()
+    finish_export(export_dir)
+    print(f"exported {len(sizes)} shards, {sum(sizes)} tokens")
+    spark.stop()
+
+
+def run_local(input_glob: str, export_dir: str, n_shards: int) -> None:
+    """Sparkless path: same writer calls, partitions split round-robin."""
+    from cloudtik_tpu.train.data import finish_export
+    lines = []
+    for path in sorted(glob.glob(input_glob)):
+        with open(path, errors="replace") as f:
+            lines.extend(f.read().splitlines(keepends=True))
+    total = 0
+    for i in range(n_shards):
+        total += export_partition(i, lines[i::n_shards], export_dir)
+    finish_export(export_dir)
+    print(f"exported {n_shards} shards, {total} tokens")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("spark_export_job")
+    p.add_argument("--input", required=True, help="input text glob")
+    p.add_argument("--export-dir", required=True)
+    p.add_argument("--shards", type=int, default=8)
+    p.add_argument("--local", action="store_true",
+                   help="run without spark (same writer path)")
+    args = p.parse_args(argv)
+    if args.local:
+        run_local(args.input, args.export_dir, args.shards)
+    else:
+        run_spark(args.input, args.export_dir, args.shards)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
