@@ -155,6 +155,13 @@ def _validate(config: Dict[str, Any], schema: Dict[str, Any], what: str) -> None
 def validate_cluster_config(config: Dict[str, Any]) -> None:
     _validate(config, CLUSTER_SCHEMA, "cluster")
     # Cross-field checks beyond JSON schema:
+    if config.get("docker"):
+        from cloudtik_tpu.control.executor.docker import (
+            validate_docker_config)
+        try:
+            validate_docker_config(config)
+        except ValueError as e:
+            raise ConfigError(str(e)) from e
     node_types = config.get("available_node_types", {})
     head = config.get("head_node_type")
     if head is not None and head not in node_types:

@@ -20,7 +20,7 @@ from cloudtik_tpu.config.hashing import hash_launch_conf, hash_runtime_conf
 from cloudtik_tpu.control.executor.factory import make_command_executor
 from cloudtik_tpu.control.state import (
     StateClient, TABLE_SCALING, TcpStateBackend)
-from cloudtik_tpu.control.updater import NodeUpdater
+from cloudtik_tpu.control.updater import NodeUpdater, shared_memory_ratio
 from cloudtik_tpu.core.tags import (
     NODE_KIND_HEAD, NODE_KIND_WORKER, STATUS_UNINITIALIZED, STATUS_UP_TO_DATE,
     TAG_CLUSTER_NAME, TAG_LAUNCH_CONFIG, TAG_NODE_KIND, TAG_NODE_STATUS,
@@ -227,6 +227,8 @@ def get_or_create_head_node(
         environment_variables=_runtime_env(config, provider, head_id),
         is_head_node=True,
         restart_only=restart_only,
+        shared_memory_ratio=shared_memory_ratio(
+            config, config.get("head_node_type", "")),
     )
     updater.run()
     return head_id

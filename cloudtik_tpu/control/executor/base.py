@@ -53,9 +53,13 @@ class CommandExecutor:
         raise NotImplementedError
 
     def run_init(self, *, as_head: bool, file_mounts: Dict[str, str],
-                 sync_run_yet: bool) -> Optional[bool]:
+                 sync_run_yet: bool,
+                 shared_memory_ratio: float = 0.0) -> Optional[bool]:
         """Pre-setup hook (e.g. start docker container).  Returns True if it
-        changed node state in a way that requires re-running file sync."""
+        changed node state in a way that requires re-running file sync.
+        shared_memory_ratio: fraction of node memory for /dev/shm (docker
+        --shm-size sizing; runtimes declare it via
+        get_runtime_shared_memory_ratio)."""
         return None
 
 

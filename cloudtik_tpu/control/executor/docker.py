@@ -1,15 +1,51 @@
 """Docker command executor: wraps another executor with `docker exec`.
 
 Reference parity: command_executor/docker_command_executor.py:27 and
-core/_private/docker.py (with_docker_exec:74).
+core/_private/docker.py (with_docker_exec:74, validate_docker_config:54,
+file-mount checks) + _auto_configure_shm
+(docker_command_executor.py:500) for /dev/shm sizing from runtime
+demand.
 """
 
 from __future__ import annotations
 
+import logging
+import os
 import shlex
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from cloudtik_tpu.control.executor.base import CommandExecutor
+
+logger = logging.getLogger(__name__)
+
+
+def validate_docker_config(config: Dict[str, Any]) -> None:
+    """Reject unusable docker sections at config time instead of at
+    first node boot (reference: docker.py validate_docker_config:54).
+
+    Mirrors the executor factory's semantics exactly: docker is OFF
+    unless `enabled` is truthy (a bare section is inert), and
+    container_name is optional (the factory defaults it to
+    tik-<cluster>).  When enabled, an image is required; file
+    (non-directory) file_mounts draw a warning, since bind-mounted
+    files do not reliably see host updates inside containers.
+    """
+    docker_config = config.get("docker") or {}
+    if not docker_config.get("enabled"):
+        return
+    image = docker_config.get("image")
+    head_image = docker_config.get("head_image", image)
+    worker_image = docker_config.get("worker_image", image)
+    if not (image or (head_image and worker_image)):
+        raise ValueError(
+            "docker config requires image (or both head_image and "
+            "worker_image)")
+    for remote, local in (config.get("file_mounts") or {}).items():
+        if os.path.isfile(os.path.expanduser(local)):
+            logger.warning(
+                "file mount (%s: %s) is a FILE; docker bind-mounted "
+                "files do not always see host updates — mount a "
+                "directory instead", remote, local)
 
 
 class DockerCommandExecutor(CommandExecutor):
@@ -62,27 +98,60 @@ class DockerCommandExecutor(CommandExecutor):
         return (self.host.remote_shell_command_str()
                 + f" docker exec -it {self.container_name} /bin/bash")
 
+    def _auto_shm_options(self, run_options: List[str],
+                          shared_memory_ratio: float) -> List[str]:
+        """--shm-size sized from the HOST's available memory times the
+        runtimes' declared ratio (reference: _auto_configure_shm:500).
+        Explicit --shm-size in run_options and a zero ratio both bypass
+        detection."""
+        if self.docker_config.get("disable_shm_size_detection"):
+            return run_options
+        if any("--shm-size" in opt for opt in run_options):
+            return run_options
+        if shared_memory_ratio <= 0:
+            return run_options
+        try:
+            meminfo = self.host.run(
+                "cat /proc/meminfo || true", with_output=True) or ""
+            if isinstance(meminfo, bytes):
+                meminfo = meminfo.decode(errors="replace")
+            available_kb = int(next(
+                line for line in meminfo.splitlines()
+                if "MemAvailable" in line).split()[1])
+        except Exception:
+            logger.warning("cannot read host MemAvailable; skipping "
+                           "--shm-size sizing")
+            return run_options
+        # overestimate by 10%, same as the reference
+        shm_bytes = int(available_kb * 1024 * shared_memory_ratio * 1.1)
+        return run_options + [f"--shm-size='{shm_bytes}b'"]
+
     def run_init(self, *, as_head: bool, file_mounts: Dict[str, str],
-                 sync_run_yet: bool) -> Optional[bool]:
+                 sync_run_yet: bool,
+                 shared_memory_ratio: float = 0.0) -> Optional[bool]:
         """Ensure the container is running (image pull + docker run)."""
         image = self.docker_config.get(
             "head_image" if as_head else "worker_image") or \
             self.docker_config.get("image")
         if not image:
             return None
-        run_options = " ".join(
+        check = (f"docker ps -q -f name=^{self.container_name}$")
+        running = (self.host.run(check, with_output=True) or "").strip()
+        if running:
+            return False
+        # shm probe (a remote exec) only when a container will start
+        run_options = self._auto_shm_options(
             self.docker_config.get("run_options", []) +
             self.docker_config.get(
-                "head_run_options" if as_head else "worker_run_options", []))
+                "head_run_options" if as_head else "worker_run_options",
+                []),
+            shared_memory_ratio)
+        options = " ".join(run_options)
         mounts = " ".join(
             f"-v {shlex.quote(path)}:{shlex.quote(path)}"
             for path in file_mounts)
-        check = (f"docker ps -q -f name=^{self.container_name}$")
-        running = (self.host.run(check, with_output=True) or "").strip()
-        if not running:
-            self.host.run(
-                f"docker run --rm --name {self.container_name} -d --network "
-                f"host {mounts} {run_options} {shlex.quote(image)} "
-                f"sleep infinity")
-            return True
-        return False
+        self.host.run(
+            f"docker run --rm --name {self.container_name} -d --network "
+            f"host {mounts} {options} {shlex.quote(image)} "
+            f"sleep infinity")
+        return True

@@ -325,7 +325,14 @@ class ClusterScaler:
             self._spawn_updater(node_id)
 
     def _spawn_updater(self, node_id: str, restart_only: bool = False) -> None:
+        from cloudtik_tpu.control.updater import shared_memory_ratio
+        from cloudtik_tpu.core.tags import TAG_USER_NODE_TYPE
         executor = self.executor_factory(node_id)
+        try:
+            node_type = self.provider.node_tags(node_id).get(
+                TAG_USER_NODE_TYPE, "")
+        except Exception:
+            node_type = ""
         updater = NodeUpdaterThread(
             node_id, self.provider, executor,
             file_mounts=self.config.get("file_mounts", {}),
@@ -338,6 +345,8 @@ class ClusterScaler:
             file_mounts_contents_hash=self.contents_hash,
             environment_variables=self.update_environment,
             restart_only=restart_only,
+            shared_memory_ratio=shared_memory_ratio(
+                self.config, node_type),
         )
         self.updaters[node_id] = updater
         updater.start()

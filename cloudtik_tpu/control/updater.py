@@ -27,6 +27,24 @@ from cloudtik_tpu.utils.constants import TIK_NODE_START_WAIT_S
 logger = logging.getLogger(__name__)
 
 
+def shared_memory_ratio(config: Dict[str, Any],
+                        node_type: str = "") -> float:
+    """Max /dev/shm demand any configured runtime declares for this node
+    type — sizes the docker --shm-size at container init (reference:
+    node_updater.py:451 get_shared_memory_ratio)."""
+    from cloudtik_tpu.runtimes.registry import iter_runtimes
+    ratio = 0.0
+    try:
+        for runtime in iter_runtimes(config):
+            ratio = max(ratio, float(
+                runtime.get_runtime_shared_memory_ratio(
+                    config, node_type) or 0.0))
+    except Exception:
+        logger.warning("cannot compute shared-memory ratio",
+                       exc_info=True)
+    return ratio
+
+
 class NodeUpdater:
     def __init__(
         self,
@@ -45,6 +63,7 @@ class NodeUpdater:
         wait_ready_timeout_s: int = TIK_NODE_START_WAIT_S,
         restart_only: bool = False,
         no_restart: bool = False,
+        shared_memory_ratio: float = 0.0,
     ):
         self.node_id = node_id
         self.provider = provider
@@ -60,6 +79,7 @@ class NodeUpdater:
         self.wait_ready_timeout_s = wait_ready_timeout_s
         self.restart_only = restart_only
         self.no_restart = no_restart
+        self.shared_memory_ratio = shared_memory_ratio
         self.error: Optional[Exception] = None
 
     def _set_status(self, status: str) -> None:
@@ -105,7 +125,8 @@ class NodeUpdater:
 
         changed = self.executor.run_init(
             as_head=self.is_head_node, file_mounts=self.file_mounts,
-            sync_run_yet=False)
+            sync_run_yet=False,
+            shared_memory_ratio=self.shared_memory_ratio)
         self.sync_file_mounts()
         if changed:
             self.sync_file_mounts()

@@ -13,6 +13,8 @@
 // Field names match control/node_agent.py collect_node_metrics() so the
 // Python and native samplers are drop-in interchangeable.
 
+#include <signal.h>
+#include <sys/prctl.h>
 #include <sys/statvfs.h>
 #include <unistd.h>
 
@@ -116,16 +118,27 @@ static void emit_sample(const CpuTimes& prev, const CpuTimes& cur) {
 
 int main(int argc, char** argv) {
   long interval_ms = 1000;
+  long fate_parent = 0;
   bool once = false;
   for (int i = 1; i < argc; i++) {
     if (!strcmp(argv[i], "--interval-ms") && i + 1 < argc) {
       interval_ms = atol(argv[++i]);
+    } else if (!strcmp(argv[i], "--fate-parent") && i + 1 < argc) {
+      fate_parent = atol(argv[++i]);
     } else if (!strcmp(argv[i], "--once")) {
       once = true;
     } else {
-      fprintf(stderr, "usage: %s [--interval-ms N] [--once]\n", argv[0]);
+      fprintf(stderr,
+              "usage: %s [--interval-ms N] [--once] [--fate-parent PID]\n",
+              argv[0]);
       return 2;
     }
+  }
+  if (fate_parent > 0) {
+    // in-binary fate-sharing (see state_server.cpp): lets the launcher
+    // avoid preexec_fn, so posix_spawn works under multithreaded JAX
+    prctl(PR_SET_PDEATHSIG, SIGTERM);
+    if (getppid() != fate_parent) return 0;
   }
   CpuTimes prev = read_cpu_times();
   if (once) {

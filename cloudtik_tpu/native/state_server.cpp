@@ -17,6 +17,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <signal.h>
+#include <sys/prctl.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -351,12 +352,24 @@ int main(int argc, char** argv) {
   std::string host = "0.0.0.0";
   int port = 6879;
   std::string token;
+  long fate_parent = 0;
   for (int i = 1; i < argc - 1; ++i) {
     if (!strcmp(argv[i], "--host")) host = argv[++i];
     else if (!strcmp(argv[i], "--port")) port = atoi(argv[++i]);
     else if (!strcmp(argv[i], "--token")) token = argv[++i];
+    else if (!strcmp(argv[i], "--fate-parent"))
+      fate_parent = atol(argv[++i]);
   }
   signal(SIGPIPE, SIG_IGN);
+  if (fate_parent > 0) {
+    // Self-armed fate-sharing: SIGTERM when the spawning parent dies.
+    // In-binary (vs a Python preexec_fn) so the launcher can use
+    // posix_spawn — fork()+preexec in a multithreaded JAX process is a
+    // deadlock risk profile.  prctl binds to the parent THREAD; if the
+    // parent already died between spawn and here, exit now.
+    prctl(PR_SET_PDEATHSIG, SIGTERM);
+    if (getppid() != fate_parent) return 0;
+  }
 
   int listener = socket(AF_INET, SOCK_STREAM, 0);
   if (listener < 0) { perror("socket"); return 1; }

@@ -43,6 +43,13 @@ class AIRuntime(Runtime):
     def validate_config(self, cluster_config: Dict[str, Any]) -> None:
         return None
 
+    def get_runtime_shared_memory_ratio(
+            self, config: Dict[str, Any], node_type: str) -> float:
+        """Host data loaders stage batches through /dev/shm; dockerized
+        nodes need --shm-size beyond the 64 MB default (reference: the
+        ray runtime's shared-memory ratio, runtime/ray/runtime.py:32)."""
+        return float(self.runtime_config.get("shared_memory_ratio", 0.3))
+
     def node_install(self, node_context: Dict[str, Any]) -> None:
         """Install the JAX stack on nodes that don't already have it."""
         try:

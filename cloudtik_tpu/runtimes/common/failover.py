@@ -131,6 +131,57 @@ class DBFailoverDaemon:
         return self.service.get_active()
 
 
+def read_primary(state, service_name: str) -> Optional[Dict[str, Any]]:
+    """Current <service>-primary lease holder ({"member_id", "ip",
+    "port"}) WITHOUT campaigning — the observer read pools/gateways use."""
+    from cloudtik_tpu.runtimes.common.leader_election import LeaderElection
+    return LeaderElection(state, f"svc/{service_name}-primary",
+                          member_id="__observer__").leader()
+
+
+class PrimaryChangeWatcher:
+    """Observe a service's primary lease; call `on_change(meta)` whenever
+    the holder changes (including on first observation — the callback
+    must be an idempotent re-render).  This is how pools and gateways
+    that sit IN FRONT of a replicated DB (pgpool, pgbouncer) follow a
+    failover without being election members themselves."""
+
+    def __init__(self, state, service_name: str,
+                 on_change: Callable[[Dict[str, Any]], None],
+                 *, poll_s: float = 1.0):
+        self.service_name = service_name
+        self._state = state
+        self._on_change = on_change
+        self._poll_s = poll_s
+        self._stop = threading.Event()
+        self._seen: Optional[str] = None
+
+    def poll_once(self) -> None:
+        primary = read_primary(self._state, self.service_name)
+        if not primary:
+            return
+        key = f"{primary.get('ip')}:{primary.get('port')}"
+        if key == self._seen:
+            return
+        self._on_change(dict(primary))
+        self._seen = key
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            try:
+                self.poll_once()
+            except Exception:
+                logger.exception("%s: primary-change follow failed",
+                                 self.service_name)
+
+    def start(self) -> None:
+        threading.Thread(target=self._loop, daemon=True,
+                         name=f"tik-{self.service_name}-pwatch").start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
 class PrimaryWatchDaemon:
     """For engines with NATIVE elections (mongodb replica sets): the
     engine picks its own primary, so there is nothing to promote — the

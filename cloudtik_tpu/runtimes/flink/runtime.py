@@ -17,6 +17,35 @@ JM_RPC_PORT = 6123
 JM_UI_PORT = 8081
 
 
+# Memory-sizing ratios mirroring the reference's session sizing
+# (runtime/flink/utils.py:26-35, get_flink_jobmanager_memory:57): the
+# node's schedulable memory fraction, the JM's share with clamps, and
+# the per-TM overhead floor.
+RESOURCE_MEMORY_RATIO = 0.8
+JM_MEMORY_RATIO = 0.02
+JM_MEMORY_MIN_MB = 1024
+JM_MEMORY_MAX_MB = 8192
+ADDITIONAL_OVERHEAD_MB = 1024
+TM_OVERHEAD_RATIO = 0.1
+TM_OVERHEAD_MIN_MB = 384
+
+
+def size_flink_memory(node_memory_bytes: int,
+                      node_cpus: int) -> Dict[str, int]:
+    """Session sizing from the node's resources: JM share (clamped),
+    TM process size after overheads, one slot per core."""
+    for_flink = int(node_memory_bytes / (1024 * 1024)
+                    * RESOURCE_MEMORY_RATIO)
+    jm = max(min(int(for_flink * JM_MEMORY_RATIO), JM_MEMORY_MAX_MB),
+             JM_MEMORY_MIN_MB)
+    tm_all = max(for_flink - jm - ADDITIONAL_OVERHEAD_MB,
+                 TM_OVERHEAD_MIN_MB + 512)
+    overhead = max(int(tm_all * TM_OVERHEAD_RATIO), TM_OVERHEAD_MIN_MB)
+    return {"jm_memory_mb": jm,
+            "tm_memory_mb": max(tm_all - overhead, 512),
+            "slots_per_tm": max(int(node_cpus), 1)}
+
+
 def render_flink_conf(jobmanager_ip: str,
                       jm_memory_mb: int = 1600,
                       tm_memory_mb: int = 1728,
@@ -69,15 +98,31 @@ class FlinkRuntime(ServiceRuntimeBase):
 
     def node_configure(self, node_context: Dict[str, Any]) -> None:
         import os
+        sized = self._sized(node_context)
         conf = render_flink_conf(
             node_context.get("head_ip", ""),
-            tm_memory_mb=int(
-                self.runtime_config.get("tm_memory_mb", 1728)),
-            slots_per_tm=int(
-                self.runtime_config.get("slots_per_tm", 2)))
+            jm_memory_mb=int(self.runtime_config.get(
+                "jm_memory_mb", sized["jm_memory_mb"])),
+            tm_memory_mb=int(self.runtime_config.get(
+                "tm_memory_mb", sized["tm_memory_mb"])),
+            slots_per_tm=int(self.runtime_config.get(
+                "slots_per_tm", sized["slots_per_tm"])))
         with open(os.path.join(self.conf_dir(node_context),
                                "flink-conf.yaml"), "w") as f:
             f.write(conf)
+
+    def _sized(self, node_context: Dict[str, Any]) -> Dict[str, int]:
+        """Auto-size from this node's detected resources (explicit
+        runtime_config values override per key)."""
+        try:
+            from cloudtik_tpu.utils.resource_spec import (
+                detect_node_resources)
+            res = detect_node_resources()
+            return size_flink_memory(
+                int(res.get("memory", 0)), int(res.get("CPU", 1)))
+        except Exception:
+            return {"jm_memory_mb": 1600, "tm_memory_mb": 1728,
+                    "slots_per_tm": 2}
 
     def get_processes(self):
         return [("StandaloneSessionClusterEntrypoint", False,

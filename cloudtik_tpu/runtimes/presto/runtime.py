@@ -80,14 +80,39 @@ class PrestoRuntime(ServiceRuntimeBase):
             node_id=node_context.get("node_id", "node"),
             environment=node_context.get("config", {}).get(
                 "workspace_name", "tik") or "tik")
+        ms = self._metastore(node_context)
+        if ms:
+            os.makedirs(os.path.join(conf_dir, "catalog"), exist_ok=True)
+            files[os.path.join("catalog", "hive.properties")] = \
+                render_hive_catalog(ms["host"], ms["port"])
+        for fname, content in files.items():
+            with open(os.path.join(conf_dir, fname), "w") as f:
+                f.write(content)
+
+    def _metastore(self, node_context) -> "Optional[Dict[str, Any]]":
+        """Catalog target: explicit metastore_uri beats discovery of a
+        metastore runtime in this or a connected cluster (same wiring
+        as trino; reference: presto's hive catalog from the metastore
+        head, runtime/presto/utils.py)."""
         metastore = self.runtime_config.get("metastore_uri")
         if metastore:
             # accept thrift://host:port, host:port, or bare host
             hostport = metastore.split("://", 1)[-1]
             host, _, port_s = hostport.partition(":")
-            os.makedirs(os.path.join(conf_dir, "catalog"), exist_ok=True)
-            files[os.path.join("catalog", "hive.properties")] = \
-                render_hive_catalog(host, int(port_s or 9083))
-        for fname, content in files.items():
-            with open(os.path.join(conf_dir, fname), "w") as f:
-                f.write(content)
+            return {"host": host, "port": int(port_s or 9083)}
+        from cloudtik_tpu.runtimes.common.discovery_client import (
+            discover_endpoint_for_config)
+        config = node_context.get("config", {})
+        state = node_context.get("state_client")
+
+        def factory():
+            if state is None:
+                return None
+            from cloudtik_tpu.runtimes.discovery.runtime import (
+                ServiceRegistry)
+            return ServiceRegistry(
+                state, cluster=config.get("cluster_name", ""),
+                workspace=config.get("workspace_name", ""))
+
+        return discover_endpoint_for_config(
+            config, "presto", "metastore", factory, 9083)

@@ -5,9 +5,13 @@ the reference runs a reaper daemon that kills the process tree when the
 parent dies, so a crashed node-services process never leaves orphaned
 runtime daemons.  On Linux the kernel does this directly:
 PR_SET_PDEATHSIG delivers a signal to the child when its parent thread
-dies.  `preexec()` is passed as Popen(preexec_fn=...) by every
-detached-service spawn path (runtime services, native state server,
-native host sampler)."""
+dies.
+
+`preexec()` is for PYTHON children only.  The native C++ daemons
+(state server, host sampler) arm PDEATHSIG themselves via their
+--fate-parent flag instead: a Popen preexec_fn forces fork()+exec,
+which both risks deadlock in a multithreaded (JAX) parent and blocks
+subprocess's posix_spawn fast path."""
 
 from __future__ import annotations
 

@@ -1,0 +1,133 @@
+"""Docker executor: config validation + /dev/shm sizing.
+
+Round-4 verdict item 8 tail: the docker layer wrapped exec but validated
+nothing — a docker section without an image failed at first node boot,
+and containers ran with the 64 MB default /dev/shm no matter what the
+runtimes needed (reference: docker.py:54 validate_docker_config,
+docker_command_executor.py:500 _auto_configure_shm).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from cloudtik_tpu.control.executor.docker import (
+    DockerCommandExecutor, validate_docker_config)
+
+MEMINFO = (
+    "MemTotal:       16384000 kB\n"
+    "MemFree:         2048000 kB\n"
+    "MemAvailable:    8192000 kB\n")
+
+
+class FakeHost:
+    def __init__(self, outputs=None):
+        self.commands = []
+        self.outputs = outputs or {}
+
+    def run(self, cmd, **kw):
+        self.commands.append(cmd)
+        for key, out in self.outputs.items():
+            if key in cmd:
+                return out
+        return ""
+
+    def run_rsync_up(self, *a, **k):
+        pass
+
+
+class TestValidateDockerConfig:
+    def test_valid(self):
+        validate_docker_config({"docker": {
+            "enabled": True, "image": "tik:latest"}})
+
+    def test_missing_image(self):
+        with pytest.raises(ValueError, match="image"):
+            validate_docker_config({"docker": {"enabled": True}})
+
+    def test_head_worker_images_suffice(self):
+        validate_docker_config({"docker": {
+            "enabled": True,
+            "head_image": "tik:head", "worker_image": "tik:worker"}})
+
+    def test_not_enabled_is_inert(self):
+        """Factory semantics: docker is OFF unless enabled is truthy —
+        a bare/disabled section must not be validated (it is never
+        used at runtime either)."""
+        validate_docker_config({"docker": {"enabled": False}})
+        validate_docker_config({"docker": {"image": "x"}})   # no enabled
+        validate_docker_config({})
+
+    def test_file_mount_warns(self, tmp_path, caplog):
+        f = tmp_path / "creds.json"
+        f.write_text("{}")
+        import logging
+        with caplog.at_level(logging.WARNING):
+            validate_docker_config({
+                "docker": {"enabled": True, "image": "i"},
+                "file_mounts": {"/remote/creds.json": str(f)}})
+        assert any("FILE" in r.message for r in caplog.records)
+
+    def test_config_validation_rejects_bad_docker(self):
+        from cloudtik_tpu.config.schema import (
+            ConfigError, validate_cluster_config)
+        config = {
+            "cluster_name": "c",
+            "provider": {"type": "virtual"},
+            "available_node_types": {
+                "head": {"node_config": {}, "resources": {}}},
+            "head_node_type": "head",
+            "docker": {"enabled": True},   # no image anywhere
+        }
+        with pytest.raises(ConfigError, match="image"):
+            validate_cluster_config(config)
+
+
+class TestShmSizing:
+    def _executor(self, host, docker_config=None):
+        return DockerCommandExecutor(
+            host, "tik", docker_config=docker_config or {
+                "container_name": "tik", "image": "tik:latest"})
+
+    def test_shm_size_from_host_memory(self):
+        host = FakeHost(outputs={"meminfo": MEMINFO, "docker ps": ""})
+        ex = self._executor(host)
+        ex.run_init(as_head=True, file_mounts={}, sync_run_yet=False,
+                    shared_memory_ratio=0.5)
+        run_cmd = next(c for c in host.commands if "docker run" in c)
+        # 8192000 kB avail * 1024 * 0.5 * 1.1
+        expect = int(8192000 * 1024 * 0.5 * 1.1)
+        assert f"--shm-size='{expect}b'" in run_cmd
+
+    def test_zero_ratio_no_shm_flag(self):
+        host = FakeHost(outputs={"docker ps": ""})
+        ex = self._executor(host)
+        ex.run_init(as_head=True, file_mounts={}, sync_run_yet=False)
+        run_cmd = next(c for c in host.commands if "docker run" in c)
+        assert "--shm-size" not in run_cmd
+
+    def test_explicit_shm_size_bypasses_detection(self):
+        host = FakeHost(outputs={"meminfo": MEMINFO, "docker ps": ""})
+        ex = self._executor(host, {
+            "container_name": "tik", "image": "tik:latest",
+            "run_options": ["--shm-size=4g"]})
+        ex.run_init(as_head=True, file_mounts={}, sync_run_yet=False,
+                    shared_memory_ratio=0.5)
+        run_cmd = next(c for c in host.commands if "docker run" in c)
+        assert run_cmd.count("--shm-size") == 1
+        assert "--shm-size=4g" in run_cmd
+
+    def test_unreadable_meminfo_degrades(self):
+        host = FakeHost(outputs={"docker ps": ""})   # no meminfo output
+        ex = self._executor(host)
+        ex.run_init(as_head=True, file_mounts={}, sync_run_yet=False,
+                    shared_memory_ratio=0.5)
+        run_cmd = next(c for c in host.commands if "docker run" in c)
+        assert "--shm-size" not in run_cmd
+
+    def test_ai_runtime_declares_ratio(self):
+        from cloudtik_tpu.control.updater import shared_memory_ratio
+        ratio = shared_memory_ratio(
+            {"runtime": {"types": ["ai"]}}, "head")
+        assert ratio == pytest.approx(0.3)
+        assert shared_memory_ratio({"runtime": {"types": []}}) == 0.0

@@ -1,0 +1,282 @@
+"""Gateways/pools follow the cluster's elected state (round-4 verdict 7).
+
+* Kong: the admin-API client drives services/routes/upstreams and DIFFS
+  upstream targets against discovery (add new, delete stale) — tested
+  against a fake admin REST server.
+* pgpool / pgbouncer: watch the postgres primary lease; on failover the
+  backend list / [databases] re-renders at the new primary and the pool
+  reloads.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from cloudtik_tpu.control.state import InMemoryStateBackend, StateClient
+from cloudtik_tpu.runtimes.common.failover import (
+    DBFailoverDaemon, PrimaryChangeWatcher, read_primary)
+from cloudtik_tpu.runtimes.discovery.runtime import ServiceRegistry
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+# -------------------------------------------------------------------------
+# fake Kong admin API
+# -------------------------------------------------------------------------
+
+class FakeKongAdmin:
+    """Enough of the admin REST surface for the client: PUT-by-name
+    entities + target collection with POST/DELETE."""
+
+    def __init__(self):
+        self.entities = {"services": {}, "routes": {}, "upstreams": {}}
+        self.targets = {}      # upstream -> {target: weight}
+        self.declarative = []  # POST /config payloads (DB-less mode)
+        store = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, obj=None):
+                body = json.dumps(obj or {}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self):
+                length = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(length) or b"{}")
+
+            def do_PUT(self):
+                kind, name = self.path.strip("/").split("/", 1)
+                store.entities.setdefault(kind, {})[name] = self._body()
+                self._send(200, {"name": name})
+
+            def do_GET(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) == 3 and parts[2] == "targets":
+                    data = [{"target": t, "weight": w} for t, w in
+                            store.targets.get(parts[1], {}).items()]
+                    self._send(200, {"data": data})
+                else:
+                    self._send(404)
+
+            def do_POST(self):
+                parts = self.path.strip("/").split("/")
+                body = self._body()
+                if parts == ["config"]:       # DB-less declarative swap
+                    store.declarative.append(body["config"])
+                    self._send(201, {})
+                    return
+                store.targets.setdefault(parts[1], {})[
+                    body["target"]] = body.get("weight", 100)
+                self._send(201, body)
+
+            def do_DELETE(self):
+                parts = self.path.strip("/").split("/")
+                store.targets.get(parts[1], {}).pop(parts[3], None)
+                self._send(204)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class TestKongAdminSync:
+    def test_sync_creates_and_diffs_targets(self):
+        from cloudtik_tpu.runtimes.kong.runtime import (
+            KongAdminClient, sync_gateway)
+        fake = FakeKongAdmin()
+        try:
+            admin = KongAdminClient(f"http://127.0.0.1:{fake.port}")
+            services = [{"name": "serving", "path": "/serve",
+                         "targets": [{"ip": "10.0.0.2", "port": 8200},
+                                     {"ip": "10.0.0.3", "port": 8200}]}]
+            sync_gateway(admin, services)
+            assert "serving.upstream" in fake.entities["upstreams"]
+            hc = fake.entities["upstreams"]["serving.upstream"][
+                "healthchecks"]["active"]
+            assert hc["http_path"] == "/healthz"
+            assert fake.entities["services"]["serving"]["host"] == \
+                "serving.upstream"
+            assert fake.entities["routes"]["serving-route"]["paths"] == \
+                ["/serve"]
+            assert set(fake.targets["serving.upstream"]) == \
+                {"10.0.0.2:8200", "10.0.0.3:8200"}
+
+            # a node is replaced: stale target removed, new one added
+            services[0]["targets"] = [{"ip": "10.0.0.3", "port": 8200},
+                                      {"ip": "10.0.0.4", "port": 8200}]
+            sync_gateway(admin, services)
+            assert set(fake.targets["serving.upstream"]) == \
+                {"10.0.0.3:8200", "10.0.0.4:8200"}
+        finally:
+            fake.stop()
+
+    def test_dbless_sync_posts_declarative_config(self):
+        """DB-less Kong (the default: kong.yml boot config) accepts
+        ONLY POST /config — the runtime's sync must swap the whole
+        declarative document, not PUT entities (those 405 there)."""
+        import yaml
+
+        from cloudtik_tpu.runtimes.discovery.runtime import ServiceRegistry
+        from cloudtik_tpu.runtimes.kong.runtime import (
+            KongAdminClient, KongRuntime)
+        fake = FakeKongAdmin()
+        try:
+            state = StateClient(InMemoryStateBackend())
+            reg = ServiceRegistry(state, "c1", "w1")
+            reg.register("serving", "n1", "10.0.0.2", 8200,
+                         protocol="http")
+            rt = KongRuntime({"admin_port": fake.port})
+            ctx = {"is_head": True, "node_id": "head",
+                   "state_client": state,
+                   "config": {"cluster_name": "c1",
+                              "workspace_name": "w1"}}
+            rt.sync_once(ctx, KongAdminClient(
+                f"http://127.0.0.1:{fake.port}"))
+            assert fake.declarative, "no POST /config issued"
+            doc = yaml.safe_load(fake.declarative[-1])
+            assert doc["_format_version"] == "3.0"
+            targets = doc["upstreams"][0]["targets"]
+            assert targets[0]["target"] == "10.0.0.2:8200"
+            # and no entity writes happened (DB-less would 405 them)
+            assert not fake.entities["services"]
+        finally:
+            fake.stop()
+
+    def test_runtime_start_reaches_sync_without_binary(self, tmp_path):
+        """The delivery start path must launch the sync daemon even
+        though kong has no service_command (the binary/daemon is
+        externally managed): round-4 review found post_start dead."""
+        from cloudtik_tpu.runtimes.kong.runtime import KongRuntime
+        state = StateClient(InMemoryStateBackend())
+        rt = KongRuntime({"sync_poll_s": 0.05})
+        ctx = {"is_head": True, "node_id": "head",
+               "state_client": state,
+               "config": {"cluster_name": "c1", "workspace_name": "w1"},
+               "conf_dir": str(tmp_path)}
+        synced = []
+        rt.sync_once = lambda _ctx, admin=None: synced.append(1)
+        try:
+            rt.node_services(ctx, "start")
+            assert _wait(lambda: synced, timeout=5)
+        finally:
+            rt.node_services(ctx, "stop")
+
+
+# -------------------------------------------------------------------------
+# pools follow the primary lease
+# -------------------------------------------------------------------------
+
+def _register_postgres(state):
+    registry = ServiceRegistry(state, "c1", "w1")
+    registry.register("postgres", "node-a", "10.0.0.1", 5432,
+                      tags={"role": "primary"})
+    registry.register("postgres-replica", "node-b", "10.0.0.2", 5432,
+                      tags={"role": "replica"})
+    return registry
+
+
+def _ctx(state, tmp_path):
+    return {"is_head": True, "node_id": "head", "node_ip": "10.0.0.1",
+            "head_ip": "10.0.0.1", "state_client": state,
+            "config": {"cluster_name": "c1", "workspace_name": "w1"},
+            "conf_dir": str(tmp_path)}
+
+
+class TestPoolsFollowPrimary:
+    def _failover(self, state):
+        """Elect a, then kill it so b takes the lease."""
+        a = DBFailoverDaemon(state, "postgres", "node-a", "10.0.0.1",
+                             5432, promote=lambda: None,
+                             initially_primary=True, cluster_name="c1",
+                             workspace_name="w1", ttl_s=1.0)
+        b = DBFailoverDaemon(state, "postgres", "node-b", "10.0.0.2",
+                             5432, promote=lambda: None,
+                             initially_primary=False, cluster_name="c1",
+                             workspace_name="w1", ttl_s=1.0)
+        a.start(poll_s=0.05)
+        assert _wait(lambda: a.is_primary)
+        b.start(poll_s=0.05)
+        return a, b
+
+    def test_read_primary_observer(self):
+        state = StateClient(InMemoryStateBackend())
+        a, b = self._failover(state)
+        assert read_primary(state, "postgres")["ip"] == "10.0.0.1"
+        a.stop()
+        assert _wait(
+            lambda: (read_primary(state, "postgres") or {}).get("ip")
+            == "10.0.0.2")
+        b.stop()
+
+    def test_pgpool_rerenders_and_reloads_on_failover(self, tmp_path):
+        from cloudtik_tpu.runtimes.pgpool.runtime import PgpoolRuntime
+        state = StateClient(InMemoryStateBackend())
+        _register_postgres(state)
+        a, b = self._failover(state)
+        ctx = _ctx(state, tmp_path)
+        rt = PgpoolRuntime({"follow_poll_s": 0.05})
+        reloads = []
+        rt.restart_service = lambda _ctx: reloads.append(1)
+        try:
+            rt.node_configure(ctx)
+            rt.post_start(ctx)
+            # initial observation renders the current primary (node-a)
+            assert _wait(lambda: reloads)
+            conf = (tmp_path / "pgpool.conf").read_text()
+            assert "backend_hostname0 = '10.0.0.1'" in conf
+            assert "backend_flag0 = 'ALWAYS_PRIMARY'" in conf
+
+            a.stop()
+            assert _wait(lambda: b.is_primary)
+            assert _wait(lambda: "backend_hostname0 = '10.0.0.2'" in
+                         (tmp_path / "pgpool.conf").read_text())
+            conf = (tmp_path / "pgpool.conf").read_text()
+            assert "backend_flag0 = 'ALWAYS_PRIMARY'" in conf
+            assert len(reloads) >= 2
+        finally:
+            rt.post_stop(ctx)
+            b.stop()
+
+    def test_pgbouncer_repoints_databases_on_failover(self, tmp_path):
+        from cloudtik_tpu.runtimes.pgbouncer.runtime import (
+            PgBouncerRuntime)
+        state = StateClient(InMemoryStateBackend())
+        _register_postgres(state)
+        a, b = self._failover(state)
+        ctx = _ctx(state, tmp_path)
+        rt = PgBouncerRuntime({"follow_poll_s": 0.05})
+        rt.reload_service = lambda _ctx: None
+        try:
+            rt.node_configure(ctx)
+            rt.post_start(ctx)
+            assert _wait(lambda: "host=10.0.0.1" in
+                         (tmp_path / "pgbouncer.ini").read_text())
+            a.stop()
+            assert _wait(lambda: "host=10.0.0.2" in
+                         (tmp_path / "pgbouncer.ini").read_text())
+        finally:
+            rt.post_stop(ctx)
+            b.stop()

@@ -169,6 +169,106 @@ class TestHDFS:
         site = render_hdfs_site(is_namenode=True, replication=2)
         assert "<value>2</value>" in site
 
+    def test_dirs_are_absolute_file_uris(self):
+        """hadoop does not expand '~' — a literal tilde in the dir
+        properties silently creates a './~' tree."""
+        site = render_hdfs_site(is_namenode=True)
+        assert "~" not in site
+        assert "file:///" in site
+
+    def test_namenode_format_once(self, tmp_path, monkeypatch):
+        """First boot formats the NN metadata dir; every later boot sees
+        hadoop's current/VERSION marker and must NOT reformat (a
+        reformat orphans all DataNode blocks under a new clusterID)."""
+        import subprocess
+
+        from cloudtik_tpu.runtimes.hdfs.runtime import HDFSRuntime
+        name_dir = tmp_path / "name"
+        rt = HDFSRuntime({"name_dir": str(name_dir)})
+        monkeypatch.setattr(rt, "find_binary", lambda: "/usr/bin/hdfs")
+        calls = []
+
+        def fake_run(cmd, **kw):
+            calls.append(cmd)
+            # the real format writes current/VERSION
+            (name_dir / "current").mkdir(parents=True, exist_ok=True)
+            (name_dir / "current" / "VERSION").write_text("clusterID=x")
+
+        monkeypatch.setattr(subprocess, "run", fake_run)
+        ctx = {"is_head": True, "conf_dir": str(tmp_path / "conf")}
+        assert rt.maybe_format_namenode(ctx) is True
+        assert any("-format" in c for c in calls[0])
+        # second boot: marker present -> no reformat
+        assert rt.maybe_format_namenode(ctx) is False
+        assert len(calls) == 1
+
+    def test_datanode_command_has_no_format(self, tmp_path, monkeypatch):
+        from cloudtik_tpu.runtimes.hdfs.runtime import HDFSRuntime
+        rt = HDFSRuntime({})
+        monkeypatch.setattr(rt, "find_binary", lambda: "/usr/bin/hdfs")
+        cmd = rt.service_command(
+            {"is_head": False, "conf_dir": str(tmp_path)})
+        assert cmd[-1] == "datanode"
+
+
+class TestFlinkSizing:
+    def test_session_sizing_from_node_resources(self):
+        from cloudtik_tpu.runtimes.flink.runtime import size_flink_memory
+        sized = size_flink_memory(64 * 1024 ** 3, 16)
+        # 64G node: 80% schedulable, JM 2% clamped to [1G, 8G]
+        assert sized["jm_memory_mb"] == 1048      # 52428 * 0.02
+        assert sized["slots_per_tm"] == 16
+        # TM gets the rest minus the JM, fixed overhead, and 10% TM
+        # overhead — well above the floor for a 64G node
+        assert 40_000 < sized["tm_memory_mb"] < 52_428
+
+    def test_jm_clamps(self):
+        from cloudtik_tpu.runtimes.flink.runtime import (
+            JM_MEMORY_MAX_MB, JM_MEMORY_MIN_MB, size_flink_memory)
+        small = size_flink_memory(4 * 1024 ** 3, 2)
+        assert small["jm_memory_mb"] == JM_MEMORY_MIN_MB
+        huge = size_flink_memory(1024 * 1024 ** 3, 96)
+        assert huge["jm_memory_mb"] == JM_MEMORY_MAX_MB
+
+    def test_explicit_config_overrides(self, tmp_path):
+        from cloudtik_tpu.runtimes.flink.runtime import FlinkRuntime
+        rt = FlinkRuntime({"tm_memory_mb": 2048, "slots_per_tm": 4,
+                           "jm_memory_mb": 1200})
+        ctx = {"is_head": True, "head_ip": "10.0.0.1",
+               "conf_dir": str(tmp_path)}
+        rt.node_configure(ctx)
+        conf = (tmp_path / "flink-conf.yaml").read_text()
+        assert "taskmanager.memory.process.size: 2048m" in conf
+        assert "taskmanager.numberOfTaskSlots: 4" in conf
+        assert "jobmanager.memory.process.size: 1200m" in conf
+
+
+class TestPrestoCatalogDiscovery:
+    def test_catalog_from_registry(self, tmp_path):
+        from cloudtik_tpu.runtimes.presto.runtime import PrestoRuntime
+        state = StateClient(InMemoryStateBackend())
+        reg = ServiceRegistry(state, cluster="c1", workspace="w1")
+        reg.register("metastore", "head", "10.0.0.9", 9083)
+        rt = PrestoRuntime({})
+        ctx = {"is_head": True, "head_ip": "10.0.0.1", "node_id": "head",
+               "state_client": state,
+               "config": {"cluster_name": "c1", "workspace_name": "w1"},
+               "conf_dir": str(tmp_path / "presto")}
+        rt.node_configure(ctx)
+        catalog = (tmp_path / "presto" / "catalog" /
+                   "hive.properties").read_text()
+        assert "thrift://10.0.0.9:9083" in catalog
+
+    def test_explicit_uri_beats_discovery(self, tmp_path):
+        from cloudtik_tpu.runtimes.presto.runtime import PrestoRuntime
+        rt = PrestoRuntime({"metastore_uri": "thrift://10.1.1.1:9999"})
+        ctx = {"is_head": True, "head_ip": "10.0.0.1", "node_id": "head",
+               "config": {}, "conf_dir": str(tmp_path / "presto")}
+        rt.node_configure(ctx)
+        catalog = (tmp_path / "presto" / "catalog" /
+                   "hive.properties").read_text()
+        assert "thrift://10.1.1.1:9999" in catalog
+
 
 class TestMetastore:
     def test_hive_site_mysql(self):
