@@ -35,11 +35,27 @@ def build_run_instances_request(
     for src, dst in (("v_switch_id", "VSwitchId"),
                      ("security_group_id", "SecurityGroupId"),
                      ("key_pair_name", "KeyPairName"),
-                     ("system_disk_size", "SystemDisk.Size")):
+                     ("system_disk_size", "SystemDisk.Size"),
+                     # placement (reference aliyun/config.py options):
+                     # zone pinning + deployment sets (ECS's spread
+                     # placement groups) + dedicated hosts
+                     ("zone_id", "ZoneId"),
+                     ("deployment_set_id", "DeploymentSetId"),
+                     ("dedicated_host_id", "DedicatedHostId")):
         if src in node_config:
             req[dst] = node_config[src]
     if node_config.get("spot"):
-        req["SpotStrategy"] = "SpotAsPriceGo"
+        # preemptible capacity: price-capped when spot_price_limit is
+        # given, market-price otherwise; SpotDuration=0 means no
+        # protected hour (reclaim any time, cheapest)
+        limit = node_config.get("spot_price_limit")
+        if limit is not None:
+            req["SpotStrategy"] = "SpotWithPriceLimit"
+            req["SpotPriceLimit"] = float(limit)
+        else:
+            req["SpotStrategy"] = "SpotAsPriceGo"
+        if "spot_duration" in node_config:
+            req["SpotDuration"] = int(node_config["spot_duration"])
     return req
 
 
@@ -49,6 +65,7 @@ def workspace_resource_names(workspace: str) -> Dict[str, str]:
         "vswitch": f"tik-{workspace}-vswitch",
         "security_group": f"tik-{workspace}-sg",
         "nat": f"tik-{workspace}-nat",
+        "eip": f"tik-{workspace}-eip",
         "ram_role": f"tik-{workspace}-role",
         "bucket": f"tik-{workspace}-data",
     }

@@ -90,10 +90,65 @@ class AliyunWorkspaceProvider(WorkspaceProvider):
             self.vpc.authorize_security_group(
                 security_group_id=group_id, ip_protocol="all",
                 port_range="-1/-1", source_cidr_ip="10.30.0.0/16")
-        nats = self.vpc.describe_nat_gateways(vpc_id=vpc_id)
-        if not nats.get("NatGateways", {}).get("NatGateway", []):
-            self.vpc.create_nat_gateway(vpc_id=vpc_id,
-                                        name=self.names["nat"])
+        nats = self.vpc.describe_nat_gateways(vpc_id=vpc_id).get(
+            "NatGateways", {}).get("NatGateway", [])
+        if not nats:
+            created = self.vpc.create_nat_gateway(
+                vpc_id=vpc_id, name=self.names["nat"])
+            nat_id = created["NatGatewayId"]
+        else:
+            nat_id = nats[0]["NatGatewayId"]
+        self._ensure_nat_egress(nat_id)
+        self._ensure_ram_role()
+
+    def _ensure_nat_egress(self, nat_id: str) -> None:
+        """A NAT gateway alone routes nothing: egress needs an EIP bound
+        to it plus an SNAT entry for the workspace CIDR (reference:
+        aliyun/config.py's EIP + SNAT provisioning)."""
+        eips = self.vpc.describe_eip_addresses(
+            name=self.names["eip"]).get(
+                "EipAddresses", {}).get("EipAddress", [])
+        if not eips:
+            eip = self.vpc.allocate_eip_address(name=self.names["eip"])
+        else:
+            eip = eips[0]
+        # idempotent re-run after a partial failure: an allocated but
+        # never-associated EIP must still get bound, or the SNAT entry
+        # points at an address that routes nothing
+        if not eip.get("InstanceId"):
+            self.vpc.associate_eip_address(
+                allocation_id=eip["AllocationId"], instance_id=nat_id,
+                instance_type="Nat")
+        eip_ip = eip.get("IpAddress", "")
+        snats = self.vpc.describe_snat_table_entries(
+            nat_gateway_id=nat_id).get(
+                "SnatTableEntries", {}).get("SnatTableEntry", [])
+        if not snats:
+            self.vpc.create_snat_entry(
+                nat_gateway_id=nat_id, source_cidr="10.30.0.0/16",
+                snat_ip=eip_ip)
+
+    def _ensure_ram_role(self) -> None:
+        """Instance RAM role with OSS access, so cluster nodes reach the
+        workspace bucket without static keys (reference: aliyun
+        config.py's RAM role + policy attachment).  Skipped when no
+        ram_client is injected — the role must then pre-exist."""
+        ram = self.provider_config.get("ram_client")
+        if ram is None:
+            return
+        roles = ram.list_roles().get("Roles", {}).get("Role", [])
+        if any(r.get("RoleName") == self.names["ram_role"]
+               for r in roles):
+            return
+        ram.create_role(
+            role_name=self.names["ram_role"],
+            assume_role_policy_document=(
+                '{"Statement": [{"Action": "sts:AssumeRole", '
+                '"Effect": "Allow", "Principal": {"Service": '
+                '["ecs.aliyuncs.com"]}}], "Version": "1"}'))
+        ram.attach_policy_to_role(
+            policy_type="System", policy_name="AliyunOSSFullAccess",
+            role_name=self.names["ram_role"])
 
     def delete_workspace(self, config: Dict[str, Any],
                          delete_managed_storage: bool = False,
@@ -104,8 +159,28 @@ class AliyunWorkspaceProvider(WorkspaceProvider):
         vpc_id = vpc_obj["VpcId"]
         for nat in self.vpc.describe_nat_gateways(vpc_id=vpc_id).get(
                 "NatGateways", {}).get("NatGateway", []):
+            for entry in self.vpc.describe_snat_table_entries(
+                    nat_gateway_id=nat["NatGatewayId"]).get(
+                        "SnatTableEntries", {}).get("SnatTableEntry", []):
+                self.vpc.delete_snat_entry(
+                    snat_entry_id=entry["SnatEntryId"])
             self.vpc.delete_nat_gateway(
                 nat_gateway_id=nat["NatGatewayId"])
+        for eip in self.vpc.describe_eip_addresses(
+                name=self.names["eip"]).get(
+                    "EipAddresses", {}).get("EipAddress", []):
+            self.vpc.release_eip_address(
+                allocation_id=eip["AllocationId"])
+        ram = self.provider_config.get("ram_client")
+        if ram is not None:
+            roles = ram.list_roles().get("Roles", {}).get("Role", [])
+            if any(r.get("RoleName") == self.names["ram_role"]
+                   for r in roles):
+                ram.detach_policy_from_role(
+                    policy_type="System",
+                    policy_name="AliyunOSSFullAccess",
+                    role_name=self.names["ram_role"])
+                ram.delete_role(role_name=self.names["ram_role"])
         group = self._find_security_group(vpc_id)
         if group is not None:
             self.vpc.delete_security_group(

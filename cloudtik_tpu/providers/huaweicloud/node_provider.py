@@ -34,6 +34,26 @@ def build_create_servers_request(
     }
     if node_config.get("key_name"):
         server["key_name"] = node_config["key_name"]
+    # placement: AZ pinning + anti-affinity server groups (reference
+    # huaweicloud/config.py options)
+    if node_config.get("availability_zone"):
+        server["availability_zone"] = node_config["availability_zone"]
+    scheduler_hints: Dict[str, Any] = {}
+    if node_config.get("server_group_id"):
+        scheduler_hints["group"] = node_config["server_group_id"]
+    if scheduler_hints:
+        server["os:scheduler_hints"] = scheduler_hints
+    # preemptible capacity: spot billing via extendparam, optionally
+    # price-capped; interruption policy immediate-delete matches how
+    # the scaler treats reclaimed nodes (recycle the group)
+    extendparam: Dict[str, Any] = {}
+    if node_config.get("spot"):
+        extendparam["marketType"] = "spot"
+        if node_config.get("spot_price") is not None:
+            extendparam["spotPrice"] = str(node_config["spot_price"])
+        extendparam["interruption_policy"] = "immediate"
+    if extendparam:
+        server["extendparam"] = extendparam
     return {"server": server}
 
 
@@ -43,6 +63,7 @@ def workspace_resource_names(workspace: str) -> Dict[str, str]:
         "subnet": f"tik-{workspace}-subnet",
         "security_group": f"tik-{workspace}-sg",
         "nat": f"tik-{workspace}-nat",
+        "eip": f"tik-{workspace}-eip",
         "agency": f"tik-{workspace}-agency",
         "bucket": f"tik-{workspace}-data",
     }

@@ -84,17 +84,67 @@ class HuaweiCloudWorkspaceProvider(WorkspaceProvider):
                 security_group_id=group["id"], direction="ingress",
                 protocol=None, remote_ip_prefix="10.40.0.0/16")
         nats = self.vpc.list_nat_gateways().get("nat_gateways", [])
-        if self._find(nats, "name", self.names["nat"]) is None:
-            self.vpc.create_nat_gateway(
+        nat = self._find(nats, "name", self.names["nat"])
+        if nat is None:
+            nat = self.vpc.create_nat_gateway(
                 name=self.names["nat"], router_id=vpc_id,
-                internal_network_id=self._find_subnet(vpc_id)["id"])
+                internal_network_id=self._find_subnet(vpc_id)["id"])[
+                    "nat_gateway"]
+        self._ensure_snat(nat["id"])
+        self._ensure_agency()
+
+    def _ensure_snat(self, nat_id: str) -> None:
+        """Egress needs a bound EIP plus an SNAT rule for the subnet
+        CIDR — the gateway alone routes nothing (reference:
+        huaweicloud/config.py EIP + SNAT provisioning)."""
+        eips = self.vpc.list_eips().get("publicips", [])
+        eip = self._find(eips, "alias", self.names["eip"])
+        if eip is None:
+            eip = self.vpc.create_eip(
+                alias=self.names["eip"])["publicip"]
+        rules = self.vpc.list_snat_rules(
+            nat_gateway_id=nat_id).get("snat_rules", [])
+        if not rules:
+            self.vpc.create_snat_rule(
+                nat_gateway_id=nat_id, cidr="10.40.0.0/16",
+                floating_ip_id=eip["id"])
+
+    def _ensure_agency(self) -> None:
+        """Cloud agency granting nodes OBS access without static keys
+        (reference: huaweicloud config.py's agency + role grant).
+        Skipped when no iam_client is injected — the agency must then
+        pre-exist."""
+        iam = self.provider_config.get("iam_client")
+        if iam is None:
+            return
+        agencies = iam.list_agencies().get("agencies", [])
+        if self._find(agencies, "name", self.names["agency"]):
+            return
+        created = iam.create_agency(
+            name=self.names["agency"], trust_domain_name="op_svc_ecs",
+            description="tik workspace node agency")
+        iam.grant_agency_role(
+            agency_id=created["agency"]["id"], role_name="OBS Administrator")
 
     def delete_workspace(self, config: Dict[str, Any],
                          delete_managed_storage: bool = False,
                          delete_managed_database: bool = False) -> None:
         for nat in self.vpc.list_nat_gateways().get("nat_gateways", []):
             if nat.get("name") == self.names["nat"]:
+                for rule in self.vpc.list_snat_rules(
+                        nat_gateway_id=nat["id"]).get("snat_rules", []):
+                    self.vpc.delete_snat_rule(snat_rule_id=rule["id"])
                 self.vpc.delete_nat_gateway(nat_gateway_id=nat["id"])
+        for eip in self.vpc.list_eips().get("publicips", []):
+            if eip.get("alias") == self.names["eip"]:
+                self.vpc.delete_eip(publicip_id=eip["id"])
+        iam = self.provider_config.get("iam_client")
+        if iam is not None:
+            agency = self._find(
+                iam.list_agencies().get("agencies", []),
+                "name", self.names["agency"])
+            if agency is not None:
+                iam.delete_agency(agency_id=agency["id"])
         group = self._find_security_group()
         if group is not None:
             self.vpc.delete_security_group(security_group_id=group["id"])

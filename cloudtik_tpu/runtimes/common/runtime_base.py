@@ -19,6 +19,52 @@ HEAD = "head"
 WORKER = "worker"
 ALL_NODES = "node"
 
+# Process-wide registry of background daemons (failover elections,
+# primary watchers, gateway sync loops) keyed by instance_key.  Delivery
+# creates a FRESH runtime instance per start/stop invocation, so a
+# daemon stored on `self` at start is unreachable from the instance
+# handling stop — the same lifetime problem the serving runtime's
+# `_servers` registry solves for in-process servers.
+_DAEMONS: Dict[Tuple[str, str], List[Any]] = {}
+
+
+class LoopDaemon:
+    """Background loop calling `fn()` every `poll_s` until stop() — the
+    shared shape of the gateway sync loops.  Persistent failures are
+    escalated to a warning once instead of being silently retried
+    forever."""
+
+    def __init__(self, name: str, fn, poll_s: float):
+        import threading
+        self.name = name
+        self._fn = fn
+        self._poll_s = poll_s
+        self._stop = threading.Event()
+        self._thread: Optional[Any] = None
+
+    def _loop(self) -> None:
+        import logging
+        logger = logging.getLogger(__name__)
+        failures = 0
+        while not self._stop.wait(self._poll_s):
+            try:
+                self._fn()
+                failures = 0
+            except Exception:
+                failures += 1
+                log = logger.warning if failures == 6 else logger.debug
+                log("%s failing (%d consecutive)", self.name, failures,
+                    exc_info=failures == 6)
+
+    def start(self) -> None:
+        import threading
+        self._thread = threading.Thread(
+            target=self._loop, name=self.name, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
 
 class ServiceRuntimeBase(Runtime):
     """Declarative base for service runtimes.
@@ -44,6 +90,10 @@ class ServiceRuntimeBase(Runtime):
     QUORUM: bool = False
     ENDPOINT_NAME: Optional[str] = None
     DEPENDENCIES: List[str] = []
+    # True: the service process is started by its own packaging (distro
+    # service); node_services renders config + runs post_start (sync
+    # daemons) but spawns nothing
+    EXTERNAL_SERVICE: bool = False
 
     @property
     def port(self) -> int:
@@ -117,6 +167,27 @@ class ServiceRuntimeBase(Runtime):
         port must not collide (round-4 verdict weak #3)."""
         cfg = node_context.get("config") or {}
         return (cfg.get("cluster_name", ""), self.SERVICE_NAME)
+
+    # -- background daemons -----------------------------------------------
+    def has_daemons(self, node_context: Dict[str, Any]) -> bool:
+        return bool(_DAEMONS.get(self.instance_key(node_context)))
+
+    def register_daemon(self, node_context: Dict[str, Any],
+                        daemon: Any) -> Any:
+        """Track a started daemon (an object with .stop()) so the stop
+        path — which runs on a DIFFERENT runtime instance — can find and
+        stop it.  node_services('stop') stops all of this runtime's
+        registered daemons automatically."""
+        _DAEMONS.setdefault(self.instance_key(node_context),
+                            []).append(daemon)
+        return daemon
+
+    def stop_daemons(self, node_context: Dict[str, Any]) -> None:
+        for daemon in _DAEMONS.pop(self.instance_key(node_context), []):
+            try:
+                daemon.stop()
+            except Exception:
+                pass
 
     def runs_on(self, node_context: Dict[str, Any]) -> bool:
         if self.NODE_KIND == ALL_NODES:
@@ -237,6 +308,7 @@ class ServiceRuntimeBase(Runtime):
         name = self.SERVICE_NAME
         if command == "stop":
             self.post_stop(node_context)
+            self.stop_daemons(node_context)
             process_runner.stop_service(name)
             self._deregister(node_context)
             return
@@ -244,6 +316,11 @@ class ServiceRuntimeBase(Runtime):
             raise ValueError(f"unknown services command {command!r}")
         cmd = self.service_command(node_context)
         if cmd is None:
+            # EXTERNAL_SERVICE runtimes (kong, apisix) manage their own
+            # process; the start path still runs post_start so their
+            # sync daemons come up
+            if self.EXTERNAL_SERVICE:
+                self.post_start(node_context)
             return
         process_runner.spawn_service(
             name, cmd, env=self.service_env(node_context))

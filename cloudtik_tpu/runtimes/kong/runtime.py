@@ -21,13 +21,12 @@ from __future__ import annotations
 
 import json
 import logging
-import threading
 import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional
 
 from cloudtik_tpu.runtimes.common.runtime_base import (
-    HEAD, ServiceRuntimeBase)
+    HEAD, LoopDaemon, ServiceRuntimeBase)
 
 logger = logging.getLogger(__name__)
 
@@ -153,6 +152,7 @@ class KongRuntime(ServiceRuntimeBase):
     PROTOCOL = "http"
     NODE_KIND = HEAD
     PROCESS_KEYWORD = "kong"
+    EXTERNAL_SERVICE = True   # kong start daemonizes via its packaging
     ENDPOINT_NAME = "Kong API Gateway"
 
     def node_configure(self, node_context: Dict[str, Any]) -> None:
@@ -170,19 +170,6 @@ class KongRuntime(ServiceRuntimeBase):
         return int(self.runtime_config.get("admin_port",
                                            KONG_ADMIN_PORT))
 
-    def node_services(self, node_context: Dict[str, Any],
-                      command: str) -> None:
-        """Kong itself is typically started by its own packaging (`kong
-        start` daemonizes through the distro service) — this runtime
-        renders config and runs the admin sync.  The base start path
-        returns before post_start when there is no service command, so
-        invoke the sync hook explicitly in that externally-managed
-        case."""
-        super().node_services(node_context, command)
-        if command == "start" and self.runs_on(node_context) and \
-                self.service_command(node_context) is None:
-            self.post_start(node_context)
-
     def sync_once(self, node_context: Dict[str, Any],
                   admin: Optional[KongAdminClient] = None) -> None:
         """One reconfiguration pass against the admin API."""
@@ -198,40 +185,20 @@ class KongRuntime(ServiceRuntimeBase):
     def post_start(self, node_context: Dict[str, Any]) -> None:
         """Live admin-API sync: the gateway keeps tracking discovery
         while serving.  Skippable (admin_sync: false) for strictly
-        static declarative deployments."""
+        static declarative deployments.  The daemon is registered
+        process-wide so the stop path (a different runtime instance)
+        can stop it."""
         if not self.runtime_config.get("admin_sync", True):
             return
         if node_context.get("state_client") is None:
             return
-        if getattr(self, "_sync_stop", None) is not None:
-            return   # already running (explicit + base invocation)
-        poll_s = float(self.runtime_config.get("sync_poll_s", 10.0))
-        stop = threading.Event()
-
-        def loop():
-            failures = 0
-            while not stop.wait(poll_s):
-                try:
-                    self.sync_once(node_context)
-                    failures = 0
-                except Exception:
-                    # admin API not up yet / transient: retry next tick,
-                    # but escalate persistent failure to a warning
-                    failures += 1
-                    log = (logger.warning if failures == 6
-                           else logger.debug)
-                    log("kong admin sync failing (%d consecutive)",
-                        failures, exc_info=failures == 6)
-
-        self._sync_stop = stop
-        threading.Thread(target=loop, daemon=True,
-                         name="tik-kong-sync").start()
-
-    def post_stop(self, node_context: Dict[str, Any]) -> None:
-        stop = getattr(self, "_sync_stop", None)
-        if stop is not None:
-            stop.set()
-            self._sync_stop = None
+        if self.has_daemons(node_context):
+            return
+        daemon = LoopDaemon(
+            "tik-kong-sync", lambda: self.sync_once(node_context),
+            float(self.runtime_config.get("sync_poll_s", 10.0)))
+        daemon.start()
+        self.register_daemon(node_context, daemon)
 
 
 def _discovered_http_services(node_context: Dict[str, Any],

@@ -147,9 +147,4 @@ class MongoDBRuntime(ServiceRuntimeBase):
             workspace_name=config.get("workspace_name", ""),
             poll_s=float(self.runtime_config.get("watch_poll_s", 2.0)))
         self._watch.start()
-
-    def post_stop(self, node_context: Dict[str, Any]) -> None:
-        watch = getattr(self, "_watch", None)
-        if watch is not None:
-            watch.stop()
-            self._watch = None
+        self.register_daemon(node_context, self._watch)

@@ -154,9 +154,5 @@ class MySQLRuntime(ServiceRuntimeBase):
                     "replication_user", "replicator"),
                 password=self.runtime_config.get(
                     "replication_password", ""))))
-
-    def post_stop(self, node_context: Dict[str, Any]) -> None:
-        daemon = getattr(self, "_failover", None)
-        if daemon is not None:
-            daemon.stop()
-            self._failover = None
+        if self._failover is not None:
+            self.register_daemon(node_context, self._failover)

@@ -93,24 +93,21 @@ class PgBouncerRuntime(ServiceRuntimeBase):
 
     def post_start(self, node_context: Dict[str, Any]) -> None:
         """Follow the elected postgres primary (round-4 verdict item 7):
-        on every lease change re-point [databases] and SIGHUP."""
+        on every lease change re-point [databases] and SIGHUP.  The
+        watcher is registered process-wide so the stop path (a
+        different runtime instance) can stop it."""
         from cloudtik_tpu.runtimes.common.failover import (
             PrimaryChangeWatcher)
         state = node_context.get("state_client")
-        if state is None:
+        if state is None or self.has_daemons(node_context):
             return
 
         def on_change(primary):
             self.rerender_for_primary(node_context, primary)
             self.reload_service(node_context)
 
-        self._watch = PrimaryChangeWatcher(
+        watch = PrimaryChangeWatcher(
             state, "postgres", on_change,
             poll_s=float(self.runtime_config.get("follow_poll_s", 1.0)))
-        self._watch.start()
-
-    def post_stop(self, node_context: Dict[str, Any]) -> None:
-        watch = getattr(self, "_watch", None)
-        if watch is not None:
-            watch.stop()
-            self._watch = None
+        watch.start()
+        self.register_daemon(node_context, watch)

@@ -99,27 +99,24 @@ class PgpoolRuntime(ServiceRuntimeBase):
         """Round-4 verdict item 7: the pool must FOLLOW the elected
         postgres primary — watch the primary lease and re-render +
         restart on every change, so writes route to the promoted node
-        instead of the corpse the boot-time render pointed at."""
+        instead of the corpse the boot-time render pointed at.  The
+        watcher is registered process-wide so the stop path (a
+        different runtime instance) can stop it."""
         from cloudtik_tpu.runtimes.common.failover import (
             PrimaryChangeWatcher)
         state = node_context.get("state_client")
-        if state is None:
+        if state is None or self.has_daemons(node_context):
             return
 
         def on_change(primary):
             self.rerender_for_primary(node_context, primary)
             self.restart_service(node_context)
 
-        self._watch = PrimaryChangeWatcher(
+        watch = PrimaryChangeWatcher(
             state, "postgres", on_change,
             poll_s=float(self.runtime_config.get("follow_poll_s", 1.0)))
-        self._watch.start()
-
-    def post_stop(self, node_context: Dict[str, Any]) -> None:
-        watch = getattr(self, "_watch", None)
-        if watch is not None:
-            watch.stop()
-            self._watch = None
+        watch.start()
+        self.register_daemon(node_context, watch)
 
 
 def _postgres_backends(node_context: Dict[str, Any]

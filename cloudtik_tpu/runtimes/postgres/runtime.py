@@ -161,9 +161,7 @@ class PostgresRuntime(ServiceRuntimeBase):
 
         self._failover = spawn_db_failover(
             self, node_context, promote, follow=follow)
-
-    def post_stop(self, node_context: Dict[str, Any]) -> None:
-        daemon = getattr(self, "_failover", None)
-        if daemon is not None:
-            daemon.stop()
-            self._failover = None
+        if self._failover is not None:
+            # process-wide registration: the stop path runs on a fresh
+            # runtime instance, which finds the daemon via the registry
+            self.register_daemon(node_context, self._failover)

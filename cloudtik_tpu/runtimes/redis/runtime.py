@@ -113,9 +113,5 @@ class RedisRuntime(ServiceRuntimeBase):
             follow=lambda meta: self.run_cli(
                 "replicaof", str(meta.get("ip", "")),
                 str(meta.get("port", self.port))))
-
-    def post_stop(self, node_context: Dict[str, Any]) -> None:
-        daemon = getattr(self, "_failover", None)
-        if daemon is not None:
-            daemon.stop()
-            self._failover = None
+        if self._failover is not None:
+            self.register_daemon(node_context, self._failover)

@@ -40,6 +40,12 @@ class ServingRuntime(ServiceRuntimeBase):
         gbdt_path = self.runtime_config.get("gbdt_model")
         if gbdt_path:
             return [S.gbdt_backend(gbdt_path)]
+        if self.runtime_config.get("engine"):
+            return [S.engine_backend(
+                self.runtime_config.get("model", "tiny"),
+                checkpoint_dir=self.runtime_config.get("checkpoint_dir"),
+                slots=int(self.runtime_config.get("slots", 4)),
+                max_len=int(self.runtime_config.get("max_len", 512)))]
         return [S.transformer_backend(
             self.runtime_config.get("model", "tiny"),
             checkpoint_dir=self.runtime_config.get("checkpoint_dir"))]
@@ -67,6 +73,10 @@ class ServingRuntime(ServiceRuntimeBase):
             server = _servers.pop(key, None)
             if server is not None:
                 server.stop()
+                for backend in getattr(server, "backends", []):
+                    engine = getattr(backend, "engine", None)
+                    if engine is not None:
+                        engine.stop()
             self._deregister(node_context)
 
     def get_runtime_services(self, cluster_config, cluster_head_ip):

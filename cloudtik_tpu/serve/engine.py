@@ -1,0 +1,373 @@
+"""Continuous-batching decode engine for the transformer family.
+
+Reference parity: the serving half of the AI runtime (SURVEY.md §2.3's
+model serving + §2.8's serving latency harness).  tik-serve's plain
+backend jits one program per request shape; this engine is the
+TPU-first upgrade: requests of different lengths DECODE TOGETHER in one
+resident program, and new requests join while others are mid-decode
+(continuous batching), so serving throughput comes from the MXU's
+batch dimension instead of request-at-a-time latency.
+
+Design:
+
+* One shared static KV cache `[L, slots, max_len, Hkv, Dh]`.  A request
+  occupies one slot from admission to completion; slot state (length,
+  remaining budget, eos) lives host-side.
+* PREFILL per request: the prompt is padded to a power-of-two bucket
+  and run through `generate.forward_step` with a single-slot cache (one
+  compile per bucket), then the filled K/V planes are inserted into the
+  shared cache at the slot index.  Padded junk beyond the true length
+  is never read: the decode attention masks `t <= length[slot]` and
+  later writes overwrite it.
+* DECODE: ONE jitted step for all slots, compiled once.  Per-slot
+  lengths drive per-slot RoPE positions, per-slot scatter writes
+  (`cache.at[slot, length]`), and per-slot causal masks — that is what
+  lets a freshly admitted 7-token request share a step with one that is
+  500 tokens in.  Inactive slots are masked (their state does not
+  advance).
+* Sampling on device: greedy / per-slot temperature (traced — no
+  recompiles per request), engine-level static top_k.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cloudtik_tpu.models.generate import (
+    _NEG, _rms_norm, forward_step, init_cache)
+from cloudtik_tpu.models.transformer import (
+    TransformerConfig, _embed_lookup, _lm_head, _rope)
+
+logger = logging.getLogger(__name__)
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    slots: int = 4                    # concurrent decode lanes
+    max_len: int = 512                # cache capacity per slot
+    prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128, 256)
+    top_k: int = 0                    # static (part of the decode jit)
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: "Request"
+    length: int                       # tokens in cache
+    remaining: int                    # new tokens still wanted
+
+
+class Request:
+    """One generation request; wait() blocks until tokens are ready."""
+
+    def __init__(self, prompt: List[int], max_new_tokens: int = 32,
+                 temperature: float = 0.0,
+                 eos_id: Optional[int] = None):
+        self.prompt = list(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.eos_id = eos_id
+        self.tokens: List[int] = []
+        self.error: Optional[Exception] = None
+        self._done = threading.Event()
+
+    def wait(self, timeout: Optional[float] = None) -> List[int]:
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation did not complete in time")
+        if self.error is not None:
+            raise self.error
+        return self.tokens
+
+
+def _decode_layer(cfg: TransformerConfig, x: jax.Array, layer: Params,
+                  ck: jax.Array, cv: jax.Array, lengths: jax.Array,
+                  active: jax.Array
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One layer, one token per slot.  x [B,1,d]; ck/cv [B,T,Hkv,Dh];
+    lengths [B] int32 (per-slot absolute position); active [B] bool."""
+    B = x.shape[0]
+    positions = lengths[:, None]                      # [B,1]
+    h = _rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(cfg.dtype))
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    # per-slot scatter at each slot's own length; inactive slots write
+    # their current cell back (no-op)
+    rows = jnp.arange(B)
+    write_pos = jnp.where(active, lengths, 0)
+    cur_k = ck[rows, write_pos]
+    cur_v = cv[rows, write_pos]
+    new_k = jnp.where(active[:, None, None], k[:, 0], cur_k)
+    new_v = jnp.where(active[:, None, None], v[:, 0], cur_v)
+    ck = ck.at[rows, write_pos].set(new_k.astype(ck.dtype))
+    cv = cv.at[rows, write_pos].set(new_v.astype(cv.dtype))
+    # attention: slot b may see cache positions <= lengths[b]
+    T = ck.shape[1]
+    groups = q.shape[2] // ck.shape[2]
+    ck_h = jnp.repeat(ck, groups, axis=2)
+    cv_h = jnp.repeat(cv, groups, axis=2)
+    scores = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                        ck_h.astype(jnp.float32)) * (q.shape[-1] ** -0.5)
+    mask = (jnp.arange(T)[None, None, None, :]
+            <= lengths[:, None, None, None])
+    scores = jnp.where(mask, scores, _NEG)
+    probs = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("bhst,bthd->bshd", probs,
+                   cv_h.astype(jnp.float32)).astype(x.dtype)
+    attn_out = jnp.einsum("bshk,hkd->bsd", o,
+                          layer["wo"].astype(cfg.dtype))
+    x = x + attn_out
+    h = _rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
+    if cfg.is_moe:
+        from cloudtik_tpu.ops.moe import moe_ffn
+        down, _ = moe_ffn(h, layer["w_router"], layer["w_gate"],
+                          layer["w_up"], layer["w_down"],
+                          cfg.moe_config())
+    else:
+        gate = jnp.einsum("bsd,df->bsf", h,
+                          layer["w_gate"].astype(cfg.dtype))
+        up = jnp.einsum("bsd,df->bsf", h,
+                        layer["w_up"].astype(cfg.dtype))
+        down = jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                          layer["w_down"].astype(cfg.dtype))
+    return x + down, ck, cv
+
+
+def decode_step(params: Params, tokens: jax.Array, ks: jax.Array,
+                vs: jax.Array, lengths: jax.Array, active: jax.Array,
+                temps: jax.Array, rng: jax.Array,
+                cfg: TransformerConfig, top_k: int
+                ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One token for every active slot.
+
+    tokens [B] (each slot's last token), ks/vs [L,B,T,Hkv,Dh],
+    lengths/active/temps [B].  Returns (next_tokens, ks, vs,
+    new_lengths); inactive slots keep their state.
+    """
+    x = _embed_lookup(params["embed"], tokens[:, None], cfg)
+
+    def body(carry, xs):
+        x = carry
+        layer, ck, cv = xs
+        x, ck, cv = _decode_layer(cfg, x, layer, ck, cv, lengths,
+                                  active)
+        return x, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], ks, vs))
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, _lm_head(params, cfg).astype(cfg.dtype),
+        preferred_element_type=jnp.float32)[:, 0, :]
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, _NEG, logits)
+    greedy = logits.argmax(-1).astype(jnp.int32)
+    temps = jnp.maximum(temps, 1e-6)
+    sampled = jax.random.categorical(
+        rng, logits / temps[:, None], axis=-1).astype(jnp.int32)
+    nxt = jnp.where(temps > 1e-5, sampled, greedy)
+    nxt = jnp.where(active, nxt, tokens)
+    new_lengths = jnp.where(active, lengths + 1, lengths)
+    return nxt, ks, vs, new_lengths
+
+
+class DecodeEngine:
+    """Host loop + device programs for continuous-batching generation.
+
+    submit() is thread-safe; callers block on Request.wait().  One
+    background thread owns all device state, so there is never more
+    than one in-flight program (the single-process TPU rule)."""
+
+    def __init__(self, params: Params, cfg: TransformerConfig,
+                 engine_config: Optional[EngineConfig] = None,
+                 rng: Optional[jax.Array] = None):
+        self.params = params
+        self.cfg = cfg
+        self.ec = engine_config or EngineConfig()
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        B, T = self.ec.slots, self.ec.max_len
+        # buckets must COVER max_len: any prompt submit() accepts
+        # (prompt + max_new <= max_len) has to land in some bucket, so
+        # extend the configured ladder by doubling up to max_len
+        buckets = [b for b in self.ec.prefill_buckets if b <= T]
+        if not buckets:
+            buckets = [min(16, T)]
+        while buckets[-1] < T:
+            buckets.append(min(buckets[-1] * 2, T))
+        self._buckets = tuple(buckets)
+        shape = (cfg.n_layers, B, T, cfg.n_kv_heads, cfg.head_dim)
+        self._ks = jnp.zeros(shape, cfg.dtype)
+        self._vs = jnp.zeros(shape, cfg.dtype)
+        self._lengths = jnp.zeros((B,), jnp.int32)
+        self._tokens = jnp.zeros((B,), jnp.int32)
+        self._slots: List[Optional[_Slot]] = [None] * B
+        self._queue: "queue.Queue[Request]" = queue.Queue()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        self._decode = jax.jit(
+            lambda p, tok, ks, vs, ln, act, tmp, rng: decode_step(
+                p, tok, ks, vs, ln, act, tmp, rng, cfg=cfg,
+                top_k=self.ec.top_k))
+
+        def _prefill(p, prompt, true_len):
+            cache = init_cache(cfg, 1, T)
+            logits, cache = forward_step(p, prompt, cache, cfg)
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], true_len - 1, 0, keepdims=False)
+            return cache["k"][:, 0], cache["v"][:, 0], \
+                last.argmax(-1).astype(jnp.int32)
+
+        self._prefill = jax.jit(_prefill)
+
+        def _insert(ks, vs, pk, pv, slot):
+            ks = jax.lax.dynamic_update_slice(
+                ks, pk[:, None], (0, slot, 0, 0, 0))
+            vs = jax.lax.dynamic_update_slice(
+                vs, pv[:, None], (0, slot, 0, 0, 0))
+            return ks, vs
+
+        self._insert = jax.jit(_insert)
+
+    # -- public ----------------------------------------------------------
+    def submit(self, request: Request) -> Request:
+        if not request.prompt:
+            request.error = ValueError("empty prompt")
+            request._done.set()
+            return request
+        if len(request.prompt) + request.max_new_tokens > self.ec.max_len:
+            request.error = ValueError(
+                f"prompt+max_new ({len(request.prompt)} + "
+                f"{request.max_new_tokens}) exceeds max_len "
+                f"{self.ec.max_len}")
+            request._done.set()
+            return request
+        self._queue.put(request)
+        self._wake.set()
+        return request
+
+    def generate(self, prompt: List[int], **kw) -> List[int]:
+        """Convenience: submit + wait."""
+        return self.submit(Request(prompt, **kw)).wait(timeout=600)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="tik-decode-engine", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        # fail everything still queued or mid-decode — callers must not
+        # sit in wait() until their timeout after a shutdown
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.error = RuntimeError("engine stopped")
+            req._done.set()
+        for slot_id, slot in enumerate(self._slots):
+            if slot is not None:
+                slot.request.error = RuntimeError("engine stopped")
+                slot.request._done.set()
+                self._slots[slot_id] = None
+
+    # -- engine loop ------------------------------------------------------
+    def _bucket(self, n: int) -> int:
+        for b in self._buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt length {n} exceeds largest bucket")
+
+    def _admit(self) -> None:
+        for slot_id in range(self.ec.slots):
+            if self._slots[slot_id] is not None:
+                continue
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                true_len = len(req.prompt)
+                padded = np.zeros((1, self._bucket(true_len)), np.int32)
+                padded[0, :true_len] = req.prompt
+                pk, pv, first = self._prefill(
+                    self.params, jnp.asarray(padded),
+                    jnp.asarray(true_len, jnp.int32))
+                self._ks, self._vs = self._insert(
+                    self._ks, self._vs, pk, pv, slot_id)
+                first_tok = int(first)
+                req.tokens.append(first_tok)
+                self._tokens = self._tokens.at[slot_id].set(first_tok)
+                self._lengths = self._lengths.at[slot_id].set(true_len)
+                slot = _Slot(req, true_len, req.max_new_tokens - 1)
+                if (req.eos_id is not None and first_tok == req.eos_id) \
+                        or slot.remaining <= 0:
+                    req._done.set()
+                    continue
+                self._slots[slot_id] = slot
+            except Exception as e:   # surface per-request failures
+                req.error = e
+                req._done.set()
+
+    def _step(self) -> None:
+        active_mask = np.array(
+            [s is not None for s in self._slots], np.bool_)
+        temps = np.array(
+            [s.request.temperature if s else 0.0 for s in self._slots],
+            np.float32)
+        self._rng, step_rng = jax.random.split(self._rng)
+        nxt, self._ks, self._vs, self._lengths = self._decode(
+            self.params, self._tokens, self._ks, self._vs,
+            self._lengths, jnp.asarray(active_mask),
+            jnp.asarray(temps), step_rng)
+        self._tokens = nxt
+        host_tokens = np.asarray(nxt)
+        for slot_id, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            tok = int(host_tokens[slot_id])
+            slot.request.tokens.append(tok)
+            slot.length += 1
+            slot.remaining -= 1
+            done = slot.remaining <= 0 or \
+                (slot.request.eos_id is not None
+                 and tok == slot.request.eos_id) or \
+                slot.length + 1 >= self.ec.max_len
+            if done:
+                slot.request._done.set()
+                self._slots[slot_id] = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._admit()
+                if any(s is not None for s in self._slots):
+                    self._step()
+                elif self._queue.empty():
+                    self._wake.wait(timeout=0.5)
+                    self._wake.clear()
+            except Exception:
+                logger.exception("decode engine loop error")
+                # fail everything in flight rather than hang callers
+                for slot_id, slot in enumerate(self._slots):
+                    if slot is not None:
+                        slot.request.error = RuntimeError(
+                            "engine loop failed; see logs")
+                        slot.request._done.set()
+                        self._slots[slot_id] = None

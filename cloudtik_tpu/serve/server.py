@@ -89,6 +89,53 @@ def transformer_backend(model: str = "tiny",
     return ModelBackend(f"transformer:{model}", {"generate": generate})
 
 
+def engine_backend(model: str = "tiny",
+                   checkpoint_dir: Optional[str] = None,
+                   slots: int = 4, max_len: int = 512,
+                   **config_overrides) -> ModelBackend:
+    """Continuous-batching generation endpoint (serve/engine.py).
+
+    Each HTTP request submits ONE prompt to the shared DecodeEngine and
+    blocks on its result; the ThreadingHTTPServer's concurrency is what
+    fills the engine's decode slots — concurrent requests share decode
+    steps instead of queueing behind each other."""
+    import jax
+
+    from cloudtik_tpu.models import transformer as T
+    from cloudtik_tpu.serve.engine import (
+        DecodeEngine, EngineConfig, Request)
+
+    cfg = T.config(model, **config_overrides)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    if checkpoint_dir:
+        from cloudtik_tpu.train.checkpoint import (
+            CheckpointConfig, Checkpointer)
+        ckpt = Checkpointer(CheckpointConfig(directory=checkpoint_dir))
+        params = ckpt.restore({"params": params},
+                              partial=True)["params"]
+        ckpt.close()
+    engine = DecodeEngine(
+        params, cfg, EngineConfig(slots=slots, max_len=max_len))
+    engine.start()
+
+    def generate(payload: Dict[str, Any]) -> Dict[str, Any]:
+        tokens = payload["tokens"]
+        prompt = tokens[0] if tokens and isinstance(tokens[0], list) \
+            else tokens
+        req = engine.submit(Request(
+            [int(t) for t in prompt],
+            max_new_tokens=int(payload.get("max_new_tokens", 16)),
+            temperature=float(payload.get("temperature", 0.0)),
+            eos_id=(int(payload["eos_id"])
+                    if "eos_id" in payload else None)))
+        return {"tokens": [req.wait(timeout=600)]}
+
+    backend = ModelBackend(f"transformer-engine:{model}",
+                           {"generate": generate})
+    backend.engine = engine          # exposes stop() for clean shutdown
+    return backend
+
+
 def gbdt_backend(model_path: str) -> ModelBackend:
     """Tabular predict endpoint on a saved GBDT forest."""
     import jax.numpy as jnp
@@ -202,6 +249,11 @@ def main(argv=None) -> int:
                    help="transformer preset to serve")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--gbdt", default=None, help="saved GBDT .npz path")
+    p.add_argument("--engine", action="store_true",
+                   help="continuous-batching decode engine (concurrent "
+                        "requests share decode steps)")
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--max-len", type=int, default=512)
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8200)
     args = p.parse_args(argv)
@@ -209,6 +261,10 @@ def main(argv=None) -> int:
     backends = []
     if args.gbdt:
         backends.append(gbdt_backend(args.gbdt))
+    elif args.engine:
+        backends.append(engine_backend(
+            args.model, checkpoint_dir=args.checkpoint_dir,
+            slots=args.slots, max_len=args.max_len))
     else:
         backends.append(transformer_backend(
             args.model, checkpoint_dir=args.checkpoint_dir))

@@ -180,7 +180,7 @@ class TestMySQLFailover:
         assert _wait(lambda: any(
             f"SOURCE_HOST='{winner_ip}'" in s for s in logs[loser]))
         for rt in rts.values():
-            rt.post_stop({})
+            rt._failover.stop()
 
     def test_renders(self, tmp_path):
         from cloudtik_tpu.runtimes.mysql.runtime import (
@@ -244,7 +244,7 @@ class TestRedisFailover:
         assert _wait(lambda: any(
             a[:2] == ("replicaof", winner_ip) for a in logs[loser]))
         for rt in rts.values():
-            rt.post_stop({})
+            rt._failover.stop()
 
 
 class TestMongoDBPrimaryWatch:
@@ -283,7 +283,7 @@ class TestMongoDBPrimaryWatch:
                             tmp_path=tmp_path)
         rt.node_configure(ctx)
         rt.post_start(ctx)
-        rt.post_stop(ctx)
+        rt.stop_daemons(ctx)
         initiates = [c for c in calls if c.startswith("rs.initiate")]
         assert len(initiates) == 1
         # marker prevents a second initiate on restart
@@ -292,5 +292,5 @@ class TestMongoDBPrimaryWatch:
         monkeypatch.setattr(
             rt2, "_mongosh", lambda script: calls2.append(script) or "ok")
         rt2.post_start(ctx)
-        rt2.post_stop(ctx)
+        rt2.stop_daemons(ctx)
         assert not [c for c in calls2 if c.startswith("rs.initiate")]

@@ -165,6 +165,31 @@ class TestKongAdminSync:
         finally:
             fake.stop()
 
+    def test_apisix_rerenders_on_discovery_change(self, tmp_path):
+        """Standalone APISIX hot-reloads apisix.yaml on mtime — live
+        reconfiguration is the sync loop re-rendering it when the
+        discovered targets change (and NOT rewriting when unchanged)."""
+        from cloudtik_tpu.runtimes.apisix.runtime import APISIXRuntime
+        from cloudtik_tpu.runtimes.discovery.runtime import ServiceRegistry
+        state = StateClient(InMemoryStateBackend())
+        reg = ServiceRegistry(state, "c1", "w1")
+        reg.register("serving", "n1", "10.0.0.2", 8200,
+                     protocol="http")
+        rt = APISIXRuntime({})
+        ctx = {"is_head": True, "node_id": "head", "state_client": state,
+               "config": {"cluster_name": "c1", "workspace_name": "w1"},
+               "conf_dir": str(tmp_path)}
+        assert rt.render_once(ctx) is True
+        conf = (tmp_path / "apisix.yaml").read_text()
+        assert "10.0.0.2:8200" in conf and conf.endswith("#END\n")
+        # unchanged discovery -> no rewrite (mtime untouched)
+        assert rt.render_once(ctx) is False
+        # a new target appears -> re-render picks it up
+        reg.register("serving", "n2", "10.0.0.3", 8200,
+                     protocol="http")
+        assert rt.render_once(ctx) is True
+        assert "10.0.0.3:8200" in (tmp_path / "apisix.yaml").read_text()
+
     def test_runtime_start_reaches_sync_without_binary(self, tmp_path):
         """The delivery start path must launch the sync daemon even
         though kong has no service_command (the binary/daemon is
@@ -257,7 +282,7 @@ class TestPoolsFollowPrimary:
             assert "backend_flag0 = 'ALWAYS_PRIMARY'" in conf
             assert len(reloads) >= 2
         finally:
-            rt.post_stop(ctx)
+            rt.stop_daemons(ctx)
             b.stop()
 
     def test_pgbouncer_repoints_databases_on_failover(self, tmp_path):
@@ -278,5 +303,5 @@ class TestPoolsFollowPrimary:
             assert _wait(lambda: "host=10.0.0.2" in
                          (tmp_path / "pgbouncer.ini").read_text())
         finally:
-            rt.post_stop(ctx)
+            rt.stop_daemons(ctx)
             b.stop()

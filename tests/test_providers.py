@@ -351,6 +351,23 @@ class TestCloudPayloadBuilders:
         assert req["SpotStrategy"] == "SpotAsPriceGo"
         assert {"Key": "tik-cluster-name", "Value": "c1"} in req["Tag"]
 
+    def test_aliyun_spot_price_limit_and_placement(self):
+        req = ali_run_request(
+            {"instance_type": "ecs.g7.2xlarge", "spot": True,
+             "spot_price_limit": 0.75, "spot_duration": 0,
+             "zone_id": "cn-hangzhou-k",
+             "deployment_set_id": "ds-123"},
+            {TAG_NODE_KIND: "worker"}, 1, "c1")
+        assert req["SpotStrategy"] == "SpotWithPriceLimit"
+        assert req["SpotPriceLimit"] == 0.75
+        assert req["SpotDuration"] == 0
+        assert req["ZoneId"] == "cn-hangzhou-k"
+        assert req["DeploymentSetId"] == "ds-123"
+        # non-spot request carries no spot fields
+        on_demand = ali_run_request(
+            {"instance_type": "ecs.g7.2xlarge"}, {}, 1, "c1")
+        assert "SpotStrategy" not in on_demand
+
     def test_huawei_request(self):
         body = build_create_servers_request(
             {"flavor": "c7.4xlarge.2", "subnet_id": "sub-1"},
@@ -360,3 +377,17 @@ class TestCloudPayloadBuilders:
         assert server["flavorRef"] == "c7.4xlarge.2"
         assert {"key": "tik-cluster-name", "value": "c1"} in \
             server["server_tags"]
+        assert "extendparam" not in server     # on-demand: no spot
+
+    def test_huawei_spot_and_placement(self):
+        body = build_create_servers_request(
+            {"flavor": "c7.xlarge.2", "spot": True, "spot_price": 0.2,
+             "availability_zone": "cn-north-4a",
+             "server_group_id": "sg-anti-affinity"},
+            {TAG_NODE_KIND: "worker"}, 1, "c1")
+        server = body["server"]
+        assert server["extendparam"]["marketType"] == "spot"
+        assert server["extendparam"]["spotPrice"] == "0.2"
+        assert server["availability_zone"] == "cn-north-4a"
+        assert server["os:scheduler_hints"]["group"] == \
+            "sg-anti-affinity"

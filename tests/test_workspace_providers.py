@@ -176,25 +176,116 @@ class FakeAliyunVpc:
     def delete_nat_gateway(self, nat_gateway_id):
         del self.nats[nat_gateway_id]
 
+    # EIP + SNAT (the egress half of the NAT)
+    def allocate_eip_address(self, name):
+        eid = self._id("eip")
+        self.eips = getattr(self, "eips", {})
+        self.eips[eid] = {"AllocationId": eid, "Name": name,
+                          "IpAddress": f"47.0.0.{len(self.eips) + 1}"}
+        return dict(self.eips[eid])
+
+    def describe_eip_addresses(self, name):
+        eips = [e for e in getattr(self, "eips", {}).values()
+                if e["Name"] == name]
+        return {"EipAddresses": {"EipAddress": eips}}
+
+    def associate_eip_address(self, allocation_id, instance_id,
+                              instance_type):
+        self.eips[allocation_id]["InstanceId"] = instance_id
+
+    def release_eip_address(self, allocation_id):
+        del self.eips[allocation_id]
+
+    def create_snat_entry(self, nat_gateway_id, source_cidr, snat_ip):
+        self.snats = getattr(self, "snats", {})
+        sid = self._id("snat")
+        self.snats[sid] = {"SnatEntryId": sid, "Nat": nat_gateway_id,
+                           "SourceCIDR": source_cidr, "SnatIp": snat_ip}
+        return dict(self.snats[sid])
+
+    def describe_snat_table_entries(self, nat_gateway_id):
+        entries = [s for s in getattr(self, "snats", {}).values()
+                   if s["Nat"] == nat_gateway_id]
+        return {"SnatTableEntries": {"SnatTableEntry": entries}}
+
+    def delete_snat_entry(self, snat_entry_id):
+        del self.snats[snat_entry_id]
+
+
+class FakeAliyunRam:
+    def __init__(self):
+        self.roles = {}
+        self.attached = []
+
+    def list_roles(self):
+        return {"Roles": {"Role": list(self.roles.values())}}
+
+    def create_role(self, role_name, assume_role_policy_document):
+        self.roles[role_name] = {
+            "RoleName": role_name,
+            "AssumeRolePolicyDocument": assume_role_policy_document}
+
+    def attach_policy_to_role(self, policy_type, policy_name, role_name):
+        self.attached.append((policy_type, policy_name, role_name))
+
+    def detach_policy_from_role(self, policy_type, policy_name,
+                                role_name):
+        self.attached.remove((policy_type, policy_name, role_name))
+
+    def delete_role(self, role_name):
+        del self.roles[role_name]
+
 
 class TestAliyunWorkspace:
     def test_create_check_delete_cycle(self):
         fake = FakeAliyunVpc()
+        ram = FakeAliyunRam()
         p = create_workspace_provider(
             {"type": "aliyun", "region": "cn-hangzhou",
-             "vpc_client": fake}, "ws")
+             "vpc_client": fake, "ram_client": ram}, "ws")
         assert p.check_workspace_existence({}) == Existence.NOT_EXIST
         p.create_workspace({})
         assert p.check_workspace_existence({}) == Existence.COMPLETED
         assert len(fake.rules) == 2  # ssh + internal
         assert len(fake.nats) == 1
-        before = (len(fake.vpcs), len(fake.vswitches), len(fake.groups))
+        # NAT egress is actually routable: EIP bound to the NAT + SNAT
+        # entry for the workspace CIDR
+        eip = next(iter(fake.eips.values()))
+        assert eip["InstanceId"] in fake.nats
+        snat = next(iter(fake.snats.values()))
+        assert snat["SourceCIDR"] == "10.30.0.0/16"
+        assert snat["SnatIp"] == eip["IpAddress"]
+        # instance RAM role with OSS policy
+        assert "tik-ws-role" in ram.roles
+        assert ("System", "AliyunOSSFullAccess",
+                "tik-ws-role") in ram.attached
+        before = (len(fake.vpcs), len(fake.vswitches), len(fake.groups),
+                  len(fake.eips), len(fake.snats), len(ram.roles))
         p.create_workspace({})  # idempotent: nothing duplicated
-        assert (len(fake.vpcs), len(fake.vswitches),
-                len(fake.groups)) == before
+        assert (len(fake.vpcs), len(fake.vswitches), len(fake.groups),
+                len(fake.eips), len(fake.snats),
+                len(ram.roles)) == before
         p.delete_workspace({})
         assert p.check_workspace_existence({}) == Existence.NOT_EXIST
         assert not fake.vpcs and not fake.nats
+        assert not fake.eips and not fake.snats and not ram.roles
+
+    def test_rerun_binds_orphaned_eip(self):
+        """Partial-failure recovery: a previous run allocated the EIP
+        but crashed before associating it — the rerun must bind it to
+        the NAT instead of leaving egress dark while reporting
+        COMPLETED."""
+        fake = FakeAliyunVpc()
+        # pre-allocate the named EIP, unassociated (the crash artifact)
+        fake.allocate_eip_address(name="tik-ws-eip")
+        p = create_workspace_provider(
+            {"type": "aliyun", "region": "cn-hangzhou",
+             "vpc_client": fake}, "ws")
+        p.create_workspace({})
+        eip = next(iter(fake.eips.values()))
+        assert eip.get("InstanceId") in fake.nats
+        snat = next(iter(fake.snats.values()))
+        assert snat["SnatIp"] == eip["IpAddress"]
 
 
 # --------------------------------------------------------------- huawei --
@@ -259,22 +350,83 @@ class FakeHuaweiVpc:
     def delete_nat_gateway(self, nat_gateway_id):
         del self.nats[nat_gateway_id]
 
+    # EIP + SNAT
+    def create_eip(self, alias):
+        eid = self._id("eip")
+        self.eips = getattr(self, "eips", {})
+        self.eips[eid] = {"id": eid, "alias": alias,
+                          "public_ip_address": f"121.0.0.{len(self.eips) + 1}"}
+        return {"publicip": dict(self.eips[eid])}
+
+    def list_eips(self):
+        return {"publicips": list(getattr(self, "eips", {}).values())}
+
+    def delete_eip(self, publicip_id):
+        del self.eips[publicip_id]
+
+    def create_snat_rule(self, nat_gateway_id, cidr, floating_ip_id):
+        self.snat_rules = getattr(self, "snat_rules", {})
+        rid = self._id("snat")
+        self.snat_rules[rid] = {"id": rid, "nat": nat_gateway_id,
+                                "cidr": cidr, "eip": floating_ip_id}
+        return dict(self.snat_rules[rid])
+
+    def list_snat_rules(self, nat_gateway_id):
+        return {"snat_rules": [
+            r for r in getattr(self, "snat_rules", {}).values()
+            if r["nat"] == nat_gateway_id]}
+
+    def delete_snat_rule(self, snat_rule_id):
+        del self.snat_rules[snat_rule_id]
+
+
+class FakeHuaweiIam:
+    def __init__(self):
+        self.agencies = {}
+        self.grants = []
+        self._n = 0
+
+    def list_agencies(self):
+        return {"agencies": list(self.agencies.values())}
+
+    def create_agency(self, name, trust_domain_name, description=""):
+        self._n += 1
+        aid = f"agency-{self._n}"
+        self.agencies[aid] = {"id": aid, "name": name,
+                              "trust_domain_name": trust_domain_name}
+        return {"agency": dict(self.agencies[aid])}
+
+    def grant_agency_role(self, agency_id, role_name):
+        self.grants.append((agency_id, role_name))
+
+    def delete_agency(self, agency_id):
+        del self.agencies[agency_id]
+
 
 class TestHuaweiWorkspace:
     def test_create_check_delete_cycle(self):
         fake = FakeHuaweiVpc()
         p = create_workspace_provider(
             {"type": "huaweicloud", "region": "cn-north-4",
-             "vpc_client": fake}, "ws")
+             "vpc_client": fake, "iam_client": FakeHuaweiIam()}, "ws")
+        iam = p.provider_config["iam_client"]
         assert p.check_workspace_existence({}) == Existence.NOT_EXIST
         p.create_workspace({})
         assert p.check_workspace_existence({}) == Existence.COMPLETED
         assert len(fake.rules) == 2
+        # routable egress: EIP + SNAT rule for the subnet CIDR
+        rule = next(iter(fake.snat_rules.values()))
+        assert rule["cidr"] == "10.40.0.0/16"
+        assert rule["eip"] in fake.eips
+        # agency for OBS access granted
+        assert iam.grants and iam.grants[0][1] == "OBS Administrator"
         p.create_workspace({})  # idempotent
         assert len(fake.vpcs) == 1 and len(fake.subnets) == 1
+        assert len(fake.eips) == 1 and len(iam.agencies) == 1
         p.delete_workspace({})
         assert p.check_workspace_existence({}) == Existence.NOT_EXIST
         assert not fake.nats and not fake.groups
+        assert not fake.eips and not iam.agencies
 
 
 # ------------------------------------------------- per-cloud storage --
