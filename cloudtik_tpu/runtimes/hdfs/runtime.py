@@ -89,10 +89,28 @@ class HDFSRuntime(ServiceRuntimeBase):
         binary = self.find_binary()
         if binary is None:
             return False
-        subprocess.run(
-            [binary, "--config", self.conf_dir(node_context),
-             "namenode", "-format", "-nonInteractive"],
-            capture_output=True)
+        try:
+            timeout_s = float(self.runtime_config.get(
+                "format_timeout_s", 60))
+        except (TypeError, ValueError):
+            timeout_s = 60.0
+        try:
+            # bounded: a real format takes seconds; a wedged (or fake)
+            # binary must not hang node boot — the NN itself will fail
+            # loudly on an unformatted dir if this didn't succeed
+            subprocess.run(
+                [binary, "--config", self.conf_dir(node_context),
+                 "namenode", "-format", "-nonInteractive"],
+                capture_output=True, timeout=timeout_s)
+        except (subprocess.TimeoutExpired, OSError):
+            # a format KILLED mid-write may have dropped current/VERSION
+            # without a complete fsimage; leaving it would make the
+            # format-once gate refuse to retry forever while the NN
+            # crash-loops — wipe the partial marker so next boot retries
+            import shutil
+            shutil.rmtree(os.path.join(self.name_dir(), "current"),
+                          ignore_errors=True)
+            return False
         return os.path.exists(os.path.join(self.name_dir(), "current",
                                            "VERSION"))
 

@@ -96,6 +96,10 @@ def test_runtime_boots_from_clean_home(name, tik_home_tmp, tmp_path):
         "install": {"type": "archive", "url": f"file://{tarball}"},
         "data_dir": str(tmp_path / "data"),
     }
+    if name == "hdfs":
+        # the stub binary ignores `-format` and serves forever; the
+        # bounded format must give up fast, not stall the suite
+        runtime_config["format_timeout_s"] = 1
     config = {
         "cluster_name": "lt", "workspace_name": "w",
         "provider": {"type": "virtual"},
