@@ -21,4 +21,7 @@ execution → control plane → operators → runtimes → API/CLI → AI worklo
 __version__ = "0.1.0"
 
 # Public API re-exports (reference parity: core/api.py:22,65,630).
+# NOTE: jax is deliberately NOT imported here (CLI startup); the
+# jax-facing packages (parallel/ops/models/train/serve) install the
+# version-compat shims (parallel/jax_compat.py) on their own import.
 from cloudtik_tpu.core.api import Cluster, ThisCluster, Workspace  # noqa: F401,E402

@@ -29,7 +29,7 @@ def make_command_executor(
     auth_config = auth_config or {}
     if auth_config.get("executor") == "local":
         base: CommandExecutor = LocalCommandExecutor(
-            call_context, process_runner, log_prefix)
+            call_context, process_runner, log_prefix, node_id=node_id)
     else:
         options = SSHOptions(
             private_key=auth_config.get("ssh_private_key"),

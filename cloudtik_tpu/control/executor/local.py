@@ -15,17 +15,22 @@ from typing import Any, Dict, Optional
 
 from cloudtik_tpu.control.executor.base import (
     CommandError, CommandExecutor, _shell_env_prefix)
+from cloudtik_tpu.faults import seams
 
 
 class LocalCommandExecutor(CommandExecutor):
     def __init__(self, call_context=None, process_runner=None,
-                 log_prefix: str = ""):
+                 log_prefix: str = "", node_id: str = ""):
         super().__init__(call_context)
         self.process_runner = process_runner or subprocess
         self.log_prefix = log_prefix
+        self.node_id = node_id
 
     def run(self, cmd, *, environment_variables=None, with_output=False,
             run_env="auto", timeout=None, shutdown_after_run=False):
+        # bare node_id, same as the SSH executor fires — fault-plan
+        # match filters must behave identically on local/virtual drills
+        seams.fire("executor.run", node_id=self.node_id, cmd=cmd)
         full_cmd = _shell_env_prefix(environment_variables) + cmd
         if not with_output and self.process_runner is subprocess:
             # real execution path: stream per-line with the node prefix

@@ -10,11 +10,13 @@ import os
 import posixpath
 import shlex
 import subprocess
-import time
 from typing import Any, Dict, List, Optional
 
 from cloudtik_tpu.control.executor.base import (
     CommandError, CommandExecutor, _shell_env_prefix)
+from cloudtik_tpu.faults import seams
+from cloudtik_tpu.utils.retry import (
+    RetriesExhausted, RetryPolicy, call_with_retry)
 
 
 class SSHOptions:
@@ -91,14 +93,15 @@ class SSHCommandExecutor(CommandExecutor):
 
     def run(self, cmd, *, environment_variables=None, with_output=False,
             run_env="auto", timeout=None, shutdown_after_run=False):
+        seams.fire("executor.run", node_id=self.node_id, cmd=cmd)
         remote_cmd = _shell_env_prefix(environment_variables) + cmd
         if shutdown_after_run:
             remote_cmd += "; sudo shutdown -h now"
+        wrapped = _quote("true && source ~/.bashrc && "
+                         "export OMP_NUM_THREADS=1 && " + remote_cmd)
         final = self._ssh_base() + [
             f"{self.ssh_user}@{self.ssh_ip}",
-            f"bash --login -c -i {_quote(f'true && source ~/.bashrc && '
-                                         f'export OMP_NUM_THREADS=1 && '
-                                         + remote_cmd)}",
+            f"bash --login -c -i {wrapped}",
         ]
         try:
             if with_output:
@@ -136,15 +139,24 @@ class SSHCommandExecutor(CommandExecutor):
                         [f"{self.ssh_user}@{self.ssh_ip}"])
 
     def wait_ready(self, deadline_s: float, retry_interval: float = 5.0) -> bool:
-        """Poll `uptime` over SSH until the node answers or deadline."""
-        deadline = time.time() + deadline_s
-        while time.time() < deadline:
-            try:
-                self.run("uptime", with_output=True, timeout=15)
-                return True
-            except Exception:
-                time.sleep(retry_interval)
-        return False
+        """Poll `uptime` over SSH until the node answers or deadline.
+
+        Runs under the tree-wide RetryPolicy: fixed interval (a booting
+        node is not a backoff situation — it answers when sshd is up),
+        unlimited attempts, bounded by the wall deadline."""
+        policy = RetryPolicy(
+            max_attempts=0 if deadline_s > 0 else 1,
+            base_delay_s=retry_interval,
+            multiplier=1.0, jitter=0.0, deadline_s=max(deadline_s, 0.0))
+
+        def probe():
+            self.run("uptime", with_output=True, timeout=15)
+
+        try:
+            call_with_retry(probe, policy)
+            return True
+        except RetriesExhausted:
+            return False
 
 
 def _quote(s: str) -> str:

@@ -18,6 +18,7 @@ from cloudtik_tpu.core.node_provider import (
 from cloudtik_tpu.core.tags import (
     NODE_KIND_WORKER, STATUS_UNINITIALIZED, TAG_CLUSTER_NAME,
     TAG_LAUNCH_CONFIG, TAG_NODE_KIND, TAG_NODE_STATUS, TAG_USER_NODE_TYPE)
+from cloudtik_tpu.faults import seams
 
 logger = logging.getLogger(__name__)
 
@@ -107,6 +108,8 @@ class NodeLauncher(threading.Thread):
         }
         group = nt.get("node_group") or {}
         try:
+            seams.fire("provider.create_node", provider=self.provider,
+                       node_type=node_type, count=count)
             if group.get("atomic") and self.provider.supports_node_groups():
                 group_size = int(group.get("group_size", 1))
                 n_groups = max(count // group_size, 1)

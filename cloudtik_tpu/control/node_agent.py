@@ -18,6 +18,8 @@ import psutil
 
 from cloudtik_tpu.control.state import (
     StateClient, TABLE_HEARTBEAT, TABLE_METRICS, TABLE_PROCESSES)
+from cloudtik_tpu.faults import seams
+from cloudtik_tpu.faults.plan import DIRECTIVE_DROP
 from cloudtik_tpu.utils.constants import TIK_HEARTBEAT_PERIOD_S
 
 logger = logging.getLogger(__name__)
@@ -107,6 +109,12 @@ class NodeAgent:
                     exc_info=True)
 
     def heartbeat_once(self) -> None:
+        # drop-heartbeats-for(ip, duration) drill point: a dropped beat
+        # is simply never published — exactly what a wedged host looks
+        # like from the head's side
+        if seams.fire("node_agent.heartbeat", ip=self.node_ip,
+                      node_id=self.node_id) == DIRECTIVE_DROP:
+            return
         self.state.table_put(TABLE_HEARTBEAT, self.node_id, {
             "node_id": self.node_id,
             "node_ip": self.node_ip,

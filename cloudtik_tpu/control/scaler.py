@@ -31,6 +31,7 @@ from cloudtik_tpu.core.tags import (
     NODE_KIND_HEAD, NODE_KIND_WORKER, STATUS_UP_TO_DATE, STATUS_UPDATE_FAILED,
     TAG_LAUNCH_CONFIG, TAG_NODE_GROUP_ID, TAG_NODE_KIND, TAG_NODE_STATUS,
     TAG_RUNTIME_CONFIG, TAG_USER_NODE_TYPE)
+from cloudtik_tpu.faults import seams
 from cloudtik_tpu.utils.constants import (
     TIK_BOOT_GRACE_S, TIK_MAX_CONCURRENT_LAUNCHES,
     TIK_MAX_CONCURRENT_UPDATES)
@@ -44,6 +45,7 @@ class NonTerminatedNodes:
     and is safe to be stale by one tick)."""
 
     def __init__(self, provider: NodeProvider):
+        seams.fire("provider.non_terminated_nodes", provider=provider)
         self.all_node_ids = provider.non_terminated_nodes({})
         self.worker_ids: List[str] = []
         self.head_id: Optional[str] = None
@@ -214,6 +216,8 @@ class ClusterScaler:
         # node that actually dies, not just the ones the caller named.
         expanded = self.quorum.expand_to_group(sorted(to_terminate))
         groups = self.quorum.groups_of(sorted(expanded))
+        seams.fire("provider.terminate_node", provider=self.provider,
+                   node_ids=sorted(expanded))
         all_dead: Set[str] = set()
         for group_id, members in groups.items():
             if group_id and self.provider.supports_node_groups():

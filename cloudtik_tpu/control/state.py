@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional
 
 import msgpack
 
+from cloudtik_tpu.faults import seams
 from cloudtik_tpu.utils.constants import TIK_STATE_PORT_DEFAULT
 
 # Well-known table names (reference: control_state.py:142-146).
@@ -370,9 +371,11 @@ class StateClient:
 
     # raw kv
     def kv_put(self, key: str, value: bytes, ns: str = TABLE_USER) -> None:
+        seams.fire("state.put", table=ns, key=key)
         self.backend.put(ns, key, value)
 
     def kv_get(self, key: str, ns: str = TABLE_USER) -> Optional[bytes]:
+        seams.fire("state.get", table=ns, key=key)
         return self.backend.get(ns, key)
 
     def kv_delete(self, key: str, ns: str = TABLE_USER) -> bool:
@@ -394,9 +397,11 @@ class StateClient:
 
     # object tables
     def table_put(self, table: str, key: str, obj: Dict[str, Any]) -> None:
+        seams.fire("state.put", table=table, key=key)
         self.backend.put(table, key, msgpack.packb(obj, use_bin_type=True))
 
     def table_get(self, table: str, key: str) -> Optional[Dict[str, Any]]:
+        seams.fire("state.get", table=table, key=key)
         raw = self.backend.get(table, key)
         return None if raw is None else msgpack.unpackb(raw, raw=False)
 

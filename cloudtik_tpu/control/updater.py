@@ -23,8 +23,14 @@ from cloudtik_tpu.core.tags import (
     STATUS_UP_TO_DATE, STATUS_WAITING_FOR_SSH, TAG_FILE_MOUNTS_CONTENTS,
     TAG_NODE_STATUS, TAG_RUNTIME_CONFIG)
 from cloudtik_tpu.utils.constants import TIK_NODE_START_WAIT_S
+from cloudtik_tpu.utils.retry import (
+    RetriesExhausted, RetryPolicy, call_with_retry)
 
 logger = logging.getLogger(__name__)
+
+
+class _NodeTerminated(Exception):
+    """Non-retryable: the node died while we were waiting for it."""
 
 
 def shared_memory_ratio(config: Dict[str, Any],
@@ -99,21 +105,29 @@ class NodeUpdater:
 
     def wait_ready(self) -> None:
         self._set_status(STATUS_WAITING_FOR_SSH)
-        deadline = time.time() + self.wait_ready_timeout_s
-        last_error: Optional[Exception] = None
-        while time.time() < deadline:
+        # a zero/negative wait means fail-fast after one probe, not
+        # "no limits" (max_attempts=0 + deadline_s=0 would disable both)
+        policy = RetryPolicy(
+            max_attempts=0 if self.wait_ready_timeout_s > 0 else 1,
+            base_delay_s=5.0, multiplier=1.0, jitter=0.0,
+            deadline_s=max(self.wait_ready_timeout_s, 0),
+            retryable=lambda e: (isinstance(e, Exception)
+                                 and not isinstance(e, _NodeTerminated)))
+
+        def probe():
             if self.provider.is_terminated(self.node_id):
-                raise RuntimeError(
-                    f"node {self.node_id} terminated while waiting for boot")
-            try:
-                self.executor.run("uptime", with_output=True, timeout=20)
-                return
-            except Exception as e:
-                last_error = e
-                time.sleep(5)
-        raise TimeoutError(
-            f"node {self.node_id} not reachable after "
-            f"{self.wait_ready_timeout_s}s: {last_error}")
+                raise _NodeTerminated(self.node_id)
+            self.executor.run("uptime", with_output=True, timeout=20)
+
+        try:
+            call_with_retry(probe, policy)
+        except _NodeTerminated:
+            raise RuntimeError(
+                f"node {self.node_id} terminated while waiting for boot")
+        except RetriesExhausted as e:
+            raise TimeoutError(
+                f"node {self.node_id} not reachable after "
+                f"{self.wait_ready_timeout_s}s: {e.last}") from e.last
 
     def sync_file_mounts(self) -> None:
         self._set_status(STATUS_SYNCING_FILES)

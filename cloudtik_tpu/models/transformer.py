@@ -347,8 +347,14 @@ def hidden_states(
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(cfg))
 
+    from cloudtik_tpu.parallel import jax_compat
     from cloudtik_tpu.parallel.pipeline import pipe_axis_size, pipeline_apply
     n_stages = pipe_axis_size()
+    if n_stages > 1 and not jax_compat.PARTIAL_MANUAL_SHARD_MAP:
+        # the 1F1B/GPipe schedule needs manual-over-`pipe`-only shard_map;
+        # without it the plain scan below still produces a correct GSPMD
+        # program (layers gather across pipe — slower, never wrong)
+        n_stages = 1
     if n_stages > 1:
         # GPipe over the pipe axis: each stage scans its local layer
         # slice; positions ride the pipeline with each microbatch, and

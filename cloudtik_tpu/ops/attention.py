@@ -106,9 +106,13 @@ def attention(
     """
     impl = implementation
     if impl is None:
-        if _ambient_seq_size() > 1:
+        from cloudtik_tpu.parallel import jax_compat
+        if _ambient_seq_size() > 1 and jax_compat.PARTIAL_MANUAL_SHARD_MAP:
             impl = "ring"
         else:
+            # with a sharded seq axis but no partial-manual shard_map on
+            # this jax, GSPMD still produces a correct (if chattier)
+            # program from the flash/reference formulation
             impl = "flash" if _use_flash(q, k) else "reference"
     if impl == "ring":
         from cloudtik_tpu.ops.ring_attention import ring_attention_sharded

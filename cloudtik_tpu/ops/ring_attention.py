@@ -32,8 +32,18 @@ NEG_INF = -1e30
 
 
 def _pvary(x, axis_name):
-    """Mark an unvarying value as device-varying over `axis_name` (VMA)."""
-    return jax.lax.pcast(x, (axis_name,), to="varying")
+    """Mark an unvarying value as device-varying over `axis_name` (VMA).
+
+    Older jax has no varying-manual-axes tracking (no pcast/pvary); there
+    shard_map runs with replication checking off (jax_compat) and the
+    marking is a no-op."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, (axis_name,), to="varying")
+    pvary = getattr(jax.lax, "pvary", None)
+    if pvary is not None:
+        return pvary(x, (axis_name,))
+    return x
 
 
 def ring_attention(

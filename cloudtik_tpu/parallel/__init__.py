@@ -1,0 +1,5 @@
+# Backfill newer jax APIs on older runtimes before anything in this
+# package traces a program (idempotent; no-op on a current jax).
+from cloudtik_tpu.parallel.jax_compat import install as _install_jax_compat
+
+_install_jax_compat()

@@ -1,0 +1,102 @@
+"""JAX version compatibility: newer sharding APIs on older runtimes.
+
+The tree is written against the current jax surface —
+``jax.sharding.set_mesh`` (ambient-mesh context manager) and
+``jax.sharding.get_abstract_mesh`` (probe the ambient mesh inside a
+trace).  Deployment images can lag (this container ships 0.4.x, where
+neither exists), and a cluster platform must not fall over on a minor
+runtime skew, so `install()` backfills the missing attributes with
+semantically equivalent fallbacks built on the classic thread-resources
+ambient mesh:
+
+  * set_mesh(mesh)        -> `with mesh:` (Mesh.__enter__ sets the
+                             thread-local physical mesh, which is what
+                             the newer API's context form does too)
+  * get_abstract_mesh()   -> the thread-local physical mesh; call sites
+                             only probe `.empty` / `.axis_names` /
+                             `.shape`, which physical Mesh also carries
+  * jax.shard_map(...)    -> jax.experimental.shard_map.shard_map with
+                             the keyword surface translated: ambient
+                             mesh resolved explicitly, `axis_names`
+                             (manual axes) mapped to its complement
+                             `auto`, `check_vma` to `check_rep`
+
+On a jax that already has the real APIs, `install()` is a no-op.
+Called once from the package __init__ — import order is enough; nothing
+else needs to know which jax it runs on.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+def _thread_local_physical_mesh():
+    """The ambient mesh of the classic (`with mesh:`) context, or an
+    empty Mesh when none is set."""
+    from jax._src import mesh as mesh_lib
+    return mesh_lib.thread_resources.env.physical_mesh
+
+
+@contextlib.contextmanager
+def _set_mesh_fallback(mesh):
+    with mesh:
+        yield mesh
+
+
+def _get_abstract_mesh_fallback():
+    return _thread_local_physical_mesh()
+
+
+def _shard_map_fallback(f, mesh=None, in_specs=None, out_specs=None,
+                        axis_names=None, check_vma=None, check_rep=None,
+                        auto=None):
+    from jax.experimental.shard_map import shard_map
+
+    if mesh is None:
+        mesh = _thread_local_physical_mesh()
+        if mesh.empty:
+            raise ValueError(
+                "jax.shard_map with no mesh requires an ambient mesh "
+                "(jax.sharding.set_mesh / `with mesh:`)")
+    if auto is None:
+        auto = (frozenset(mesh.axis_names) - frozenset(axis_names)
+                if axis_names else frozenset())
+    if auto:
+        # the old implementation cannot lower collectives with auto
+        # (partial-manual) axes — attempting it aborts the process on
+        # some paths, so refuse loudly and immediately instead
+        raise NotImplementedError(
+            "partial-manual shard_map (manual over a subset of mesh "
+            f"axes; auto={sorted(auto)}) requires a newer jax than "
+            f"{jax.__version__}")
+    # default replication checking OFF: code written for the new API
+    # marks varying values with pcast/pvary, which do not exist here, so
+    # the old checker would reject valid programs (ring attention's
+    # _pvary is a no-op on this jax for exactly this reason)
+    check = check_vma if check_vma is not None else \
+        (check_rep if check_rep is not None else False)
+    return shard_map(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=check)
+
+
+# True when this jax ships native jax.shard_map (which supports manual
+# over a SUBSET of mesh axes).  Feature-dispatch that wants partial-manual
+# (ring attention under a multi-axis mesh, 1F1B pipeline) must check this
+# and fall back to a GSPMD formulation when False.
+PARTIAL_MANUAL_SHARD_MAP = True
+
+
+def install() -> None:
+    global PARTIAL_MANUAL_SHARD_MAP
+    if not hasattr(jax.sharding, "set_mesh"):
+        jax.sharding.set_mesh = _set_mesh_fallback
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        jax.sharding.get_abstract_mesh = _get_abstract_mesh_fallback
+    try:
+        jax.shard_map
+    except AttributeError:
+        PARTIAL_MANUAL_SHARD_MAP = False
+        jax.shard_map = _shard_map_fallback

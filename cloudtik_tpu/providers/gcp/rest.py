@@ -21,6 +21,9 @@ import urllib.error
 import urllib.request
 from typing import Any, Callable, Dict, Optional
 
+from cloudtik_tpu.utils.retry import (
+    RetriesExhausted, RetryPolicy, call_with_retry)
+
 Transport = Callable[[str, str, Optional[Dict[str, Any]], Dict[str, str]],
                      "RestResponse"]
 
@@ -30,6 +33,9 @@ class GCPApiError(Exception):
         super().__init__(f"HTTP {status}: {message}")
         self.status = status
         self.body = body
+        # set per-request by RestClient (429/5xx and not an ambiguous
+        # transport failure on a non-idempotent method)
+        self.retriable = False
 
     @property
     def not_found(self) -> bool:
@@ -95,7 +101,15 @@ def _urllib_transport(method: str, url: str, body: Optional[Dict[str, Any]],
 
 
 class RestClient:
-    """Authenticated JSON REST client with retry on 429/5xx."""
+    """Authenticated JSON REST client with retry on 429/5xx.
+
+    Backoff obeys the tree-wide audited RetryPolicy (utils/retry.py):
+    exponential with jitter, retrying only retriable statuses — and
+    never a non-idempotent method on an ambiguous transport failure
+    (a timed-out POST may have been accepted server-side).
+    """
+
+    RETRIABLE_STATUSES = (429, 500, 502, 503, 504)
 
     def __init__(
         self,
@@ -103,11 +117,19 @@ class RestClient:
         token_provider: Optional[Callable[[], str]] = None,
         max_retries: int = 4,
         retry_base_delay: float = 1.0,
+        sleep: Callable[[float], None] = time.sleep,
     ):
         self._transport = transport or _urllib_transport
         self._token_provider = token_provider or _default_token_provider
-        self._max_retries = max_retries
-        self._retry_base_delay = retry_base_delay
+        self._policy = RetryPolicy(
+            max_attempts=max_retries + 1,
+            base_delay_s=retry_base_delay,
+            multiplier=2.0,
+            max_delay_s=60.0,
+            retryable=lambda exc: (
+                isinstance(exc, GCPApiError) and exc.retriable),
+        )
+        self._sleep = sleep
         self._token: Optional[str] = None
         self._token_time = 0.0
 
@@ -121,26 +143,27 @@ class RestClient:
 
     def request(self, method: str, url: str,
                 body: Optional[Dict[str, Any]] = None) -> Any:
-        last: Optional[RestResponse] = None
-        for attempt in range(self._max_retries + 1):
+        def once() -> Any:
             resp = self._transport(method, url, body, self._headers())
             if resp.status < 400:
                 return resp.body
-            last = resp
+            message = ""
+            if isinstance(resp.body, dict):
+                message = (resp.body.get("error") or {}).get("message", "")
             ambiguous_transport = (
                 isinstance(resp.body, dict)
                 and resp.body.get("transport_error")
                 and method not in ("GET", "DELETE"))
-            if resp.status in (429, 500, 502, 503, 504) \
-                    and not ambiguous_transport \
-                    and attempt < self._max_retries:
-                time.sleep(self._retry_base_delay * (2 ** attempt))
-                continue
-            break
-        message = ""
-        if isinstance(last.body, dict):
-            message = (last.body.get("error") or {}).get("message", "")
-        raise GCPApiError(last.status, message, last.body)
+            error = GCPApiError(resp.status, message, resp.body)
+            error.retriable = (
+                resp.status in self.RETRIABLE_STATUSES
+                and not ambiguous_transport)
+            raise error
+
+        try:
+            return call_with_retry(once, self._policy, sleep=self._sleep)
+        except RetriesExhausted as e:
+            raise e.last from None
 
     def get(self, url: str) -> Any:
         return self.request("GET", url)

@@ -49,11 +49,27 @@ def read_pid(name: str) -> Optional[int]:
 def pid_alive(pid: int) -> bool:
     try:
         os.kill(pid, 0)
-        return True
     except ProcessLookupError:
         return False
     except PermissionError:
         return True
+    # signal 0 also succeeds for a ZOMBIE — a dead child whose parent
+    # (us, when the stopper spawned the service) has not reaped it yet.
+    # Without this check stop_service waits its full SIGTERM->SIGKILL
+    # timeout on a process that is already gone.
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            # field 3 (after the parenthesized comm, which may itself
+            # contain spaces) is the state letter
+            if f.read().rsplit(")", 1)[-1].split()[0] == "Z":
+                try:
+                    os.waitpid(pid, os.WNOHANG)   # reap if it is ours
+                except (ChildProcessError, OSError):
+                    pass
+                return False
+    except (OSError, IndexError):
+        pass  # no /proc (non-Linux): keep the signal-0 answer
+    return True
 
 
 def service_running(name: str) -> bool:

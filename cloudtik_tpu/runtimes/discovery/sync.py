@@ -52,13 +52,11 @@ def next_delay(interval: float, consecutive_failures: int,
                max_backoff: float = 60.0, jitter: float = 0.1) -> float:
     """Poll delay: base interval on success; exponential backoff with
     jitter while the head store is unreachable so a restarting head isn't
-    hammered by every node's sync daemon at once."""
-    import random
-    if consecutive_failures <= 0:
-        delay = interval
-    else:
-        delay = min(interval * (2 ** consecutive_failures), max_backoff)
-    return delay * (1.0 + random.uniform(-jitter, jitter))
+    hammered by every node's sync daemon at once.  Delegates to the
+    tree-wide audited policy in utils/retry.py."""
+    from cloudtik_tpu.utils.retry import poll_delay
+    return poll_delay(interval, consecutive_failures,
+                      max_delay_s=max_backoff, jitter=jitter)
 
 
 def run_loop(registry, home: str, interval: float,

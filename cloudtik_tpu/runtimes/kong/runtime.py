@@ -19,6 +19,7 @@ admin-API-driven config flow, runtime/kong/utils.py).  Two layers:
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import urllib.error
@@ -119,6 +120,15 @@ class KongAdminClient:
         Kong accepts (every entity endpoint returns 405 there)."""
         self._req("POST", "/config", {"config": kong_yml})
 
+    def configuration_hash(self) -> Optional[str]:
+        """Kong's own hash of its CURRENT in-memory config (GET /status,
+        dbless); None when unavailable (older Kong, request failure)."""
+        try:
+            value = self._req("GET", "/status").get("configuration_hash")
+            return str(value) if value else None
+        except Exception:
+            return None
+
     def sync_targets(self, upstream: str, want: List[str]) -> None:
         have = set(self.list_targets(upstream))
         for target in sorted(set(want) - have):
@@ -154,6 +164,13 @@ class KongRuntime(ServiceRuntimeBase):
     PROCESS_KEYWORD = "kong"
     EXTERNAL_SERVICE = True   # kong start daemonizes via its packaging
     ENDPOINT_NAME = "Kong API Gateway"
+    # dbless sync memo: hash of the last document Kong accepted, Kong's
+    # own configuration_hash right after that POST, and how many ticks
+    # have been skipped since (bounds restart blindness when Kong does
+    # not expose a configuration_hash)
+    _last_dbless_hash: Optional[str] = None
+    _last_kong_hash: Optional[str] = None
+    _skipped_syncs: int = 0
 
     def node_configure(self, node_context: Dict[str, Any]) -> None:
         if not self.runs_on(node_context):
@@ -171,16 +188,45 @@ class KongRuntime(ServiceRuntimeBase):
                                            KONG_ADMIN_PORT))
 
     def sync_once(self, node_context: Dict[str, Any],
-                  admin: Optional[KongAdminClient] = None) -> None:
-        """One reconfiguration pass against the admin API."""
+                  admin: Optional[KongAdminClient] = None) -> bool:
+        """One reconfiguration pass against the admin API.  Returns True
+        when a reconfiguration was actually pushed.
+
+        DB-less `POST /config` atomically swaps Kong's ENTIRE state and
+        resets active-health-check accumulation on every upstream, so an
+        unchanged document must NOT be re-posted every tick (mirror of
+        APISIXRuntime.render_once's unchanged-render skip): the last
+        pushed document's hash is cached and compared first."""
         admin = admin or KongAdminClient(
             f"http://127.0.0.1:{self.admin_port}")
         services = _discovered_http_services(
             node_context, self.runtime_config)
         if self.runtime_config.get("admin_mode", "dbless") == "db":
             sync_gateway(admin, services)
-        else:
-            admin.reload_declarative(render_kong_declarative(services))
+            return True
+        rendered = render_kong_declarative(services)
+        digest = hashlib.sha256(rendered.encode()).hexdigest()
+        if digest == self._last_dbless_hash:
+            # unchanged render — but a RESTARTED Kong holds dbless state
+            # only in memory, so confirm it still has what we pushed:
+            # its /status configuration_hash must match the one observed
+            # right after our last POST.  Without that signal, cap the
+            # skip streak so restart blindness is time-bounded.
+            kong_hash = admin.configuration_hash()
+            if kong_hash is not None:
+                if kong_hash == self._last_kong_hash:
+                    return False
+            elif self._skipped_syncs < int(
+                    self.runtime_config.get("sync_refresh_ticks", 30)):
+                self._skipped_syncs += 1
+                return False
+        admin.reload_declarative(rendered)
+        # only remember state Kong actually accepted — a failed POST
+        # must be retried next tick
+        self._last_dbless_hash = digest
+        self._last_kong_hash = admin.configuration_hash()
+        self._skipped_syncs = 0
+        return True
 
     def post_start(self, node_context: Dict[str, Any]) -> None:
         """Live admin-API sync: the gateway keeps tracking discovery

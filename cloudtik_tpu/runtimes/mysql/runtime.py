@@ -43,6 +43,13 @@ def render_my_cnf(server_id: int, port: int = MYSQL_PORT,
     return "\n".join(lines) + "\n"
 
 
+def _sql_quote(value: str) -> str:
+    """Single-quoted MySQL string literal: ' doubles, \\ escapes — a
+    password like o'brien must not truncate (or inject into) the
+    CHANGE REPLICATION SOURCE statement."""
+    return "'" + str(value).replace("\\", "\\\\").replace("'", "''") + "'"
+
+
 def render_change_source_sql(source_ip: str, port: int = MYSQL_PORT,
                              user: str = "replicator",
                              password: str = "") -> str:
@@ -54,10 +61,10 @@ def render_change_source_sql(source_ip: str, port: int = MYSQL_PORT,
     return (
         "STOP REPLICA;\n"
         "CHANGE REPLICATION SOURCE TO\n"
-        f"  SOURCE_HOST='{source_ip}',\n"
-        f"  SOURCE_PORT={port},\n"
-        f"  SOURCE_USER='{user}',\n"
-        f"  SOURCE_PASSWORD='{password}',\n"
+        f"  SOURCE_HOST={_sql_quote(source_ip)},\n"
+        f"  SOURCE_PORT={int(port)},\n"
+        f"  SOURCE_USER={_sql_quote(user)},\n"
+        f"  SOURCE_PASSWORD={_sql_quote(password)},\n"
         "  SOURCE_AUTO_POSITION=1;\n"
         "START REPLICA;\n")
 
@@ -95,14 +102,20 @@ class MySQLRuntime(ServiceRuntimeBase):
         with open(os.path.join(conf_dir, "my.cnf"), "w") as f:
             f.write(conf)
         if not is_head:
-            with open(os.path.join(conf_dir,
-                                   "replica-setup.sql"), "w") as f:
+            sql_path = os.path.join(conf_dir, "replica-setup.sql")
+            # the rendered file embeds the replication password: create
+            # it 0600 from the first byte (a chmod after writing leaves
+            # a world-readable window under the default umask)
+            fd = os.open(sql_path,
+                         os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+            with os.fdopen(fd, "w") as f:
                 f.write(render_change_source_sql(
                     node_context.get("head_ip", ""), port=self.port,
                     user=self.runtime_config.get(
                         "replication_user", "replicator"),
                     password=self.runtime_config.get(
                         "replication_password", "")))
+            os.chmod(sql_path, 0o600)  # O_TRUNC path: tighten pre-existing
 
     def run_sql(self, sql: str) -> None:
         """Feed SQL to the local server via the mysql client (no-op when

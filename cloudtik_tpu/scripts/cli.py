@@ -745,6 +745,72 @@ def node_dump(output):
     cli_logger.success("Node debug archive written to {}.", path)
 
 
+# ------------------------------------------------------------------ chaos --
+
+@cli.group()
+def chaos():
+    """Deterministic fault-injection drills (docs/fault-injection.md).
+
+    Plans are seeded YAML schedules of faults fired at injection seams
+    threaded through the control plane, trainer, and serve engine; with
+    no plan armed every seam is a single-attribute-check no-op."""
+
+
+@chaos.command(name="validate")
+@click.argument("plan_file", type=click.Path(exists=True))
+def chaos_validate(plan_file):
+    """Parse and schema-check a fault plan."""
+    from cloudtik_tpu.faults.chaos import validate_plan
+    try:
+        spec = validate_plan(plan_file)
+    except Exception as e:  # bad YAML, wrong shape, unknown kinds, ...
+        cli_logger.abort("Invalid fault plan: {}", e)
+    click.echo(json.dumps(spec, indent=2))
+    cli_logger.success("Plan is valid ({} fault point(s)).",
+                       len(spec["faults"]))
+
+
+@chaos.command(name="run")
+@click.argument("plan_file", type=click.Path(exists=True))
+@click.option("--config", "config_file", required=True,
+              type=click.Path(exists=True),
+              help="Cluster config to drill (virtual/mock providers).")
+@click.option("--passes", default=5, show_default=True,
+              help="Scaler reconciliation passes to drive.")
+@click.option("--interval", default=0.5, show_default=True,
+              help="Seconds between passes.")
+@click.option("--json", "as_json", is_flag=True,
+              help="Emit the full result as JSON.")
+def chaos_run(plan_file, config_file, passes, interval, as_json):
+    """Arm PLAN_FILE and drive scaler passes against a virtual cluster.
+
+    The plan's injection trace is printed afterwards — same seed, same
+    cluster, same trace."""
+    from cloudtik_tpu.faults.chaos import format_trace, run_drill
+    from cloudtik_tpu.faults.plan import load_plan
+    config = _load(config_file)
+    provider_type = config.get("provider", {}).get("type", "")
+    if provider_type not in ("virtual", "mock", "onpremise"):
+        cli_logger.abort(
+            "chaos run only drills virtual/mock clusters (got provider "
+            "{}); arm real clusters explicitly via TIK_FAULT_PLAN.",
+            provider_type)
+    plan = load_plan(plan_file)
+    result = run_drill(config, plan, passes=passes, interval_s=interval)
+    if as_json:
+        click.echo(json.dumps(result, indent=2, default=str))
+        return
+    cli_logger.print("Injection trace ({} fault(s) fired):",
+                     len(result["trace"]))
+    click.echo(format_trace(result))
+    if result["errors"]:
+        cli_logger.print("Surfaced errors: {}", result["errors"])
+    summary = result["summary"]
+    cli_logger.print(
+        "Post-drill: {} worker(s), pending launches {}.",
+        summary["num_workers"], summary["pending_launches"])
+
+
 def main():
     from cloudtik_tpu.control.executor.base import CommandError
     try:

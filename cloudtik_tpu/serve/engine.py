@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from cloudtik_tpu.faults import seams
 from cloudtik_tpu.models.generate import (
     _NEG, _rms_norm, forward_step, init_cache)
 from cloudtik_tpu.models.transformer import (
@@ -270,20 +271,43 @@ class DecodeEngine:
     def stop(self) -> None:
         self._stop.set()
         self._wake.set()
-        if self._thread is not None:
-            self._thread.join(timeout=10)
-        # fail everything still queued or mid-decode — callers must not
-        # sit in wait() until their timeout after a shutdown
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=10)
+            if thread.is_alive():
+                # wedged mid-step (e.g. a stuck device call): the loop
+                # thread still OWNS the slot state — mutating _slots from
+                # here would race its next host-side pass, so it runs
+                # slot teardown itself whenever it does exit.  The queue
+                # is a thread-safe Queue with no slot state though:
+                # fail never-admitted requests NOW rather than leaving
+                # callers blocked until their full wait timeout.
+                logger.warning(
+                    "decode loop did not exit within 10s; deferring "
+                    "slot teardown to the loop thread")
+                self._drain_queue("engine stopped")
+                return
+        # loop exited (its finally already drained) or never started:
+        # a second drain here is an idempotent no-op, and the only way
+        # to fail requests queued on a never-started engine
+        self._teardown()
+
+    def _drain_queue(self, reason: str) -> None:
         while True:
             try:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
-            req.error = RuntimeError("engine stopped")
+            req.error = RuntimeError(reason)
             req._done.set()
+
+    def _teardown(self, reason: str = "engine stopped") -> None:
+        """Fail everything still queued or mid-decode — callers must not
+        sit in wait() until their timeout after a shutdown."""
+        self._drain_queue(reason)
         for slot_id, slot in enumerate(self._slots):
             if slot is not None:
-                slot.request.error = RuntimeError("engine stopped")
+                slot.request.error = RuntimeError(reason)
                 slot.request._done.set()
                 self._slots[slot_id] = None
 
@@ -326,6 +350,8 @@ class DecodeEngine:
                 req._done.set()
 
     def _step(self) -> None:
+        seams.fire("serve.decode_step",
+                   active=sum(s is not None for s in self._slots))
         active_mask = np.array(
             [s is not None for s in self._slots], np.bool_)
         temps = np.array(
@@ -354,20 +380,26 @@ class DecodeEngine:
                 self._slots[slot_id] = None
 
     def _loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                self._admit()
-                if any(s is not None for s in self._slots):
-                    self._step()
-                elif self._queue.empty():
-                    self._wake.wait(timeout=0.5)
-                    self._wake.clear()
-            except Exception:
-                logger.exception("decode engine loop error")
-                # fail everything in flight rather than hang callers
-                for slot_id, slot in enumerate(self._slots):
-                    if slot is not None:
-                        slot.request.error = RuntimeError(
-                            "engine loop failed; see logs")
-                        slot.request._done.set()
-                        self._slots[slot_id] = None
+        try:
+            while not self._stop.is_set():
+                try:
+                    self._admit()
+                    if any(s is not None for s in self._slots):
+                        self._step()
+                    elif self._queue.empty():
+                        self._wake.wait(timeout=0.5)
+                        self._wake.clear()
+                except Exception:
+                    logger.exception("decode engine loop error")
+                    # fail everything in flight rather than hang callers
+                    for slot_id, slot in enumerate(self._slots):
+                        if slot is not None:
+                            slot.request.error = RuntimeError(
+                                "engine loop failed; see logs")
+                            slot.request._done.set()
+                            self._slots[slot_id] = None
+        finally:
+            # slot/queue teardown happens HERE, on the thread that owns
+            # the slot state — stop() only joins and falls back to a
+            # caller-side drain when this thread never ran
+            self._teardown()

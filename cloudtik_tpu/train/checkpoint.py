@@ -21,10 +21,16 @@ Design (TPU-first):
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 from typing import Any, Optional
 
 import jax
+
+from cloudtik_tpu.faults import seams
+from cloudtik_tpu.faults.plan import DIRECTIVE_TORN_WRITE
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -66,12 +72,44 @@ class Checkpointer:
     # -- save --------------------------------------------------------------
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         """Async-save `state` at `step`; returns True if a save started."""
-        return self._manager.save(
+        # fire the seam only for saves that will actually start — a
+        # skipped (off-interval) call must not consume a scheduled
+        # fault's budget with nothing written to tear
+        directive = None
+        if force or self._manager.should_save(step):
+            directive = seams.fire("checkpoint.save", step=step,
+                                   directory=self.config.directory)
+        saved = self._manager.save(
             step,
             args=self._ocp.args.Composite(
                 state=self._ocp.args.StandardSave(state)),
             force=force,
         )
+        if saved and directive == DIRECTIVE_TORN_WRITE:
+            # drill point: let the write land, then tear it — the step
+            # LOOKS committed (dir present, listed by latest_step) but
+            # its data is truncated, which is what a host dying between
+            # data write and durable flush leaves behind
+            self.wait()
+            self._tear_step(step)
+        return saved
+
+    def _tear_step(self, step: int) -> None:
+        """Truncate the largest data file of a committed step in place."""
+        root = os.path.join(str(self._manager.directory), str(step))
+        largest, largest_size = None, -1
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for name in filenames:
+                path = os.path.join(dirpath, name)
+                size = os.path.getsize(path)
+                if size > largest_size:
+                    largest, largest_size = path, size
+        if largest is None:
+            return
+        with open(largest, "r+b") as f:
+            f.truncate(max(largest_size // 2, 1))
+        logger.warning("torn-write fault: truncated %s (%d -> %d bytes)",
+                       largest, largest_size, max(largest_size // 2, 1))
 
     def wait(self) -> None:
         """Block until all in-flight async saves are durable."""
@@ -131,14 +169,53 @@ class Checkpointer:
 
         ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
         try:
-            return ckptr.restore(
-                path,
-                args=ocp.args.PyTreeRestore(
+            try:
+                restore_args = ocp.args.PyTreeRestore(
                     item=abstract,
                     restore_args=jax.tree.map(_restore_arg, abstract),
-                    partial_restore=True))
+                    partial_restore=True)
+            except TypeError:
+                # older orbax has no partial_restore kwarg; an empty
+                # `transforms` is its spelling of "materialize only the
+                # subtrees named in `item`, values from the checkpoint"
+                restore_args = ocp.args.PyTreeRestore(
+                    item=abstract,
+                    restore_args=jax.tree.map(_restore_arg, abstract),
+                    transforms={})
+            return ckptr.restore(path, args=restore_args)
         finally:
             ckptr.close()
+
+    def restore_latest_good(self, state_like: Any,
+                            partial: bool = False) -> Optional[tuple]:
+        """Restore the newest checkpoint that actually reads back.
+
+        A step directory can be committed yet unreadable (torn write: the
+        host died between data write and flush).  Walk steps newest-first,
+        skip any that fail to restore, return (state, step) from the
+        first good one.  Returns None only when there are NO checkpoints;
+        when checkpoints exist but none restores, the failure is systemic
+        (storage outage, sharding mismatch), not a torn write — raise it
+        rather than let the caller silently restart from step 0 and age
+        good checkpoints out of the retention window."""
+        steps = sorted(self.all_steps(), reverse=True)
+        if not steps:
+            return None
+        last_error: Optional[Exception] = None
+        for step in steps:
+            try:
+                return self.restore(state_like, step=step,
+                                    partial=partial), step
+            except Exception as e:
+                last_error = e
+                logger.warning(
+                    "checkpoint step %d unreadable (torn write?); "
+                    "falling back to the previous committed step",
+                    step, exc_info=True)
+        raise RuntimeError(
+            f"none of the {len(steps)} checkpoints under "
+            f"{self.config.directory} could be restored; refusing to "
+            "silently restart from scratch") from last_error
 
     def close(self) -> None:
         self._manager.close()

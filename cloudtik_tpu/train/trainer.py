@@ -289,13 +289,24 @@ class Trainer:
             shapes, shardings)
 
     def maybe_resume(self) -> Optional[int]:
-        """Resume from the latest checkpoint if one exists."""
+        """Resume from the newest *readable* checkpoint, if any.
+
+        Torn-write tolerant: a committed-looking step whose data does not
+        read back (host died mid-flush) is skipped and the previous
+        committed step is used instead."""
         if self.checkpointer is None:
             return None
-        latest = self.checkpointer.latest_step()
-        if latest is None:
+        if not self.checkpointer.all_steps():
+            # fresh run: skip building the abstract state (a full
+            # eval_shape trace of model + optimizer init) for nothing
             return None
-        return self.restore_checkpoint(latest)
+        restored = self.checkpointer.restore_latest_good(
+            self._abstract_state())
+        if restored is None:
+            return None
+        self.state, step = restored
+        self.step = int(step)
+        return self.step
 
     # -- step --------------------------------------------------------------
     def _build_step(self):

@@ -3,6 +3,16 @@
 from __future__ import annotations
 
 import os
+import sys
+
+# The pipelines are runnable straight from a checkout (`python
+# examples/pipelines/x.py`): when the package is not pip-installed, put
+# the repo root on sys.path before any `from cloudtik_tpu...` import.
+try:
+    import cloudtik_tpu  # noqa: F401
+except ModuleNotFoundError:
+    sys.path.insert(0, os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "..")))
 
 
 def pin_platform(default: str = "cpu") -> None:

@@ -25,3 +25,10 @@ os.environ.setdefault("TIK_TEST_MODE", "1")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Backfill newer jax APIs (set_mesh/get_abstract_mesh/shard_map) on older
+# runtimes — tests call them directly, before any library import would
+# have installed the shim.
+from cloudtik_tpu.parallel.jax_compat import install as _install  # noqa: E402
+
+_install()

@@ -1,0 +1,245 @@
+"""End-to-end failure drills: injected faults -> observed recovery.
+
+The three drills the CI gate runs on every PR (chaos-marked, CPU
+backend, bounded iterations):
+
+  (a) a seeded plan preempts the TPU node group mid-training; the
+      scaler recycles the slice and the trainer resumes from the last
+      committed checkpoint with a BIT-FOR-BIT identical post-resume
+      loss trajectory vs an uninterrupted run from that checkpoint;
+  (b) a torn checkpoint write (truncated before its data is complete)
+      is skipped on restore in favor of the previous committed step;
+  (c) a heartbeat blackout shorter than TIK_BOOT_GRACE_S causes NO
+      recycle (no false-positive condemnation).
+"""
+
+import itertools
+import time
+
+import pytest
+
+from cloudtik_tpu.control.metrics import ClusterMetrics
+from cloudtik_tpu.control.state import (
+    InMemoryStateBackend, StateClient, TABLE_HEARTBEAT)
+from cloudtik_tpu.core.tags import (
+    NODE_KIND_WORKER, STATUS_UP_TO_DATE, TAG_NODE_KIND, TAG_NODE_STATUS,
+    TAG_USER_NODE_TYPE)
+from cloudtik_tpu.faults import seams
+from cloudtik_tpu.faults.plan import FaultPlan, FaultPoint
+
+from tests.mock_infra import MockProvider
+from tests.test_scaler import base_config, make_scaler, wait_for
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    seams.disarm()
+    yield
+    seams.disarm()
+
+
+def _tiny_trainer(ckpt_dir, checkpoint_every=2):
+    from cloudtik_tpu.models import transformer as T
+    from cloudtik_tpu.train.trainer import (
+        Trainer, TrainerConfig, transformer_spec)
+
+    cfg = T.config("tiny", n_heads=8, n_kv_heads=8, d_ff=128, remat=False)
+    spec = transformer_spec(cfg)
+    trainer = Trainer(spec, TrainerConfig(
+        global_batch_size=8, seq_len=64, log_every=1,
+        checkpoint_every=checkpoint_every, checkpoint_dir=ckpt_dir))
+    return cfg, spec, trainer
+
+
+def _batches(cfg, skip=0):
+    from cloudtik_tpu.train.data import synthetic_lm_batches
+    data = synthetic_lm_batches(8, 64, cfg.vocab_size, seed=0)
+    return itertools.islice(data, skip, None)
+
+
+@pytest.mark.chaos
+def test_drill_preempted_slice_recycles_and_training_resumes_bitwise(
+        tmp_path):
+    """Drill (a): preempt-node-group mid-run -> slice recycled ->
+    bit-for-bit resume from the last committed checkpoint."""
+    from cloudtik_tpu.faults.chaos import run_drill
+
+    # --- cluster with one live slice, training with async checkpoints
+    provider = MockProvider(with_groups=True)
+    config = base_config(min_workers=0, with_tpu_group=True)
+    config["available_node_types"]["tpu"]["min_workers"] = 1
+    group_id = provider.create_node_group(
+        {}, {TAG_NODE_KIND: NODE_KIND_WORKER,
+             TAG_USER_NODE_TYPE: "tpu",
+             TAG_NODE_STATUS: STATUS_UP_TO_DATE}, 4)
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    cfg, spec, trainer = _tiny_trainer(ckpt_dir)
+    trainer.fit(_batches(cfg), num_steps=4)
+    trainer.checkpointer.wait()          # async save at step 4 must land
+    saved_step = trainer.step
+    assert saved_step == 4
+
+    # --- seeded plan: preempt the slice on the 2nd reconciliation pass
+    plan = FaultPlan([FaultPoint("provider.non_terminated_nodes",
+                                 "preempt_node_group", at_call=2,
+                                 times=1)], seed=42, name="preempt-drill")
+    executors = {}
+
+    def factory(node_id):
+        from tests.mock_infra import MockExecutor
+        executor = MockExecutor(node_id)
+        executors[node_id] = executor
+        return executor
+
+    result = run_drill(config, plan, passes=3, interval_s=0.2,
+                       provider=provider, executor_factory=factory)
+
+    # the injected preemption is in the trace, aimed at our slice
+    assert [e for e in result["trace"]
+            if e["kind"] == "preempt_node_group"
+            and e.get("group_id") == group_id]
+    assert group_id in provider.terminated_groups
+    # ... and the scaler recycled it: a NEW group back at min_workers
+    assert wait_for(lambda: len(provider.mock_nodes()) == 4)
+    new_groups = provider.list_node_groups({})
+    assert new_groups and list(new_groups) != [group_id]
+
+    # --- reference: uninterrupted continuation from the checkpoint
+    _, _, reference = _tiny_trainer(ckpt_dir, checkpoint_every=1000)
+    assert reference.maybe_resume() == saved_step
+    ref_out = reference.fit(_batches(cfg, skip=4), num_steps=2)
+
+    # --- drill: fresh trainer on the recycled slice resumes and matches
+    _, _, resumed = _tiny_trainer(ckpt_dir, checkpoint_every=1000)
+    assert resumed.maybe_resume() == saved_step
+    out = resumed.fit(_batches(cfg, skip=4), num_steps=2)
+
+    ref_losses = [e["loss"] for e in ref_out["history"]]
+    losses = [e["loss"] for e in out["history"]]
+    assert losses == ref_losses  # bit-for-bit, not approx
+
+
+@pytest.mark.chaos
+def test_drill_torn_checkpoint_falls_back_to_previous_committed_step(
+        tmp_path):
+    """Drill (b): the torn step LOOKS committed but does not read back;
+    restore skips it and resumes from the previous committed step."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    cfg, spec, trainer = _tiny_trainer(ckpt_dir)
+
+    plan = FaultPlan([FaultPoint("checkpoint.save", "torn_write",
+                                 match={"step": 4})], seed=7,
+                     name="torn-write-drill")
+    with seams.armed(plan):
+        trainer.fit(_batches(cfg), num_steps=4)   # saves at steps 2, 4
+        trainer.checkpointer.wait()
+    assert [e for e in plan.trace if e["kind"] == "torn_write"]
+
+    _, _, resumed = _tiny_trainer(ckpt_dir, checkpoint_every=1000)
+    # step 4 is still listed (it looks committed)...
+    assert resumed.checkpointer.latest_step() == 4
+    # ...but resume skips the corrupt step and lands on step 2
+    assert resumed.maybe_resume() == 2
+    out = resumed.fit(_batches(cfg, skip=2), num_steps=1)
+    assert out["final_step"] == 3
+
+
+@pytest.mark.chaos
+def test_drill_heartbeat_blackout_under_grace_is_not_condemned():
+    """Drill (c): a blackout shorter than TIK_BOOT_GRACE_S must not
+    recycle the node's group — the boot-grace window absorbs it."""
+    provider = MockProvider(with_groups=True)
+    config = base_config(min_workers=0, with_tpu_group=True)
+    scaler, metrics, executors = make_scaler(config, provider)
+    group_id = provider.create_node_group(
+        {}, {TAG_NODE_KIND: NODE_KIND_WORKER,
+             TAG_USER_NODE_TYPE: "tpu",
+             TAG_NODE_STATUS: STATUS_UP_TO_DATE}, 4)
+    nodes = provider.non_terminated_nodes({})
+
+    state = StateClient(InMemoryStateBackend())
+    from cloudtik_tpu.control.node_agent import NodeAgent
+    agents = [NodeAgent(state, node_id,
+                        node_ip=provider.internal_ip(node_id),
+                        total_resources={"CPU": 1})
+              for node_id in nodes]
+
+    def pull_heartbeats():
+        for node_id, hb in state.table_list(TABLE_HEARTBEAT).items():
+            metrics.update_heartbeat(
+                hb.get("node_ip", ""), node_id, hb.get("time"))
+
+    # blackout: the FIRST 3 beats of node 0 are dropped (deterministic
+    # count-based window — shorter than any sane boot grace)
+    plan = FaultPlan([FaultPoint("node_agent.heartbeat", "drop", times=3,
+                                 match={"ip": provider.internal_ip(
+                                     nodes[0])})],
+                     seed=11, name="blackout-drill")
+    try:
+        with seams.armed(plan):
+            for tick in range(3):
+                for agent in agents:
+                    agent.heartbeat_once()
+                pull_heartbeats()
+                scaler.update()
+                # blackout < grace: NOTHING may be condemned
+                assert provider.terminated_groups == []
+                assert len(provider.mock_nodes()) == 4
+            # blackout ends; the next beat goes through
+            for agent in agents:
+                agent.heartbeat_once()
+            pull_heartbeats()
+            scaler.update()
+        assert plan.points[0].fired == 3
+        assert provider.terminated_groups == []
+        assert len(provider.mock_nodes()) == 4
+        assert metrics.heartbeat_on_time(
+            provider.internal_ip(nodes[0]), time.time())
+    finally:
+        scaler.shutdown()
+
+
+@pytest.mark.chaos
+def test_chaos_cli_validate_and_run(tmp_path):
+    """`tik chaos` drives the same drill harness from the CLI."""
+    from click.testing import CliRunner
+    from cloudtik_tpu.scripts.cli import cli
+
+    plan_file = tmp_path / "plan.yaml"
+    plan_file.write_text(
+        "seed: 3\n"
+        "name: cli-drill\n"
+        "faults:\n"
+        "  - seam: provider.create_node\n"
+        "    kind: raise\n"
+        "    times: 1\n")
+    runner = CliRunner()
+    result = runner.invoke(cli, ["chaos", "validate", str(plan_file)],
+                           catch_exceptions=False)
+    assert result.exit_code == 0
+    assert "cli-drill" in result.output
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("faults:\n  - seam: x\n    kind: explode\n")
+    result = runner.invoke(cli, ["chaos", "validate", str(bad)])
+    assert result.exit_code != 0
+
+
+def test_run_drill_surfaces_injected_launch_failures():
+    """The drill driver reports faults that abort launches without
+    wedging pending accounting (the launcher's failure path)."""
+    from cloudtik_tpu.faults.chaos import run_drill
+
+    provider = MockProvider()
+    config = base_config(min_workers=2)
+    plan = FaultPlan([FaultPoint("provider.create_node", "raise",
+                                 times=1)], seed=1)
+    result = run_drill(config, plan, passes=2, interval_s=0.3,
+                       provider=provider,
+                       executor_factory=lambda node_id: None)
+    assert [e for e in result["trace"] if e["seam"] ==
+            "provider.create_node"]
+    # the injected failure did not wedge the launcher: later passes
+    # brought the cluster back to min_workers
+    assert wait_for(lambda: len(provider.mock_nodes()) == 2)

@@ -1,0 +1,281 @@
+"""Fault-injection subsystem: plan semantics, determinism, seam cost."""
+
+import time
+
+import pytest
+
+from cloudtik_tpu.faults import seams
+from cloudtik_tpu.faults.plan import (
+    DIRECTIVE_DROP, DIRECTIVE_TORN_WRITE, FaultInjected, FaultPlan,
+    FaultPoint, plan_from_dict)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends with no plan armed."""
+    seams.disarm()
+    yield
+    seams.disarm()
+
+
+# ---------------------------------------------------------------- schedule --
+
+def test_raise_once_then_clean():
+    plan = FaultPlan([FaultPoint("x", "raise", times=1)])
+    with pytest.raises(FaultInjected):
+        plan.fire("x", {})
+    assert plan.fire("x", {}) is None
+    assert plan.points[0].fired == 1
+
+
+def test_raise_n_times():
+    plan = FaultPlan([FaultPoint("x", "raise", times=3)])
+    for _ in range(3):
+        with pytest.raises(FaultInjected):
+            plan.fire("x", {})
+    assert plan.fire("x", {}) is None
+
+
+def test_at_call_defers_firing():
+    plan = FaultPlan([FaultPoint("x", "raise", at_call=3, times=1)])
+    assert plan.fire("x", {}) is None
+    assert plan.fire("x", {}) is None
+    with pytest.raises(FaultInjected):
+        plan.fire("x", {})
+
+
+def test_match_filters_context_and_does_not_count_mismatches():
+    plan = FaultPlan([FaultPoint("hb", "drop", times=1,
+                                 match={"ip": "10.0.0.3"})])
+    assert plan.fire("hb", {"ip": "10.0.0.4"}) is None
+    assert plan.points[0].calls == 0  # mismatch: schedule did not advance
+    assert plan.fire("hb", {"ip": "10.0.0.3"}) == DIRECTIVE_DROP
+
+
+def test_glob_seam_matching():
+    plan = FaultPlan([FaultPoint("provider.*", "raise", times=2)])
+    with pytest.raises(FaultInjected):
+        plan.fire("provider.create_node", {})
+    with pytest.raises(FaultInjected):
+        plan.fire("provider.terminate_node", {})
+    assert plan.fire("state.put", {}) is None
+
+
+def test_latency_uses_injectable_sleep():
+    slept = []
+    plan = FaultPlan(
+        [FaultPoint("x", "latency", times=2, args={"seconds": 1.5})],
+        sleep=slept.append)
+    assert plan.fire("x", {}) is None  # operation proceeds after delay
+    assert slept == [1.5]
+
+
+def test_drop_for_s_wall_window():
+    clock = {"now": 0.0}
+    plan = FaultPlan(
+        [FaultPoint("hb", "drop", args={"for_s": 30.0})],
+        clock=lambda: clock["now"])
+    assert plan.fire("hb", {}) == DIRECTIVE_DROP
+    clock["now"] = 29.0
+    assert plan.fire("hb", {}) == DIRECTIVE_DROP   # inside the window
+    clock["now"] = 31.0
+    assert plan.fire("hb", {}) is None             # blackout over
+
+
+def test_torn_write_directive():
+    plan = FaultPlan([FaultPoint("checkpoint.save", "torn_write",
+                                 times=1)])
+    assert plan.fire("checkpoint.save", {"step": 4}) == \
+        DIRECTIVE_TORN_WRITE
+    assert plan.fire("checkpoint.save", {"step": 6}) is None
+
+
+def test_preempt_node_group_terminates_through_provider():
+    from tests.mock_infra import MockProvider
+    provider = MockProvider(with_groups=True)
+    gid = provider.create_node_group({}, {}, 2)
+    plan = FaultPlan([FaultPoint("provider.non_terminated_nodes",
+                                 "preempt_node_group", times=1)])
+    plan.fire("provider.non_terminated_nodes", {"provider": provider})
+    assert provider.terminated_groups == [gid]
+    assert plan.trace[0]["group_id"] == gid
+
+
+def test_unknown_kind_rejected():
+    with pytest.raises(ValueError):
+        FaultPlan([FaultPoint("x", "explode")])
+    with pytest.raises(ValueError):
+        plan_from_dict({"faults": [{"seam": "x", "kind": "raise",
+                                    "typo_field": 1}]})
+
+
+# ------------------------------------------------------------- determinism --
+
+def _probabilistic_trace(seed):
+    plan = FaultPlan(
+        [FaultPoint("x", "drop", times=0, probability=0.5)], seed=seed)
+    out = []
+    for _ in range(64):
+        out.append(plan.fire("x", {}) == DIRECTIVE_DROP)
+    return out, plan
+
+
+def test_same_seed_same_injection_trace():
+    trace_a, plan_a = _probabilistic_trace(1234)
+    trace_b, plan_b = _probabilistic_trace(1234)
+    assert trace_a == trace_b
+    assert plan_a.summary()["trace"] == plan_b.summary()["trace"]
+    trace_c, _ = _probabilistic_trace(99)
+    assert trace_c != trace_a  # different seed, different schedule
+    assert any(trace_a) and not all(trace_a)  # the coin actually flips
+
+
+def test_yaml_plan_round_trip(tmp_path):
+    from cloudtik_tpu.faults.plan import load_plan
+    plan_file = tmp_path / "plan.yaml"
+    plan_file.write_text(
+        "seed: 7\n"
+        "name: drill\n"
+        "faults:\n"
+        "  - seam: node_agent.heartbeat\n"
+        "    kind: drop\n"
+        "    match: {ip: 127.0.0.1}\n"
+        "    args: {for_s: 10}\n"
+        "  - seam: provider.create_node\n"
+        "    kind: raise\n"
+        "    at_call: 2\n")
+    plan = load_plan(str(plan_file))
+    assert plan.seed == 7 and plan.name == "drill"
+    assert plan.points[0].match == {"ip": "127.0.0.1"}
+    assert plan.points[1].at_call == 2
+
+
+# ------------------------------------------------------- seam cost contract --
+
+class _Tripwire:
+    """Stands in for an armed plan; any use proves the no-op path left
+    the single-attribute-check fast path."""
+
+    def fire(self, seam, ctx):
+        raise AssertionError(
+            f"seam {seam} reached plan logic with no plan armed")
+
+
+def test_seams_are_noops_without_a_plan(monkeypatch, tmp_path):
+    """Acceptance: with no plan armed every seam is one attribute check.
+
+    FaultPlan.fire is replaced with a tripwire so ANY entry into plan
+    logic fails loudly; then every instrumented path runs."""
+    monkeypatch.setattr(FaultPlan, "fire", _Tripwire.fire)
+    assert seams.active_plan() is None
+
+    # state store
+    from cloudtik_tpu.control.state import InMemoryStateBackend, StateClient
+    client = StateClient(InMemoryStateBackend())
+    client.kv_put("k", b"v")
+    client.kv_get("k")
+    client.table_put("t", "k", {"a": 1})
+    client.table_get("t", "k")
+
+    # node agent heartbeat
+    from cloudtik_tpu.control.node_agent import NodeAgent
+    agent = NodeAgent(client, "n1", node_ip="127.0.0.1",
+                      total_resources={"CPU": 1})
+    agent.heartbeat_once()
+
+    # local executor
+    from cloudtik_tpu.control.executor.local import LocalCommandExecutor
+
+    class Runner:
+        @staticmethod
+        def check_output(*a, **k):
+            return b""
+
+        @staticmethod
+        def check_call(*a, **k):
+            return 0
+
+    LocalCommandExecutor(process_runner=Runner()).run(
+        "true", with_output=True)
+
+    # scaler snapshot + launch + terminate provider seams
+    from tests.mock_infra import MockProvider
+    from tests.test_scaler import base_config, make_scaler
+    provider = MockProvider()
+    scaler, metrics, executors = make_scaler(
+        base_config(min_workers=1), provider)
+    try:
+        scaler.update()
+        deadline = time.time() + 10
+        while time.time() < deadline and not provider.mock_nodes():
+            time.sleep(0.05)
+        assert provider.mock_nodes()
+    finally:
+        scaler.shutdown()
+
+
+def test_seam_fires_exactly_once_per_operation():
+    """Arm a counting plan: each instrumented op fires its seam once."""
+    from cloudtik_tpu.control.state import InMemoryStateBackend, StateClient
+    plan = FaultPlan([FaultPoint("state.put", "drop", at_call=10 ** 9,
+                                 times=0)])
+    client = StateClient(InMemoryStateBackend())
+    with seams.armed(plan):
+        for i in range(5):
+            client.kv_put(f"k{i}", b"v")
+    assert plan.points[0].calls == 5
+
+
+def test_armed_context_manager_restores_previous_plan():
+    outer = FaultPlan([])
+    inner = FaultPlan([])
+    seams.arm(outer)
+    with seams.armed(inner):
+        assert seams.active_plan() is inner
+    assert seams.active_plan() is outer
+    seams.disarm()
+    assert seams.active_plan() is None
+
+
+def test_arm_from_env(tmp_path, monkeypatch):
+    plan_file = tmp_path / "plan.yaml"
+    plan_file.write_text("seed: 5\nfaults: []\n")
+    monkeypatch.setenv("TIK_FAULT_PLAN", str(plan_file))
+    plan = seams.arm_from_env()
+    assert plan is not None and plan.seed == 5
+    assert seams.active_plan() is plan
+
+
+def test_arm_from_env_nonstrict_survives_bad_plan(tmp_path, monkeypatch):
+    """The import-time arming path must never crash a booting process:
+    a stale path or malformed plan disarms with a warning."""
+    monkeypatch.setenv("TIK_FAULT_PLAN", str(tmp_path / "gone.yaml"))
+    assert seams.arm_from_env(strict=False) is None
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("faults:\n  - seam: x\n    kind: explode\n")
+    monkeypatch.setenv("TIK_FAULT_PLAN", str(bad))
+    assert seams.arm_from_env(strict=False) is None
+    with pytest.raises(ValueError):
+        seams.arm_from_env(strict=True)
+
+
+def test_restore_latest_good_raises_when_nothing_restores(monkeypatch,
+                                                          tmp_path):
+    """Checkpoints exist but NONE restores => systemic failure, not a
+    torn write: raise instead of silently restarting from step 0."""
+    from cloudtik_tpu.train.checkpoint import Checkpointer
+
+    ckpt = object.__new__(Checkpointer)  # no orbax manager needed
+    ckpt.config = type("C", (), {"directory": str(tmp_path)})()
+    monkeypatch.setattr(Checkpointer, "all_steps", lambda self: [2, 4])
+
+    def _broken_restore(self, *a, **k):
+        raise OSError("io down")
+
+    monkeypatch.setattr(Checkpointer, "restore", _broken_restore)
+    with pytest.raises(RuntimeError, match="refusing to silently"):
+        ckpt.restore_latest_good(None)
+    # and with no checkpoints at all, None (fresh run) — not an error
+    monkeypatch.setattr(Checkpointer, "all_steps", lambda self: [])
+    assert ckpt.restore_latest_good(None) is None
+

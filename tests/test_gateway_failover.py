@@ -44,6 +44,9 @@ class FakeKongAdmin:
         self.entities = {"services": {}, "routes": {}, "upstreams": {}}
         self.targets = {}      # upstream -> {target: weight}
         self.declarative = []  # POST /config payloads (DB-less mode)
+        # mirrors Kong's /status configuration_hash: changes with each
+        # accepted dbless config, reverts to the empty hash on restart
+        self.config_hash = "0" * 32
         store = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -73,6 +76,9 @@ class FakeKongAdmin:
                     data = [{"target": t, "weight": w} for t, w in
                             store.targets.get(parts[1], {}).items()]
                     self._send(200, {"data": data})
+                elif parts == ["status"]:
+                    self._send(200,
+                               {"configuration_hash": store.config_hash})
                 else:
                     self._send(404)
 
@@ -81,6 +87,9 @@ class FakeKongAdmin:
                 body = self._body()
                 if parts == ["config"]:       # DB-less declarative swap
                     store.declarative.append(body["config"])
+                    import hashlib
+                    store.config_hash = hashlib.md5(
+                        body["config"].encode()).hexdigest()
                     self._send(201, {})
                     return
                 store.targets.setdefault(parts[1], {})[
@@ -162,6 +171,47 @@ class TestKongAdminSync:
             assert targets[0]["target"] == "10.0.0.2:8200"
             # and no entity writes happened (DB-less would 405 them)
             assert not fake.entities["services"]
+        finally:
+            fake.stop()
+
+    def test_dbless_sync_skips_unchanged_but_catches_kong_restart(self):
+        """An unchanged document must NOT be re-POSTed every tick (each
+        POST /config atomically swaps Kong state and resets health-check
+        accumulation) — but a RESTARTED Kong holds dbless config only in
+        memory, so the skip must notice /status configuration_hash
+        reverting and re-feed it."""
+        from cloudtik_tpu.runtimes.discovery.runtime import ServiceRegistry
+        from cloudtik_tpu.runtimes.kong.runtime import (
+            KongAdminClient, KongRuntime)
+        fake = FakeKongAdmin()
+        try:
+            state = StateClient(InMemoryStateBackend())
+            reg = ServiceRegistry(state, "c1", "w1")
+            reg.register("serving", "n1", "10.0.0.2", 8200,
+                         protocol="http")
+            rt = KongRuntime({"admin_port": fake.port})
+            ctx = {"is_head": True, "node_id": "head",
+                   "state_client": state,
+                   "config": {"cluster_name": "c1",
+                              "workspace_name": "w1"}}
+            admin = KongAdminClient(f"http://127.0.0.1:{fake.port}")
+            assert rt.sync_once(ctx, admin) is True
+            assert len(fake.declarative) == 1
+            # unchanged discovery, healthy Kong -> no further POSTs
+            assert rt.sync_once(ctx, admin) is False
+            assert rt.sync_once(ctx, admin) is False
+            assert len(fake.declarative) == 1
+            # Kong restarts: its in-memory config is gone and /status
+            # reports the empty-config hash -> next tick re-feeds it
+            fake.declarative.clear()
+            fake.config_hash = "0" * 32
+            assert rt.sync_once(ctx, admin) is True
+            assert len(fake.declarative) == 1
+            # a topology change still re-POSTs immediately
+            reg.register("serving", "n2", "10.0.0.3", 8200,
+                         protocol="http")
+            assert rt.sync_once(ctx, admin) is True
+            assert "10.0.0.3:8200" in fake.declarative[-1]
         finally:
             fake.stop()
 

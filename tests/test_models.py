@@ -157,6 +157,7 @@ class TestDiffusion:
         assert ab[0] > 0.99 and ab[-1] < 0.01
         assert (jnp.diff(ab) < 0).all()
 
+    @pytest.mark.slow  # ~20s of UNet compile; forward/loss covered above
     def test_trainer_integration(self):
         cfg = U.config("tiny")
         trainer = Trainer(diffusion_spec(cfg),
@@ -203,14 +204,25 @@ class TestLoRA:
         assert float(jnp.abs(grads["wq"]["b"]).sum()) > 0
 
     def test_trainer_trains_adapters_only(self):
+        import itertools
+
+        from cloudtik_tpu.train.optim import OptimizerConfig
         cfg = T.config("tiny")
         lcfg = LoRAConfig(rank=4)
         base = T.init_params(jax.random.PRNGKey(0), cfg)
+        # warmup must be off: the default schedule's first 5 steps run at
+        # ~lr/100, which moves rank-4 adapters by nothing measurable
         trainer = Trainer(lora_spec(base, cfg, lcfg),
                           TrainerConfig(global_batch_size=8, seq_len=32,
-                                        log_every=1))
-        data = synthetic_lm_batches(8, 32, cfg.vocab_size)
-        out = trainer.fit(data, num_steps=5)
+                                        log_every=1,
+                                        optimizer=OptimizerConfig(
+                                            learning_rate=3e-3,
+                                            warmup_steps=0,
+                                            total_steps=1000)))
+        # one fixed batch: adapter learning must show as a monotone-ish
+        # descent, not get buried under fresh-random-batch loss noise
+        batch = next(synthetic_lm_batches(8, 32, cfg.vocab_size))
+        out = trainer.fit(itertools.repeat(batch), num_steps=5)
         losses = [h["loss"] for h in out["history"]]
         assert losses[-1] < losses[0]
         # trainable state is only the adapters (tiny fraction of base)
@@ -232,7 +244,8 @@ class TestRecipesSmoke:
         ("dlrm_criteo.py", ["--model", "tiny"]),
         ("llama_lora_finetune.py",
          ["--model", "tiny", "--seq-len", "64"]),
-        ("sdxl_fsdp.py", ["--model", "tiny"]),
+        pytest.param("sdxl_fsdp.py", ["--model", "tiny"],
+                     marks=pytest.mark.slow),  # ~20s of UNet compile
     ])
     def test_recipe_one_step(self, script, args):
         import os

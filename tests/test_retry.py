@@ -1,0 +1,161 @@
+"""utils/retry.py: the one audited retry policy for the whole tree."""
+
+import random
+
+import pytest
+
+from cloudtik_tpu.utils.retry import (
+    RetriesExhausted, RetryPolicy, backoff_delay, call_with_retry,
+    poll_delay, retry)
+
+
+class Clock:
+    """Fake monotonic clock advanced by the fake sleep."""
+
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def sleep(self, s):
+        self.now += s
+
+
+def test_succeeds_after_transient_failures():
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    clock = Clock()
+    assert call_with_retry(
+        flaky, RetryPolicy(max_attempts=5, base_delay_s=1.0, jitter=0.0),
+        sleep=clock.sleep, clock=clock) == "ok"
+    assert len(attempts) == 3
+    assert clock.now == pytest.approx(1.0 + 2.0)  # exponential backoff
+
+
+def test_attempts_exhausted_chains_last_error():
+    def always():
+        raise ConnectionError("down")
+
+    clock = Clock()
+    with pytest.raises(RetriesExhausted) as ei:
+        call_with_retry(
+            always, RetryPolicy(max_attempts=3, base_delay_s=0.1,
+                                jitter=0.0),
+            sleep=clock.sleep, clock=clock)
+    assert isinstance(ei.value.last, ConnectionError)
+
+
+def test_deadline_expiry_stops_before_sleeping_past_it():
+    attempts = []
+
+    def always():
+        attempts.append(1)
+        raise ConnectionError("down")
+
+    clock = Clock()
+    with pytest.raises(RetriesExhausted):
+        call_with_retry(
+            always,
+            RetryPolicy(max_attempts=0, base_delay_s=4.0, multiplier=1.0,
+                        jitter=0.0, deadline_s=10.0),
+            sleep=clock.sleep, clock=clock)
+    # attempts at t=0, 4, 8; the sleep to t=12 would cross the deadline
+    assert len(attempts) == 3
+    assert clock.now <= 10.0
+
+
+def test_non_retryable_propagates_unwrapped():
+    def bad():
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):
+        call_with_retry(
+            bad,
+            RetryPolicy(retryable=lambda e: isinstance(e, ConnectionError)),
+            sleep=lambda s: None)
+
+
+def test_jitter_bounds_and_determinism():
+    policy = RetryPolicy(base_delay_s=10.0, multiplier=1.0, jitter=0.2)
+    delays = [backoff_delay(policy, 0, rng=random.Random(k))
+              for k in range(200)]
+    assert all(8.0 <= d <= 12.0 for d in delays)
+    assert len(set(delays)) > 1
+    # same seed -> same jitter draw
+    assert backoff_delay(policy, 0, rng=random.Random(7)) == \
+        backoff_delay(policy, 0, rng=random.Random(7))
+
+
+def test_backoff_ceiling():
+    policy = RetryPolicy(base_delay_s=1.0, multiplier=2.0,
+                         max_delay_s=5.0, jitter=0.0)
+    assert backoff_delay(policy, 10) == 5.0
+
+
+def test_poll_delay_matches_discovery_sync_contract():
+    # healthy: base interval; failing: doubling capped at max
+    assert poll_delay(2.0, 0, jitter=0.0) == 2.0
+    assert [poll_delay(2.0, n, jitter=0.0) for n in (1, 2, 3, 6)] == \
+        [4.0, 8.0, 16.0, 60.0]
+    jittered = {round(poll_delay(2.0, 1), 6) for _ in range(50)}
+    assert len(jittered) > 1
+    assert all(3.6 <= d <= 4.4 for d in jittered)
+
+
+def test_decorator_form():
+    attempts = []
+
+    @retry(RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0),
+           sleep=lambda s: None)
+    def fn(x):
+        attempts.append(1)
+        if len(attempts) < 2:
+            raise ConnectionError("once")
+        return x * 2
+
+    assert fn(21) == 42
+    assert len(attempts) == 2
+
+
+def test_on_retry_observer_sees_each_scheduled_retry():
+    seen = []
+
+    def always():
+        raise ConnectionError("down")
+
+    with pytest.raises(RetriesExhausted):
+        call_with_retry(
+            always, RetryPolicy(max_attempts=3, base_delay_s=1.0,
+                                jitter=0.0),
+            sleep=lambda s: None,
+            on_retry=lambda a, e, d: seen.append((a, d)))
+    assert seen == [(0, 1.0), (1, 2.0)]
+
+
+def test_retry_sleep_is_fault_injectable():
+    """The utils.retry seam lets a chaos plan perturb any retry loop."""
+    from cloudtik_tpu.faults import seams
+    from cloudtik_tpu.faults.plan import FaultPlan, FaultPoint
+
+    def flaky(_attempts=[]):
+        _attempts.append(1)
+        if len(_attempts) < 2:
+            raise ConnectionError("once")
+        return "ok"
+
+    plan = FaultPlan([FaultPoint("utils.retry", "raise", times=1)])
+    with seams.armed(plan):
+        from cloudtik_tpu.faults.plan import FaultInjected
+        with pytest.raises(FaultInjected):
+            call_with_retry(
+                flaky, RetryPolicy(max_attempts=5, base_delay_s=0.0,
+                                   jitter=0.0),
+                sleep=lambda s: None)
+    assert plan.trace and plan.trace[0]["seam"] == "utils.retry"

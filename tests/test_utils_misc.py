@@ -97,9 +97,17 @@ class TestFateSharing:
             print(proc.pid, flush=True)
             time.sleep(120)
         """))
+        # the spawned interpreter must import cloudtik_tpu even when the
+        # package is not installed (checkout-only runs): hand it our root
+        import cloudtik_tpu
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(cloudtik_tpu.__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + \
+            env.get("PYTHONPATH", "")
         parent = subprocess.Popen(
             [sys.executable, str(script)], stdout=subprocess.PIPE,
-            text=True)
+            text=True, env=env)
         child_pid = int(parent.stdout.readline())
         # child alive while parent lives
         os.kill(child_pid, 0)
