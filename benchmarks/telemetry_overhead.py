@@ -1,0 +1,80 @@
+"""Telemetry overhead microbenchmark (bench.py harness style).
+
+Prints ONE JSON line with per-operation costs in nanoseconds for the
+disabled path (the always-paid cost on TIK_TELEMETRY=off processes) and
+the enabled path (span enter/exit, counter inc, histogram observe).
+The acceptance bar: disabled span is a single attribute check — within
+small-integer multiples of a plain function call.
+
+Run: python benchmarks/telemetry_overhead.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import timeit
+
+
+def _ns(stmt, number: int) -> float:
+    return timeit.timeit(stmt, number=number) / number * 1e9
+
+
+def main() -> int:
+    from cloudtik_tpu import telemetry
+    from cloudtik_tpu.telemetry import instruments as ti
+
+    n = 200_000
+
+    def baseline():
+        pass
+
+    baseline_ns = _ns(baseline, n)
+
+    telemetry.disable()
+    disabled_span_ns = _ns(lambda: telemetry.span("executor.run"), n)
+    disabled_span_attrs_ns = _ns(
+        lambda: telemetry.span("executor.run", node_id="n", cmd="c"), n)
+    disabled_counter_ns = _ns(lambda: ti.EXECUTOR_RUNS.inc(result="ok"),
+                              n)
+    disabled_observe_ns = _ns(
+        lambda: ti.EXECUTOR_RUN_SECONDS.observe(0.01), n)
+
+    telemetry.enable()
+    telemetry.reset()
+
+    def enabled_span():
+        with telemetry.span("executor.run", node_id="n"):
+            pass
+
+    enabled_span_ns = _ns(enabled_span, n // 10)
+    enabled_counter_ns = _ns(lambda: ti.EXECUTOR_RUNS.inc(result="ok"),
+                             n)
+    enabled_observe_ns = _ns(
+        lambda: ti.EXECUTOR_RUN_SECONDS.observe(0.01), n)
+    telemetry.reset()
+
+    result = {
+        "metric": "telemetry_span_overhead_enabled_ns",
+        "value": round(enabled_span_ns, 1),
+        "unit": "ns/span",
+        "detail": {
+            "baseline_call_ns": round(baseline_ns, 1),
+            "disabled_span_ns": round(disabled_span_ns, 1),
+            "disabled_span_with_attrs_ns":
+                round(disabled_span_attrs_ns, 1),
+            "disabled_counter_inc_ns": round(disabled_counter_ns, 1),
+            "disabled_histogram_observe_ns":
+                round(disabled_observe_ns, 1),
+            "enabled_span_ns": round(enabled_span_ns, 1),
+            "enabled_counter_inc_ns": round(enabled_counter_ns, 1),
+            "enabled_histogram_observe_ns":
+                round(enabled_observe_ns, 1),
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,9 @@ from __future__ import annotations
 import subprocess
 from typing import Any, Dict, List, Optional
 
+from cloudtik_tpu import telemetry
+from cloudtik_tpu.telemetry import instruments as ti
+
 
 class CommandError(RuntimeError):
     def __init__(self, cmd: str, returncode: int, output: Optional[str] = None):
@@ -61,6 +64,22 @@ class CommandExecutor:
         --shm-size sizing; runtimes declare it via
         get_runtime_shared_memory_ratio)."""
         return None
+
+
+class run_telemetry(telemetry.timed_span):
+    """Span + latency histogram + result counter around one executor
+    run — shared by the ssh/local/docker transports so every command the
+    control plane issues shows up in the same series."""
+
+    def __init__(self, node_id: str, cmd: str):
+        super().__init__("executor.run", ti.EXECUTOR_RUN_SECONDS,
+                         node_id=node_id, cmd=cmd[:120])
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        super().__exit__(exc_type, exc, tb)
+        ti.EXECUTOR_RUNS.inc(
+            result="ok" if exc_type is None else "failed")
+        return False
 
 
 def _shell_env_prefix(env: Optional[Dict[str, str]]) -> str:

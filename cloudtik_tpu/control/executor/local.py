@@ -14,7 +14,7 @@ import subprocess
 from typing import Any, Dict, Optional
 
 from cloudtik_tpu.control.executor.base import (
-    CommandError, CommandExecutor, _shell_env_prefix)
+    CommandError, CommandExecutor, _shell_env_prefix, run_telemetry)
 from cloudtik_tpu.faults import seams
 
 
@@ -32,29 +32,31 @@ class LocalCommandExecutor(CommandExecutor):
         # match filters must behave identically on local/virtual drills
         seams.fire("executor.run", node_id=self.node_id, cmd=cmd)
         full_cmd = _shell_env_prefix(environment_variables) + cmd
-        if not with_output and self.process_runner is subprocess:
-            # real execution path: stream per-line with the node prefix
-            # while keeping a bounded tail for the failure report
-            # (reference subprocess_output_util.py:392)
-            from cloudtik_tpu.utils.subprocess_output import (
-                run_with_streaming_output)
-            rc, tail = run_with_streaming_output(
-                full_cmd, prefix=self.log_prefix, timeout=timeout)
-            if rc != 0:
-                raise CommandError(cmd, rc, tail)
-            return None
-        try:
-            if with_output:
-                out = self.process_runner.check_output(
-                    full_cmd, shell=True, stderr=subprocess.STDOUT,
-                    timeout=timeout)
-                return out.decode() if isinstance(out, bytes) else out
-            self.process_runner.check_call(
-                full_cmd, shell=True, timeout=timeout)
-            return None
-        except subprocess.CalledProcessError as e:
-            raise CommandError(cmd, e.returncode,
-                               getattr(e, "output", None) and str(e.output))
+        with run_telemetry(self.node_id, cmd):
+            if not with_output and self.process_runner is subprocess:
+                # real execution path: stream per-line with the node
+                # prefix while keeping a bounded tail for the failure
+                # report (reference subprocess_output_util.py:392)
+                from cloudtik_tpu.utils.subprocess_output import (
+                    run_with_streaming_output)
+                rc, tail = run_with_streaming_output(
+                    full_cmd, prefix=self.log_prefix, timeout=timeout)
+                if rc != 0:
+                    raise CommandError(cmd, rc, tail)
+                return None
+            try:
+                if with_output:
+                    out = self.process_runner.check_output(
+                        full_cmd, shell=True, stderr=subprocess.STDOUT,
+                        timeout=timeout)
+                    return out.decode() if isinstance(out, bytes) else out
+                self.process_runner.check_call(
+                    full_cmd, shell=True, timeout=timeout)
+                return None
+            except subprocess.CalledProcessError as e:
+                raise CommandError(
+                    cmd, e.returncode,
+                    getattr(e, "output", None) and str(e.output))
 
     def _copy(self, source: str, target: str) -> None:
         target_dir = os.path.dirname(target)

@@ -13,7 +13,7 @@ import subprocess
 from typing import Any, Dict, List, Optional
 
 from cloudtik_tpu.control.executor.base import (
-    CommandError, CommandExecutor, _shell_env_prefix)
+    CommandError, CommandExecutor, _shell_env_prefix, run_telemetry)
 from cloudtik_tpu.faults import seams
 from cloudtik_tpu.utils.retry import (
     RetriesExhausted, RetryPolicy, call_with_retry)
@@ -103,16 +103,18 @@ class SSHCommandExecutor(CommandExecutor):
             f"{self.ssh_user}@{self.ssh_ip}",
             f"bash --login -c -i {wrapped}",
         ]
-        try:
-            if with_output:
-                out = self.process_runner.check_output(
-                    final, stderr=subprocess.STDOUT, timeout=timeout)
-                return out.decode() if isinstance(out, bytes) else out
-            self.process_runner.check_call(final, timeout=timeout)
-            return None
-        except subprocess.CalledProcessError as e:
-            raise CommandError(cmd, e.returncode,
-                               getattr(e, "output", None) and str(e.output))
+        with run_telemetry(self.node_id, cmd):
+            try:
+                if with_output:
+                    out = self.process_runner.check_output(
+                        final, stderr=subprocess.STDOUT, timeout=timeout)
+                    return out.decode() if isinstance(out, bytes) else out
+                self.process_runner.check_call(final, timeout=timeout)
+                return None
+            except subprocess.CalledProcessError as e:
+                raise CommandError(
+                    cmd, e.returncode,
+                    getattr(e, "output", None) and str(e.output))
 
     def _rsync_rsh(self) -> str:
         return " ".join(["ssh"] + self.ssh_options.to_ssh_args())

@@ -18,7 +18,9 @@ from cloudtik_tpu.core.node_provider import (
 from cloudtik_tpu.core.tags import (
     NODE_KIND_WORKER, STATUS_UNINITIALIZED, TAG_CLUSTER_NAME,
     TAG_LAUNCH_CONFIG, TAG_NODE_KIND, TAG_NODE_STATUS, TAG_USER_NODE_TYPE)
+from cloudtik_tpu import telemetry
 from cloudtik_tpu.faults import seams
+from cloudtik_tpu.telemetry import instruments as ti
 
 logger = logging.getLogger(__name__)
 
@@ -107,22 +109,47 @@ class NodeLauncher(threading.Thread):
             TAG_LAUNCH_CONFIG: self.launch_hashes.get(node_type, ""),
         }
         group = nt.get("node_group") or {}
+        launched = 0
         try:
-            seams.fire("provider.create_node", provider=self.provider,
-                       node_type=node_type, count=count)
-            if group.get("atomic") and self.provider.supports_node_groups():
-                group_size = int(group.get("group_size", 1))
-                n_groups = max(count // group_size, 1)
-                for _ in range(n_groups):
-                    self.provider.create_node_group(
-                        node_config, dict(tags), group_size)
-            else:
-                self.provider.create_node_with_resources_and_labels(
-                    node_config, tags, count,
-                    nt.get("resources", {}), nt.get("labels", {}))
+            with telemetry.span("provider.create_node",
+                                node_type=node_type, count=count):
+                seams.fire("provider.create_node", provider=self.provider,
+                           node_type=node_type, count=count)
+                if group.get("atomic") and \
+                        self.provider.supports_node_groups():
+                    group_size = int(group.get("group_size", 1))
+                    n_groups = max(count // group_size, 1)
+                    # whole groups launch, so the real node count is
+                    # group_size per completed group, not the raw ask —
+                    # and a partial failure still counts the groups
+                    # that DID come up
+                    for _ in range(n_groups):
+                        self.provider.create_node_group(
+                            node_config, dict(tags), group_size)
+                        launched += group_size
+                else:
+                    self.provider.create_node_with_resources_and_labels(
+                        node_config, tags, count,
+                        nt.get("resources", {}), nt.get("labels", {}))
+                    launched = count
+            ti.NODE_LAUNCHES.inc(launched, node_type=node_type)
         except NodeLaunchException as e:
+            self._record_launch_failure(node_type, count, launched)
             logger.error("node launch failed (%s): %s", e.category,
                          e.description)
             if self.failure_callback:
                 self.failure_callback(node_type, count, e)
             raise
+        except Exception:
+            self._record_launch_failure(node_type, count, launched)
+            raise
+
+    @staticmethod
+    def _record_launch_failure(node_type: str, count: int,
+                               launched: int) -> None:
+        """launches + failures must reconcile against nodes that exist:
+        count what came up before the failure, fail only the rest."""
+        if launched:
+            ti.NODE_LAUNCHES.inc(launched, node_type=node_type)
+        ti.NODE_LAUNCH_FAILURES.inc(max(count - launched, 1),
+                                    node_type=node_type)

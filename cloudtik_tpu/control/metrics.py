@@ -119,6 +119,16 @@ class ClusterMetrics:
         with self._lock:
             return list(self.resource_demands) + list(self.resource_requests)
 
+    def heartbeat_ages(self, now: Optional[float] = None
+                       ) -> Dict[str, float]:
+        """Seconds since each node's last heartbeat, by node_id."""
+        now = now or time.time()
+        with self._lock:
+            return {
+                m.node_id: round(now - m.last_heartbeat_time, 3)
+                for m in self.nodes.values()
+                if m.last_heartbeat_time > 0}
+
     def summary(self) -> Dict[str, Any]:
         with self._lock:
             total: Dict[str, float] = {}
@@ -133,4 +143,6 @@ class ClusterMetrics:
                 "total_resources": total,
                 "available_resources": available,
                 "demands": self.get_resource_demands(),
+                "lost_nodes": dict(self.lost_nodes),
+                "heartbeat_age_s": self.heartbeat_ages(),
             }

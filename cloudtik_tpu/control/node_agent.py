@@ -20,6 +20,7 @@ from cloudtik_tpu.control.state import (
     StateClient, TABLE_HEARTBEAT, TABLE_METRICS, TABLE_PROCESSES)
 from cloudtik_tpu.faults import seams
 from cloudtik_tpu.faults.plan import DIRECTIVE_DROP
+from cloudtik_tpu.telemetry import instruments as ti
 from cloudtik_tpu.utils.constants import TIK_HEARTBEAT_PERIOD_S
 
 logger = logging.getLogger(__name__)
@@ -120,6 +121,7 @@ class NodeAgent:
             "node_ip": self.node_ip,
             "time": time.time(),
         })
+        ti.HEARTBEATS_PUBLISHED.inc()
 
     def publish_metrics_once(self) -> None:
         native = (self._native_sampler.latest()

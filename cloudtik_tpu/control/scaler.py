@@ -31,7 +31,9 @@ from cloudtik_tpu.core.tags import (
     NODE_KIND_HEAD, NODE_KIND_WORKER, STATUS_UP_TO_DATE, STATUS_UPDATE_FAILED,
     TAG_LAUNCH_CONFIG, TAG_NODE_GROUP_ID, TAG_NODE_KIND, TAG_NODE_STATUS,
     TAG_RUNTIME_CONFIG, TAG_USER_NODE_TYPE)
+from cloudtik_tpu import telemetry
 from cloudtik_tpu.faults import seams
+from cloudtik_tpu.telemetry import instruments as ti
 from cloudtik_tpu.utils.constants import (
     TIK_BOOT_GRACE_S, TIK_MAX_CONCURRENT_LAUNCHES,
     TIK_MAX_CONCURRENT_UPDATES)
@@ -143,22 +145,46 @@ class ClusterScaler:
     # ------------------------------------------------------------------
     def update(self) -> None:
         """One reconciliation pass."""
-        now = time.time()
-        nodes = NonTerminatedNodes(self.provider)
+        t0 = time.perf_counter()
+        result = "ok"
+        try:
+            with telemetry.span("scaler.reconcile"):
+                now = time.time()
+                nodes = NonTerminatedNodes(self.provider)
 
-        # liveness accounting from the snapshot
-        active_ips = [self.provider.internal_ip(n)
-                      for n in nodes.all_node_ids]
-        self.metrics.prune_active_ips([ip for ip in active_ips if ip])
+                # liveness accounting from the snapshot
+                active_ips = [self.provider.internal_ip(n)
+                              for n in nodes.all_node_ids]
+                self.metrics.prune_active_ips(
+                    [ip for ip in active_ips if ip])
 
-        self.process_completed_updates()
-        to_terminate = self.collect_terminations(nodes, now)
-        if to_terminate:
-            self.terminate_nodes(nodes, to_terminate)
-        self.recover_or_terminate_unhealthy(nodes, now)
-        if not self.disable_node_updaters:
-            self.update_out_of_date_nodes(nodes)
-        self.launch_required_nodes(nodes)
+                self.process_completed_updates()
+                to_terminate = self.collect_terminations(nodes, now)
+                if to_terminate:
+                    self.terminate_nodes(nodes, to_terminate)
+                self.recover_or_terminate_unhealthy(nodes, now)
+                if not self.disable_node_updaters:
+                    self.update_out_of_date_nodes(nodes)
+                self.launch_required_nodes(nodes)
+        except Exception:
+            result = "failed"
+            raise
+        finally:
+            # count failing passes too: a dead provider must show up as
+            # result="failed" rate, not as the reconcile rate going dark
+            ti.SCALER_RECONCILES.inc(result=result)
+            ti.SCALER_RECONCILE_SECONDS.observe(
+                time.perf_counter() - t0)
+
+    def _decide(self, action: str, reason: str, **attrs) -> None:
+        """Record a scale decision: a zero-length `scaler.decision` span
+        carrying WHY (demand, lost node, idle timeout, ...) plus the
+        termination counter when the action removes nodes."""
+        telemetry.add_span("scaler.decision", time.time(), 0.0,
+                           action=action, reason=reason, **attrs)
+        if action == "terminate":
+            ti.SCALER_TERMINATIONS.inc(
+                attrs.get("count", 1), reason=reason)
 
     # ------------------------------------------------------------------
     def collect_terminations(
@@ -178,11 +204,15 @@ class ClusterScaler:
             if nt is None:
                 logger.info("terminating %s: unknown node type %r",
                             node_id, node_type)
+                self._decide("terminate", "unknown_node_type",
+                             node_id=node_id, node_type=node_type)
                 to_terminate.add(node_id)
                 continue
             if tags.get(TAG_LAUNCH_CONFIG) not in (
                     None, "", self.launch_hashes.get(node_type)):
                 logger.info("terminating %s: outdated launch config", node_id)
+                self._decide("terminate", "outdated_launch_config",
+                             node_id=node_id, node_type=node_type)
                 to_terminate.add(node_id)
                 continue
             counts[node_type] = counts.get(node_type, 0) + 1
@@ -190,6 +220,8 @@ class ClusterScaler:
             if counts[node_type] > max_of_type:
                 logger.info("terminating %s: over max_workers of type %s",
                             node_id, node_type)
+                self._decide("terminate", "over_max_workers",
+                             node_id=node_id, node_type=node_type)
                 to_terminate.add(node_id)
                 continue
             # Idle termination above min_workers.  A node only becomes
@@ -204,10 +236,21 @@ class ClusterScaler:
                     and not self.metrics.is_active(ip, idle_timeout_s, now)):
                 logger.info("terminating %s: idle > %ds", node_id,
                             idle_timeout_s)
+                self._decide("terminate", "idle_timeout",
+                             node_id=node_id, node_type=node_type,
+                             idle_timeout_s=idle_timeout_s)
                 to_terminate.add(node_id)
 
-        return self.quorum.expand_to_group(list(to_terminate)) \
-            if to_terminate else to_terminate
+        if not to_terminate:
+            return to_terminate
+        expanded = self.quorum.expand_to_group(list(to_terminate))
+        # fate-shared members pulled in by atomic-group expansion die
+        # too: count them so terminations_total reconciles against the
+        # number of nodes that actually disappear
+        extra = len(expanded) - len(to_terminate)
+        if extra > 0:
+            self._decide("terminate", "group_expansion", count=extra)
+        return expanded
 
     def terminate_nodes(self, nodes: NonTerminatedNodes,
                         to_terminate: Set[str]) -> None:
@@ -215,16 +258,25 @@ class ClusterScaler:
         # down — expand first so the snapshot and updater map reflect every
         # node that actually dies, not just the ones the caller named.
         expanded = self.quorum.expand_to_group(sorted(to_terminate))
+        # callers that pass a pre-expanded set (collect_terminations)
+        # already accounted for fate-shared members; callers that name
+        # single nodes (update_failed) have not — count the delta here
+        # so terminations_total always matches nodes that die
+        extra = len(expanded) - len(set(to_terminate))
+        if extra > 0:
+            self._decide("terminate", "group_expansion", count=extra)
         groups = self.quorum.groups_of(sorted(expanded))
         seams.fire("provider.terminate_node", provider=self.provider,
                    node_ids=sorted(expanded))
         all_dead: Set[str] = set()
-        for group_id, members in groups.items():
-            if group_id and self.provider.supports_node_groups():
-                self.provider.terminate_node_group(group_id)
-            else:
-                self.provider.terminate_nodes(members)
-            all_dead.update(members)
+        with telemetry.span("provider.terminate_nodes",
+                            count=len(expanded)):
+            for group_id, members in groups.items():
+                if group_id and self.provider.supports_node_groups():
+                    self.provider.terminate_node_group(group_id)
+                else:
+                    self.provider.terminate_nodes(members)
+                all_dead.update(members)
         nodes.remove(all_dead)
         for node_id in all_dead:
             self.updaters.pop(node_id, None)
@@ -258,29 +310,47 @@ class ClusterScaler:
         expanded = self.quorum.expand_to_group(unhealthy)
         grouped = self.quorum.groups_of(sorted(expanded))
         for group_id, members in grouped.items():
+            # why this group/node is condemned: a runtime reported it
+            # LOST, or its heartbeats simply went dark
+            reason = ("lost_node" if any(m in lost for m in members)
+                      else "heartbeat_timeout")
             if group_id:
                 # An atomic group with a dead member cannot be repaired in
                 # place (the SPMD program spanning it is gone): recycle it.
                 logger.warning("recycling unhealthy node group %s (%d nodes)",
                                group_id, len(members))
+                self._decide("terminate", reason, group_id=group_id,
+                             count=len(members))
                 self.event_summarizer.add_once_per_interval(
                     "Recycling unhealthy node group %s (%d nodes)."
                     % (group_id, len(members)), key="recycle:" + group_id)
-                if self.provider.supports_node_groups():
-                    self.provider.terminate_node_group(group_id)
-                else:
-                    self.provider.terminate_nodes(members)
+                # same seam + span as terminate_nodes: the recycle path
+                # is the main termination the chaos drills exercise
+                seams.fire("provider.terminate_node",
+                           provider=self.provider,
+                           node_ids=sorted(members))
+                with telemetry.span("provider.terminate_nodes",
+                                    count=len(members)):
+                    if self.provider.supports_node_groups():
+                        self.provider.terminate_node_group(group_id)
+                    else:
+                        self.provider.terminate_nodes(members)
                 nodes.remove(set(members))
                 for node_id in members:
                     self._executor_cache.invalidate(node_id)
             else:
                 for node_id in members:
-                    self.recover_if_needed(node_id)
+                    self.recover_if_needed(node_id, reason)
 
-    def recover_if_needed(self, node_id: str) -> None:
+    def recover_if_needed(self, node_id: str,
+                          reason: str = "heartbeat_timeout") -> None:
         """Re-run start commands on a heartbeat-lost node."""
         if self.disable_node_updaters:
+            # no updaters to recover with: this is a TERMINATION and
+            # must be recorded as one (terminations_total reconciles
+            # against nodes that actually die)
             logger.warning("terminating unhealthy node %s", node_id)
+            self._decide("terminate", reason, node_id=node_id)
             self.provider.terminate_node(node_id)
             self._executor_cache.invalidate(node_id)
             return
@@ -288,6 +358,8 @@ class ClusterScaler:
             return
         logger.warning("recovering node %s: re-running start commands",
                        node_id)
+        self._decide("recover", reason, node_id=node_id)
+        ti.SCALER_RECOVERIES.inc()
         self.event_summarizer.add_once_per_interval(
             "Restarting %s services on %s." % (self.cluster_name, node_id),
             key="recover:" + node_id)
@@ -321,6 +393,8 @@ class ClusterScaler:
                     self.num_failed_updates.get(node_id, 0) >= 3:
                 logger.error("node %s failed %d updates; terminating",
                              node_id, self.num_failed_updates[node_id])
+                self._decide("terminate", "update_failed",
+                             node_id=node_id)
                 self.terminate_nodes(nodes, {node_id})
                 continue
             if status not in (None, "", STATUS_UP_TO_DATE,
@@ -398,6 +472,8 @@ class ClusterScaler:
             if count <= 0:
                 continue
             logger.info("launching %d x %s", count, node_type)
+            self._decide("launch", "demand", node_type=node_type,
+                         count=count)
             self.event_summarizer.add(
                 "Adding {} node(s) of type %s." % node_type,
                 quantity=count)

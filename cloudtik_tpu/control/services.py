@@ -96,6 +96,7 @@ class NodeServicesStarter:
         self.log_agent: Optional[LogAgent] = None
         self.state_client: Optional[StateClient] = None
         self.runtime_failures: Dict[str, str] = {}
+        self.telemetry_server = None
 
     # ------------------------------------------------------------------
     def start_head_processes(self) -> None:
@@ -153,7 +154,26 @@ class NodeServicesStarter:
             node_constraints=node_constraints,
             metrics_port=self.config.get("controller_metrics_port"))
         self.controller.start()
+        self._start_telemetry_server()
         self._start_common_agents()
+
+    def _start_telemetry_server(self) -> None:
+        """Expose this process's telemetry (/metrics, /trace) — the
+        endpoint `tik trace`/`tik metrics` and the prometheus runtime's
+        `telemetry` scrape target read.  Port 0 disables."""
+        from cloudtik_tpu import telemetry
+        from cloudtik_tpu.utils.constants import (
+            TIK_TELEMETRY_PORT_DEFAULT)
+        port = self.config.get("telemetry_port",
+                               TIK_TELEMETRY_PORT_DEFAULT)
+        if not telemetry.enabled() or not port:
+            return
+        try:
+            from cloudtik_tpu.telemetry import http as telemetry_http
+            self.telemetry_server = telemetry_http.start_server(port)
+        except OSError as e:    # port taken: degrade, don't block boot
+            logger.warning("telemetry server not started on %s: %s",
+                           port, e)
 
     def start_node_processes(self) -> None:
         self.state_client = StateClient(
@@ -238,6 +258,8 @@ class NodeServicesStarter:
         for svc in (self.node_agent, self.log_agent, self.controller):
             if svc:
                 svc.stop()
+        if self.telemetry_server:
+            self.telemetry_server.stop()
         if self.state_server:
             self.state_server.stop()
 

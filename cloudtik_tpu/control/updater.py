@@ -13,10 +13,11 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from typing import Any, Dict, List, Optional
 
+from cloudtik_tpu import telemetry
 from cloudtik_tpu.control.executor.base import CommandError, CommandExecutor
+from cloudtik_tpu.telemetry import instruments as ti
 from cloudtik_tpu.core.node_provider import NodeProvider
 from cloudtik_tpu.core.tags import (
     STATUS_SETTING_UP, STATUS_SYNCING_FILES, STATUS_UPDATE_FAILED,
@@ -94,14 +95,22 @@ class NodeUpdater:
     def run(self) -> None:
         try:
             self.do_update()
+            ti.NODE_UPDATES.inc(result="ok")
         except Exception as e:
             self.error = e
+            ti.NODE_UPDATES.inc(result="failed")
             try:
                 self._set_status(STATUS_UPDATE_FAILED)
             except Exception:
                 pass
             logger.exception("node %s update failed", self.node_id)
             raise
+
+    def _phase(self, name: str):
+        """Span + tik_updater_phase_seconds for one bootstrap phase."""
+        return telemetry.timed_span(
+            name, ti.UPDATER_PHASE_SECONDS,
+            {"phase": name.split(".", 1)[1]}, node_id=self.node_id)
 
     def wait_ready(self) -> None:
         self._set_status(STATUS_WAITING_FOR_SSH)
@@ -120,7 +129,8 @@ class NodeUpdater:
             self.executor.run("uptime", with_output=True, timeout=20)
 
         try:
-            call_with_retry(probe, policy)
+            with self._phase("updater.wait_ready"):
+                call_with_retry(probe, policy)
         except _NodeTerminated:
             raise RuntimeError(
                 f"node {self.node_id} terminated while waiting for boot")
@@ -131,8 +141,9 @@ class NodeUpdater:
 
     def sync_file_mounts(self) -> None:
         self._set_status(STATUS_SYNCING_FILES)
-        for remote, local in sorted(self.file_mounts.items()):
-            self.executor.run_rsync_up(local, remote)
+        with self._phase("updater.sync_files"):
+            for remote, local in sorted(self.file_mounts.items()):
+                self.executor.run_rsync_up(local, remote)
 
     def do_update(self) -> None:
         self.wait_ready()
@@ -147,18 +158,23 @@ class NodeUpdater:
 
         if not self.restart_only:
             self._set_status(STATUS_SETTING_UP)
-            for cmd in self.initialization_commands:
-                self.executor.run(
-                    cmd, environment_variables=self.environment_variables,
-                    run_env="host")
-            for cmd in self.setup_commands:
-                self.executor.run(
-                    cmd, environment_variables=self.environment_variables)
+            with self._phase("updater.setup"):
+                for cmd in self.initialization_commands:
+                    self.executor.run(
+                        cmd,
+                        environment_variables=self.environment_variables,
+                        run_env="host")
+                for cmd in self.setup_commands:
+                    self.executor.run(
+                        cmd,
+                        environment_variables=self.environment_variables)
 
         if not self.no_restart:
-            for cmd in self.start_commands:
-                self.executor.run(
-                    cmd, environment_variables=self.environment_variables)
+            with self._phase("updater.start_services"):
+                for cmd in self.start_commands:
+                    self.executor.run(
+                        cmd,
+                        environment_variables=self.environment_variables)
 
         tags = {
             TAG_NODE_STATUS: STATUS_UP_TO_DATE,
@@ -179,8 +195,10 @@ class NodeUpdaterThread(NodeUpdater, threading.Thread):
         try:
             self.do_update()
             self.exitcode = 0
+            ti.NODE_UPDATES.inc(result="ok")
         except Exception as e:
             self.error = e
+            ti.NODE_UPDATES.inc(result="failed")
             try:
                 self._set_status(STATUS_UPDATE_FAILED)
             except Exception:

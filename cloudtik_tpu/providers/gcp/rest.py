@@ -21,6 +21,8 @@ import urllib.error
 import urllib.request
 from typing import Any, Callable, Dict, Optional
 
+from cloudtik_tpu import telemetry
+from cloudtik_tpu.telemetry import instruments as ti
 from cloudtik_tpu.utils.retry import (
     RetriesExhausted, RetryPolicy, call_with_retry)
 
@@ -160,10 +162,29 @@ class RestClient:
                 and not ambiguous_transport)
             raise error
 
-        try:
-            return call_with_retry(once, self._policy, sleep=self._sleep)
-        except RetriesExhausted as e:
-            raise e.last from None
+        t0 = time.perf_counter()
+        code = "ok"
+        with telemetry.span("gcp.rest.request", method=method,
+                            url=url.split("?", 1)[0]):
+            try:
+                return call_with_retry(once, self._policy,
+                                       sleep=self._sleep)
+            except RetriesExhausted as e:
+                code = str(getattr(e.last, "status", "error"))
+                raise e.last from None
+            except GCPApiError as e:
+                code = str(e.status)
+                raise
+            except Exception:
+                # non-API failure (e.g. token acquisition): must not
+                # count as code="ok" or a credentials outage reads as a
+                # healthy request rate
+                code = "error"
+                raise
+            finally:
+                ti.GCP_REST_LATENCY.observe(
+                    time.perf_counter() - t0, method=method)
+                ti.GCP_REST_REQUESTS.inc(method=method, code=code)
 
     def get(self, url: str) -> Any:
         return self.request("GET", url)

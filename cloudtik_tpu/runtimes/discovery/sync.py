@@ -22,6 +22,8 @@ import os
 import time
 from typing import Any, Dict
 
+from cloudtik_tpu import telemetry
+from cloudtik_tpu.telemetry import instruments as ti
 from cloudtik_tpu.utils.constants import TIK_STATE_PORT_DEFAULT, tik_home
 
 
@@ -66,10 +68,13 @@ def run_loop(registry, home: str, interval: float,
     iterations = 0
     while True:
         try:
-            render_once(registry, home)
+            with telemetry.span("discovery.render"):
+                render_once(registry, home)
             failures = 0
+            ti.DISCOVERY_SYNCS.inc(result="ok")
         except Exception as e:  # head store down/restarting: back off
             failures += 1
+            ti.DISCOVERY_SYNCS.inc(result="failed")
             print(f"discovery-sync: render failed ({failures}x): {e}",
                   flush=True)
         iterations += 1

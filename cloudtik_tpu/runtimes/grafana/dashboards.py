@@ -1,10 +1,12 @@
 """Built-in Grafana dashboards (provisioned JSON).
 
 Reference parity: runtime/grafana conf/dashboards — the reference ships
-provisioned dashboards for its metrics stack.  One cluster-overview
-dashboard over the metrics this framework actually emits: nodex
-exporter gauges (per-node cpu/memory/disk), controller reconcile
-gauges, and the prometheus collector's per-instance series.
+provisioned dashboards for its metrics stack.  Two dashboards over the
+metrics this framework actually emits (the catalog in
+telemetry/names.py — tools/check_telemetry_names.py verifies every
+expression below resolves against it): a cluster overview (nodex
+gauges + controller/scaler series) and an AI-workload view (serve
+TTFT/TPOT/throughput + trainer step time/MFU).
 """
 
 from __future__ import annotations
@@ -47,10 +49,68 @@ def cluster_overview_dashboard() -> Dict[str, Any]:
         _panel(6, "Pending launches / active updaters",
                "tik_pending_launches or tik_active_updaters",
                "short", 12, 16),
+        _panel(7, "Scaler reconcile latency (p95)",
+               "histogram_quantile(0.95, "
+               "rate(tik_scaler_reconcile_seconds_bucket[5m]))",
+               "s", 0, 24),
+        _panel(8, "Scale decisions",
+               "rate(tik_scaler_terminations_total[5m]) "
+               "or rate(tik_node_launches_total[5m])",
+               "ops", 12, 24),
+        _panel(9, "Heartbeats published",
+               "rate(tik_heartbeats_published_total[5m])",
+               "ops", 0, 32),
+        _panel(10, "Executor command latency (p95)",
+               "histogram_quantile(0.95, "
+               "rate(tik_executor_run_seconds_bucket[5m]))",
+               "s", 12, 32),
     ]
     return {
         "uid": "tik-cluster-overview",
         "title": "Tik Cluster Overview",
+        "schemaVersion": 39,
+        "refresh": "10s",
+        "time": {"from": "now-1h", "to": "now"},
+        "panels": panels,
+        "templating": {"list": []},
+    }
+
+
+def ai_workload_dashboard() -> Dict[str, Any]:
+    """Serve latency + trainer throughput over the telemetry registry."""
+    panels: List[Dict[str, Any]] = [
+        _panel(1, "Time to first token (p95)",
+               "histogram_quantile(0.95, "
+               "rate(tik_serve_ttft_seconds_bucket[5m]))", "s", 0, 0),
+        _panel(2, "Time per output token (p95)",
+               "histogram_quantile(0.95, "
+               "rate(tik_serve_tpot_seconds_bucket[5m]))", "s", 12, 0),
+        _panel(3, "Queue wait (p95)",
+               "histogram_quantile(0.95, "
+               "rate(tik_serve_queue_wait_seconds_bucket[5m]))",
+               "s", 0, 8),
+        _panel(4, "Request outcomes",
+               "rate(tik_serve_requests_total[5m])", "ops", 12, 8),
+        _panel(5, "Tokens generated / active slots",
+               "rate(tik_serve_tokens_generated_total[5m]) "
+               "or tik_serve_active_slots", "short", 0, 16),
+        _panel(6, "Train step time (p95)",
+               "histogram_quantile(0.95, "
+               "rate(tik_train_step_seconds_bucket[5m]))", "s", 12, 16),
+        _panel(7, "Train throughput",
+               "tik_train_tokens_per_sec", "short", 0, 24),
+        _panel(8, "Train MFU",
+               "tik_train_mfu", "percentunit", 12, 24),
+        _panel(9, "Checkpoint save latency (p95)",
+               "histogram_quantile(0.95, "
+               "rate(tik_checkpoint_save_seconds_bucket[5m]))",
+               "s", 0, 32),
+        _panel(10, "Serve queue depth",
+               "tik_serve_queue_depth", "short", 12, 32),
+    ]
+    return {
+        "uid": "tik-ai-workloads",
+        "title": "Tik AI Workloads",
         "schemaVersion": 39,
         "refresh": "10s",
         "time": {"from": "now-1h", "to": "now"},
@@ -80,7 +140,12 @@ def write_dashboards(provisioning_dir: str) -> List[str]:
     provider = os.path.join(dash_dir, "tik.yaml")
     with open(provider, "w") as f:
         f.write(render_dashboard_provider(dash_dir))
-    dashboard = os.path.join(dash_dir, "cluster-overview.json")
-    with open(dashboard, "w") as f:
-        json.dump(cluster_overview_dashboard(), f, indent=1)
-    return [provider, dashboard]
+    created = [provider]
+    for filename, dashboard in (
+            ("cluster-overview.json", cluster_overview_dashboard()),
+            ("ai-workloads.json", ai_workload_dashboard())):
+        path = os.path.join(dash_dir, filename)
+        with open(path, "w") as f:
+            json.dump(dashboard, f, indent=1)
+        created.append(path)
+    return created

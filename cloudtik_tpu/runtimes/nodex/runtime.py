@@ -2,8 +2,10 @@
 
 Reference parity: runtime/nodex/runtime.py:13 (prometheus node-exporter on
 every node).  This build ships its own tiny Python exporter
-(nodex/exporter.py, psutil → prometheus_client) spawned as a real service
-process by the delivery layer, so no external binary is required.
+(nodex/exporter.py, psutil → telemetry registry → telemetry HTTP server)
+spawned as a real service process by the delivery layer, so no external
+binary is required; the same port also exposes the process's full
+telemetry registry and span ring (docs/observability.md).
 """
 
 from __future__ import annotations
@@ -29,4 +31,6 @@ class NodexRuntime(ServiceRuntimeBase):
         self, node_context: Dict[str, Any]
     ) -> Optional[List[str]]:
         return [sys.executable, "-m", "cloudtik_tpu.runtimes.nodex.exporter",
-                "--port", str(self.port)]
+                "--port", str(self.port),
+                "--interval",
+                str(self.runtime_config.get("interval_s", 10.0))]

@@ -46,6 +46,27 @@ class PrometheusRuntime(ServiceRuntimeBase):
         config = node_context.get("config", {})
         head_ip = node_context.get("head_ip", "127.0.0.1")
         services = _declared_http_services(config, head_ip)
+        # head services serve the in-process telemetry registry
+        # (spans + metrics, docs/observability.md) on its own port —
+        # scrape it alongside the declared runtime services
+        from cloudtik_tpu import telemetry
+        from cloudtik_tpu.utils.constants import (
+            TIK_TELEMETRY_PORT_DEFAULT)
+        # same resolution head services use to BIND the port
+        # (cluster-level telemetry_port), overridable per runtime config
+        telemetry_port = self.runtime_config.get(
+            "telemetry_port",
+            config.get("telemetry_port", TIK_TELEMETRY_PORT_DEFAULT))
+        if self.runtime_config.get("scrape_telemetry", True) \
+                and telemetry_port and telemetry.enabled():
+            # only when the head will actually bind the endpoint —
+            # TIK_TELEMETRY=off / port 0 must not render a dead target
+            services.setdefault("telemetry", {
+                "port": telemetry_port,
+                "protocol": "http",
+                "cluster": config.get("cluster_name", ""),
+                "nodes": [{"node_id": "head", "ip": head_ip}],
+            })
         if services or not os.path.exists(targets_file):
             write_targets_file(conf_dir, services)
         from cloudtik_tpu.runtimes.prometheus.alerts import write_rules

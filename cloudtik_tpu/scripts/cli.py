@@ -362,12 +362,27 @@ def head_process_status():
 
 @head.command(name="resource-metrics")
 def head_resource_metrics():
-    """Per-node resource metrics published by the node agents."""
+    """Per-node resource metrics published by the node agents, plus
+    heartbeat freshness and runtime-reported lost nodes."""
+    import time as _time
+
     from cloudtik_tpu.control.state import TABLE_HEARTBEAT, TABLE_METRICS
     _config, state = _head_state()
+    heartbeats = state.table_list(TABLE_HEARTBEAT)
+    now = _time.time()
+    heartbeat_age_s = {
+        node_id: round(now - hb["time"], 3)
+        for node_id, hb in heartbeats.items() if hb.get("time")}
+    # the controller's last reconcile summary carries the merged
+    # lost-node view (scaling policies + runtime-published states)
+    controller = state.table_list("controller").get("status", {})
+    lost_nodes = (controller.get("summary", {}).get("metrics", {})
+                  .get("lost_nodes", {}))
     click.echo(json.dumps({
         "metrics": state.table_list(TABLE_METRICS),
-        "heartbeats": state.table_list(TABLE_HEARTBEAT),
+        "heartbeats": heartbeats,
+        "heartbeat_age_s": heartbeat_age_s,
+        "lost_nodes": lost_nodes,
     }, indent=2, default=str))
 
 
@@ -743,6 +758,127 @@ def node_dump(output):
     from cloudtik_tpu.control.cluster_dump import create_archive
     path = create_archive(output_path=output, cluster_name="node")
     cli_logger.success("Node debug archive written to {}.", path)
+
+
+# -------------------------------------------------------------- telemetry --
+
+def _telemetry_url(url, config_file, path):
+    """Resolve the telemetry endpoint: explicit --url wins; --config
+    resolves the cluster's head ip through the provider (the same
+    machinery `tik tunnel`/`attach` use); default is this host."""
+    from cloudtik_tpu.utils.constants import TIK_TELEMETRY_PORT_DEFAULT
+    if url is None and config_file:
+        from cloudtik_tpu.control import cluster_operator
+        from cloudtik_tpu.providers.factory import create_node_provider
+        config = cluster_operator.bootstrap_config(_load(config_file))
+        provider = create_node_provider(
+            config["provider"], config["cluster_name"])
+        head_id, _ = cluster_operator.head_executor(config, provider)
+        head_ip = provider.external_ip(head_id) \
+            or provider.internal_ip(head_id)
+        port = config.get("telemetry_port", TIK_TELEMETRY_PORT_DEFAULT)
+        url = f"http://{head_ip}:{port}"
+    if url is None:
+        url = f"http://127.0.0.1:{TIK_TELEMETRY_PORT_DEFAULT}"
+    return url.rstrip("/") + path
+
+
+def _telemetry_fetch(url, config_file, path):
+    import urllib.error
+    import urllib.request
+    full = _telemetry_url(url, config_file, path)
+    try:
+        with urllib.request.urlopen(full, timeout=10) as resp:
+            return resp.read().decode(errors="replace")
+    except (urllib.error.URLError, OSError) as e:
+        raise click.ClickException(
+            f"cannot fetch {full}: {e} (is a telemetry endpoint up? "
+            "head services and the nodex exporter serve one; see "
+            "docs/observability.md)")
+
+
+_telemetry_url_opt = click.option(
+    "--url", default=None,
+    help="Telemetry endpoint (default http://127.0.0.1:<telemetry "
+         "port>, or the cluster head's with --config).")
+_telemetry_config_opt = click.option(
+    "--config", "config_file", default=None,
+    type=click.Path(exists=True),
+    help="Cluster config; fetches from the head's telemetry port.")
+
+
+@cli.group()
+def trace():
+    """Tracing spans: export/summarize the span ring of a tik process
+    (docs/observability.md).  Every long-lived process keeps a bounded
+    ring of finished spans; `export` emits chrome://tracing JSON."""
+
+
+@trace.command(name="export")
+@_telemetry_url_opt
+@_telemetry_config_opt
+@click.option("--output", "-o", default=None,
+              help="Write Chrome-trace JSON here (default: stdout).")
+def trace_export(url, config_file, output):
+    """Export the span ring as Chrome-trace JSON."""
+    body = _telemetry_fetch(url, config_file, "/trace")
+    try:
+        trace_json = json.loads(body)
+    except ValueError:
+        raise click.ClickException("endpoint returned non-JSON trace")
+    if output:
+        with open(output, "w") as f:
+            json.dump(trace_json, f, indent=1)
+        cli_logger.success(
+            "Wrote {} events to {}.",
+            len(trace_json.get("traceEvents", [])), output)
+    else:
+        click.echo(json.dumps(trace_json, indent=1))
+
+
+@trace.command(name="summary")
+@_telemetry_url_opt
+@_telemetry_config_opt
+def trace_summary_cmd(url, config_file):
+    """Per-span-name count/mean/max over the span ring."""
+    body = _telemetry_fetch(url, config_file, "/trace/summary")
+    try:
+        summary = json.loads(body)
+    except ValueError:
+        raise click.ClickException(
+            "endpoint returned non-JSON trace summary")
+    if not summary:
+        cli_logger.info("No spans recorded.")
+        return
+    width = max(len(name) for name in summary)
+    click.echo(f"{'span':<{width}}  {'count':>7}  {'mean':>10}  "
+               f"{'max':>10}  {'total':>10}")
+    for name, entry in summary.items():
+        click.echo(
+            f"{name:<{width}}  {entry['count']:>7}  "
+            f"{entry['mean_s'] * 1e3:>8.2f}ms  "
+            f"{entry['max_s'] * 1e3:>8.2f}ms  "
+            f"{entry['total_s'] * 1e3:>8.2f}ms")
+
+
+@cli.group()
+def metrics():
+    """Telemetry metrics registry surfaces (docs/observability.md)."""
+
+
+@metrics.command(name="dump")
+@_telemetry_url_opt
+@_telemetry_config_opt
+@click.option("--json", "as_json", is_flag=True,
+              help="Parse the exposition into JSON samples.")
+def metrics_dump(url, config_file, as_json):
+    """Dump the Prometheus exposition of a tik process."""
+    body = _telemetry_fetch(url, config_file, "/metrics")
+    if as_json:
+        from cloudtik_tpu.telemetry import parse_prometheus
+        click.echo(json.dumps(parse_prometheus(body), indent=1))
+    else:
+        click.echo(body, nl=False)
 
 
 # ------------------------------------------------------------------ chaos --

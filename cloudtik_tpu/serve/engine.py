@@ -32,16 +32,20 @@ Design:
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import logging
 import queue
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from cloudtik_tpu import telemetry
 from cloudtik_tpu.faults import seams
+from cloudtik_tpu.telemetry import instruments as ti
 from cloudtik_tpu.models.generate import (
     _NEG, _rms_norm, forward_step, init_cache)
 from cloudtik_tpu.models.transformer import (
@@ -67,8 +71,22 @@ class _Slot:
     remaining: int                    # new tokens still wanted
 
 
+class RequestCancelled(RuntimeError):
+    """The request was cancelled; its slot has been freed."""
+
+
+_request_ids = itertools.count(1)
+
+
 class Request:
-    """One generation request; wait() blocks until tokens are ready."""
+    """One generation request; wait() blocks until tokens are ready.
+
+    Lifecycle timestamps (epoch seconds) are stamped on every request:
+    `created` at construction, `admitted` when a slot is taken,
+    `first_token_time` when prefill produces the first token, and
+    `done_time` at completion — TTFT is first_token_time - created,
+    and queue wait is admitted - created.
+    """
 
     def __init__(self, prompt: List[int], max_new_tokens: int = 32,
                  temperature: float = 0.0,
@@ -79,7 +97,18 @@ class Request:
         self.eos_id = eos_id
         self.tokens: List[int] = []
         self.error: Optional[Exception] = None
+        self.request_id = next(_request_ids)
+        self.created: float = time.time()
+        self.admitted: Optional[float] = None
+        self.first_token_time: Optional[float] = None
+        self.done_time: Optional[float] = None
         self._done = threading.Event()
+        self._cancel = False
+        # serializes completion: cancel() (caller thread) can race the
+        # loop thread finishing the same request in the pop->admit
+        # window; exactly one completion may run
+        self._finish_lock = threading.Lock()
+        self._engine: Optional["DecodeEngine"] = None
 
     def wait(self, timeout: Optional[float] = None) -> List[int]:
         if not self._done.wait(timeout):
@@ -87,6 +116,35 @@ class Request:
         if self.error is not None:
             raise self.error
         return self.tokens
+
+    def cancel(self) -> bool:
+        """Cancel this request; wait() then raises RequestCancelled.
+        A request occupying a decode slot has the slot freed BY THE
+        LOOP THREAD (which owns slot state) on its next pass.  A
+        merely-queued request finishes immediately — it holds no slot
+        state, and the loop discards the dead queue entry on pop
+        (completion is idempotent) — so cancel is not stuck behind a
+        fully-busy engine.  Returns False when already completed."""
+        if self._done.is_set():
+            return False
+        self._cancel = True
+        engine = self._engine
+        if engine is not None and self.admitted is not None:
+            engine._wake.set()
+        elif engine is not None:
+            engine._finish_request(
+                self, "cancelled", RequestCancelled("request cancelled"))
+            engine._wake.set()
+        else:
+            # never submitted: nothing owns it, finish it here (still
+            # counted — requests_total must sum to completed requests)
+            with self._finish_lock:
+                if not self._done.is_set():
+                    self.error = RequestCancelled("request cancelled")
+                    self.done_time = time.time()
+                    ti.SERVE_REQUESTS.inc(result="cancelled")
+                    self._done.set()
+        return True
 
 
 def _decode_layer(cfg: TransformerConfig, x: jax.Array, layer: Params,
@@ -245,17 +303,21 @@ class DecodeEngine:
     # -- public ----------------------------------------------------------
     def submit(self, request: Request) -> Request:
         if not request.prompt:
-            request.error = ValueError("empty prompt")
-            request._done.set()
+            self._finish_request(
+                request, "rejected", ValueError("empty prompt"))
             return request
         if len(request.prompt) + request.max_new_tokens > self.ec.max_len:
-            request.error = ValueError(
+            self._finish_request(request, "rejected", ValueError(
                 f"prompt+max_new ({len(request.prompt)} + "
                 f"{request.max_new_tokens}) exceeds max_len "
-                f"{self.ec.max_len}")
-            request._done.set()
+                f"{self.ec.max_len}"))
             return request
-        self._queue.put(request)
+        request._engine = self
+        with telemetry.span("serve.enqueue",
+                            request=request.request_id,
+                            prompt_len=len(request.prompt)):
+            self._queue.put(request)
+        ti.SERVE_QUEUE_DEPTH.set(self._queue.qsize())
         self._wake.set()
         return request
 
@@ -292,14 +354,42 @@ class DecodeEngine:
         # to fail requests queued on a never-started engine
         self._teardown()
 
+    def _finish_request(self, req: Request, result: str,
+                        error: Optional[Exception] = None) -> None:
+        """Single completion point: stamp done_time, emit lifecycle
+        metrics + the per-request decode-window span, wake the waiter.
+        Atomic per request — safe from both the loop thread and a
+        caller thread cancelling."""
+        with req._finish_lock:
+            if req._done.is_set():
+                return
+            self._finish_request_locked(req, result, error)
+
+    def _finish_request_locked(self, req: Request, result: str,
+                               error: Optional[Exception]) -> None:
+        req.done_time = time.time()
+        if error is not None:
+            req.error = error
+        first = req.first_token_time
+        if first is not None:
+            if len(req.tokens) > 1:
+                ti.SERVE_TPOT.observe(
+                    (req.done_time - first) / (len(req.tokens) - 1))
+            telemetry.add_span(
+                "serve.decode", first, req.done_time - first,
+                request=req.request_id, tokens=len(req.tokens),
+                result=result)
+        ti.SERVE_REQUESTS.inc(result=result)
+        req._done.set()
+
     def _drain_queue(self, reason: str) -> None:
         while True:
             try:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
-            req.error = RuntimeError(reason)
-            req._done.set()
+            self._finish_request(req, "error", RuntimeError(reason))
+        ti.SERVE_QUEUE_DEPTH.set(0)
 
     def _teardown(self, reason: str = "engine stopped") -> None:
         """Fail everything still queued or mid-decode — callers must not
@@ -307,8 +397,8 @@ class DecodeEngine:
         self._drain_queue(reason)
         for slot_id, slot in enumerate(self._slots):
             if slot is not None:
-                slot.request.error = RuntimeError(reason)
-                slot.request._done.set()
+                self._finish_request(slot.request, "error",
+                                     RuntimeError(reason))
                 self._slots[slot_id] = None
 
     # -- engine loop ------------------------------------------------------
@@ -322,48 +412,78 @@ class DecodeEngine:
         for slot_id in range(self.ec.slots):
             if self._slots[slot_id] is not None:
                 continue
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    ti.SERVE_QUEUE_DEPTH.set(0)
+                    return
+                ti.SERVE_QUEUE_DEPTH.set(self._queue.qsize())
+                if req._cancel:   # cancelled while queued: no slot taken
+                    self._finish_request(
+                        req, "cancelled",
+                        RequestCancelled("request cancelled"))
+                    continue
+                break
             try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                return
-            try:
+                req.admitted = time.time()
+                ti.SERVE_QUEUE_WAIT.observe(req.admitted - req.created)
                 true_len = len(req.prompt)
-                padded = np.zeros((1, self._bucket(true_len)), np.int32)
-                padded[0, :true_len] = req.prompt
-                pk, pv, first = self._prefill(
-                    self.params, jnp.asarray(padded),
-                    jnp.asarray(true_len, jnp.int32))
-                self._ks, self._vs = self._insert(
-                    self._ks, self._vs, pk, pv, slot_id)
-                first_tok = int(first)
+                with telemetry.span("serve.prefill",
+                                    request=req.request_id,
+                                    prompt_len=true_len, slot=slot_id):
+                    padded = np.zeros((1, self._bucket(true_len)),
+                                      np.int32)
+                    padded[0, :true_len] = req.prompt
+                    pk, pv, first = self._prefill(
+                        self.params, jnp.asarray(padded),
+                        jnp.asarray(true_len, jnp.int32))
+                    self._ks, self._vs = self._insert(
+                        self._ks, self._vs, pk, pv, slot_id)
+                    first_tok = int(first)
                 req.tokens.append(first_tok)
+                req.first_token_time = time.time()
+                ti.SERVE_TTFT.observe(req.first_token_time - req.created)
+                ti.SERVE_TOKENS.inc()
                 self._tokens = self._tokens.at[slot_id].set(first_tok)
                 self._lengths = self._lengths.at[slot_id].set(true_len)
                 slot = _Slot(req, true_len, req.max_new_tokens - 1)
                 if (req.eos_id is not None and first_tok == req.eos_id) \
                         or slot.remaining <= 0:
-                    req._done.set()
+                    self._finish_request(req, "ok")
                     continue
                 self._slots[slot_id] = slot
             except Exception as e:   # surface per-request failures
-                req.error = e
-                req._done.set()
+                self._finish_request(req, "error", e)
+
+    def _reap_cancelled(self) -> None:
+        """Free slots whose request was cancelled — runs on the loop
+        thread, which owns slot state."""
+        for slot_id, slot in enumerate(self._slots):
+            if slot is not None and slot.request._cancel:
+                self._finish_request(
+                    slot.request, "cancelled",
+                    RequestCancelled("request cancelled"))
+                self._slots[slot_id] = None
 
     def _step(self) -> None:
-        seams.fire("serve.decode_step",
-                   active=sum(s is not None for s in self._slots))
-        active_mask = np.array(
-            [s is not None for s in self._slots], np.bool_)
-        temps = np.array(
-            [s.request.temperature if s else 0.0 for s in self._slots],
-            np.float32)
-        self._rng, step_rng = jax.random.split(self._rng)
-        nxt, self._ks, self._vs, self._lengths = self._decode(
-            self.params, self._tokens, self._ks, self._vs,
-            self._lengths, jnp.asarray(active_mask),
-            jnp.asarray(temps), step_rng)
-        self._tokens = nxt
-        host_tokens = np.asarray(nxt)
+        n_active = sum(s is not None for s in self._slots)
+        seams.fire("serve.decode_step", active=n_active)
+        ti.SERVE_ACTIVE_SLOTS.set(n_active)
+        with telemetry.span("serve.decode_step", active=n_active):
+            active_mask = np.array(
+                [s is not None for s in self._slots], np.bool_)
+            temps = np.array(
+                [s.request.temperature if s else 0.0
+                 for s in self._slots], np.float32)
+            self._rng, step_rng = jax.random.split(self._rng)
+            nxt, self._ks, self._vs, self._lengths = self._decode(
+                self.params, self._tokens, self._ks, self._vs,
+                self._lengths, jnp.asarray(active_mask),
+                jnp.asarray(temps), step_rng)
+            self._tokens = nxt
+            host_tokens = np.asarray(nxt)
+        ti.SERVE_TOKENS.inc(n_active)
         for slot_id, slot in enumerate(self._slots):
             if slot is None:
                 continue
@@ -376,13 +496,14 @@ class DecodeEngine:
                  and tok == slot.request.eos_id) or \
                 slot.length + 1 >= self.ec.max_len
             if done:
-                slot.request._done.set()
+                self._finish_request(slot.request, "ok")
                 self._slots[slot_id] = None
 
     def _loop(self) -> None:
         try:
             while not self._stop.is_set():
                 try:
+                    self._reap_cancelled()
                     self._admit()
                     if any(s is not None for s in self._slots):
                         self._step()
@@ -394,9 +515,9 @@ class DecodeEngine:
                     # fail everything in flight rather than hang callers
                     for slot_id, slot in enumerate(self._slots):
                         if slot is not None:
-                            slot.request.error = RuntimeError(
-                                "engine loop failed; see logs")
-                            slot.request._done.set()
+                            self._finish_request(
+                                slot.request, "error", RuntimeError(
+                                    "engine loop failed; see logs"))
                             self._slots[slot_id] = None
         finally:
             # slot/queue teardown happens HERE, on the thread that owns

@@ -1,0 +1,36 @@
+"""tik telemetry: always-on, low-overhead tracing spans + metrics.
+
+Dependency-free and thread-safe.  Instrumented paths pay ONE attribute
+check when disabled (`TIK_TELEMETRY=off`) — same discipline as the fault
+seams (faults/seams.py).  docs/observability.md is the operator guide;
+telemetry/names.py is the authoritative name catalog.
+
+Emit sites::
+
+    from cloudtik_tpu import telemetry
+    from cloudtik_tpu.telemetry import instruments as ti
+
+    with telemetry.span("scaler.reconcile", tick=n):
+        ...
+    ti.SERVE_TTFT.observe(dt)
+
+Export::
+
+    telemetry.render_prometheus()   # Prometheus text
+    telemetry.chrome_trace()        # chrome://tracing JSON
+    telemetry.http.start_server(p)  # /metrics /trace /trace/summary
+"""
+
+from cloudtik_tpu.telemetry.core import (  # noqa: F401
+    NOOP_SPAN, REGISTRY, SPAN_RING, add_span, configure_from_env,
+    disable, enable, enabled, reset, span, spans, timed_span)
+from cloudtik_tpu.telemetry.export import (  # noqa: F401
+    chrome_trace, parse_prometheus, render_prometheus, trace_summary)
+from cloudtik_tpu.telemetry.names import METRICS, SPANS  # noqa: F401
+
+__all__ = [
+    "NOOP_SPAN", "REGISTRY", "SPAN_RING", "METRICS", "SPANS",
+    "add_span", "chrome_trace", "configure_from_env", "disable",
+    "enable", "enabled", "parse_prometheus", "render_prometheus",
+    "reset", "span", "spans", "timed_span", "trace_summary",
+]

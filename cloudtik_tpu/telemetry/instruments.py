@@ -1,0 +1,74 @@
+"""The registry instruments — every in-process metric, created once.
+
+Instrument construction is driven by the catalog (telemetry/names.py):
+each registry-sourced MetricSpec becomes exactly one module attribute
+here, so emit sites import a concrete object (`ti.SERVE_TTFT.observe(x)`)
+and the name checker can diff `REGISTRY` against the catalog.
+"""
+
+from __future__ import annotations
+
+from cloudtik_tpu.telemetry.core import (
+    Counter, Gauge, Histogram, Instrument, REGISTRY)
+from cloudtik_tpu.telemetry.names import METRICS
+
+
+def _build(name: str) -> Instrument:
+    spec = METRICS[name]
+    if spec.source != "registry":
+        raise ValueError(f"{name} is an external metric, not an "
+                         "in-process instrument")
+    if spec.kind == "counter":
+        return REGISTRY.counter(spec.name, spec.help, spec.labels)
+    if spec.kind == "gauge":
+        return REGISTRY.gauge(spec.name, spec.help, spec.labels)
+    if spec.kind == "histogram":
+        return REGISTRY.histogram(spec.name, spec.help, spec.labels,
+                                  spec.buckets)
+    raise ValueError(f"{name}: unknown kind {spec.kind!r}")
+
+
+# providers / control plane
+GCP_REST_REQUESTS: Counter = _build("tik_gcp_rest_requests_total")
+GCP_REST_LATENCY: Histogram = _build("tik_gcp_rest_latency_seconds")
+NODE_LAUNCHES: Counter = _build("tik_node_launches_total")
+NODE_LAUNCH_FAILURES: Counter = _build("tik_node_launch_failures_total")
+SCALER_RECONCILES: Counter = _build("tik_scaler_reconcile_total")
+SCALER_RECONCILE_SECONDS: Histogram = _build("tik_scaler_reconcile_seconds")
+SCALER_TERMINATIONS: Counter = _build("tik_scaler_terminations_total")
+SCALER_RECOVERIES: Counter = _build("tik_scaler_recoveries_total")
+NODE_UPDATES: Counter = _build("tik_node_updates_total")
+UPDATER_PHASE_SECONDS: Histogram = _build("tik_updater_phase_seconds")
+EXECUTOR_RUNS: Counter = _build("tik_executor_runs_total")
+EXECUTOR_RUN_SECONDS: Histogram = _build("tik_executor_run_seconds")
+HEARTBEATS_PUBLISHED: Counter = _build("tik_heartbeats_published_total")
+DISCOVERY_SYNCS: Counter = _build("tik_discovery_sync_total")
+
+# train
+CHECKPOINT_SAVES: Counter = _build("tik_checkpoint_saves_total")
+CHECKPOINT_SAVE_SECONDS: Histogram = _build("tik_checkpoint_save_seconds")
+CHECKPOINT_RESTORE_SECONDS: Histogram = _build(
+    "tik_checkpoint_restore_seconds")
+TRAIN_STEPS: Counter = _build("tik_train_steps_total")
+TRAIN_STEP_SECONDS: Histogram = _build("tik_train_step_seconds")
+TRAIN_TOKENS_PER_SEC: Gauge = _build("tik_train_tokens_per_sec")
+TRAIN_MFU: Gauge = _build("tik_train_mfu")
+
+# serve
+SERVE_REQUESTS: Counter = _build("tik_serve_requests_total")
+SERVE_QUEUE_WAIT: Histogram = _build("tik_serve_queue_wait_seconds")
+SERVE_TTFT: Histogram = _build("tik_serve_ttft_seconds")
+SERVE_TPOT: Histogram = _build("tik_serve_tpot_seconds")
+SERVE_TOKENS: Counter = _build("tik_serve_tokens_generated_total")
+SERVE_ACTIVE_SLOTS: Gauge = _build("tik_serve_active_slots")
+SERVE_QUEUE_DEPTH: Gauge = _build("tik_serve_queue_depth")
+
+# telemetry self-accounting
+SPANS_DROPPED: Counter = _build("tik_spans_dropped_total")
+
+# nodex exporter gauges (set only by the exporter process)
+NODE_CPU_PERCENT: Gauge = _build("tik_node_cpu_percent")
+NODE_MEMORY_PERCENT: Gauge = _build("tik_node_memory_percent")
+NODE_DISK_PERCENT: Gauge = _build("tik_node_disk_percent")
+NODE_NET_SENT: Gauge = _build("tik_node_net_sent_bytes")
+NODE_NET_RECV: Gauge = _build("tik_node_net_recv_bytes")

@@ -1,0 +1,184 @@
+"""The authoritative telemetry name catalog.
+
+Every metric and span name the tree emits is declared HERE, exactly once.
+`tools/check_telemetry_names.py` (run standalone or as the tier-1 test
+tests/test_telemetry_names.py) enforces that:
+
+  * every metric name matches ``tik_[a-z0-9_]+`` and is declared once,
+  * every instrument the registry creates is declared in this catalog,
+  * every ``telemetry.span("...")`` literal in the source is declared,
+  * every declared span name is actually fired somewhere,
+  * docs/observability.md and the grafana dashboards reference only
+    names that resolve against this catalog.
+
+Keep docs/observability.md's metric catalog table in sync when editing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+# Default fixed bucket ladders (seconds).  Exposition emits cumulative
+# `le` buckets plus +Inf, prometheus-style.
+LATENCY_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+FAST_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 1.0)
+SLOW_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                120.0, 300.0, 600.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSpec:
+    name: str
+    kind: str                      # counter | gauge | histogram
+    help: str
+    layer: str                     # which layer emits it
+    labels: Tuple[str, ...] = ()
+    buckets: Tuple[float, ...] = ()
+    # registry: created by telemetry/instruments.py in-process.
+    # external: emitted by a standalone surface (controller's
+    # prometheus_client gauges, the collector's own series) — cataloged
+    # so docs/dashboards referencing them resolve.
+    source: str = "registry"
+
+
+def _m(name: str, kind: str, help: str, layer: str,
+       labels: Tuple[str, ...] = (),
+       buckets: Tuple[float, ...] = (),
+       source: str = "registry") -> MetricSpec:
+    return MetricSpec(name, kind, help, layer, labels, buckets, source)
+
+
+_ALL = [
+    # -- providers / control plane ---------------------------------------
+    _m("tik_gcp_rest_requests_total", "counter",
+       "GCP REST calls by method and outcome code.", "providers",
+       ("method", "code")),
+    _m("tik_gcp_rest_latency_seconds", "histogram",
+       "GCP REST call latency (including retries).", "providers",
+       ("method",), LATENCY_BUCKETS),
+    _m("tik_node_launches_total", "counter",
+       "Provider node launches requested.", "control", ("node_type",)),
+    _m("tik_node_launch_failures_total", "counter",
+       "Provider node launches that raised.", "control", ("node_type",)),
+    _m("tik_scaler_reconcile_total", "counter",
+       "Scaler reconciliation passes, by result.", "control",
+       ("result",)),
+    _m("tik_scaler_reconcile_seconds", "histogram",
+       "Wall time of one scaler reconciliation pass.", "control",
+       (), LATENCY_BUCKETS),
+    _m("tik_scaler_terminations_total", "counter",
+       "Nodes the scaler decided to terminate, by why.", "control",
+       ("reason",)),
+    _m("tik_scaler_recoveries_total", "counter",
+       "Heartbeat-lost nodes sent back through start commands.",
+       "control"),
+    _m("tik_node_updates_total", "counter",
+       "Node updater runs by result.", "control", ("result",)),
+    _m("tik_updater_phase_seconds", "histogram",
+       "Node updater phase durations.", "control", ("phase",),
+       SLOW_BUCKETS),
+    _m("tik_executor_runs_total", "counter",
+       "Commands run through node executors, by result.", "control",
+       ("result",)),
+    _m("tik_executor_run_seconds", "histogram",
+       "Node executor command latency.", "control", (), SLOW_BUCKETS),
+    _m("tik_heartbeats_published_total", "counter",
+       "Heartbeats the node agent published.", "control"),
+    _m("tik_discovery_sync_total", "counter",
+       "Discovery sync render passes by result.", "runtimes",
+       ("result",)),
+    # -- train -----------------------------------------------------------
+    _m("tik_checkpoint_saves_total", "counter",
+       "Checkpoint saves started, by result.", "train", ("result",)),
+    _m("tik_checkpoint_save_seconds", "histogram",
+       "Checkpoint save dispatch latency (async: device->host copy).",
+       "train", (), SLOW_BUCKETS),
+    _m("tik_checkpoint_restore_seconds", "histogram",
+       "Checkpoint restore latency.", "train", (), SLOW_BUCKETS),
+    _m("tik_train_steps_total", "counter",
+       "Optimizer steps taken.", "train"),
+    _m("tik_train_step_seconds", "histogram",
+       "Per-step wall time in the training loop.", "train", (),
+       LATENCY_BUCKETS),
+    _m("tik_train_tokens_per_sec", "gauge",
+       "Training throughput over the last log window.", "train"),
+    _m("tik_train_mfu", "gauge",
+       "Analytic model FLOPs utilization over the last log window "
+       "(flops_per_token x tokens/sec over device peak).", "train"),
+    # -- serve -----------------------------------------------------------
+    _m("tik_serve_requests_total", "counter",
+       "Serve requests finished, by result.", "serve", ("result",)),
+    _m("tik_serve_queue_wait_seconds", "histogram",
+       "Submit -> slot admission wait.", "serve", (), LATENCY_BUCKETS),
+    _m("tik_serve_ttft_seconds", "histogram",
+       "Time to first token (submit -> prefill's first token).",
+       "serve", (), LATENCY_BUCKETS),
+    _m("tik_serve_tpot_seconds", "histogram",
+       "Time per output token after the first (decode cadence).",
+       "serve", (), FAST_BUCKETS),
+    _m("tik_serve_tokens_generated_total", "counter",
+       "Tokens produced by the decode engine.", "serve"),
+    _m("tik_serve_active_slots", "gauge",
+       "Decode slots occupied this step.", "serve"),
+    _m("tik_serve_queue_depth", "gauge",
+       "Requests waiting for a slot.", "serve"),
+    # -- telemetry self-accounting ---------------------------------------
+    _m("tik_spans_dropped_total", "counter",
+       "Finished spans overwritten in the ring before export.",
+       "telemetry"),
+    # -- nodex exporter (registry gauges set by the exporter process) ----
+    _m("tik_node_cpu_percent", "gauge", "CPU utilization.", "nodex"),
+    _m("tik_node_memory_percent", "gauge", "Memory utilization.",
+       "nodex"),
+    _m("tik_node_disk_percent", "gauge", "Disk utilization of /.",
+       "nodex"),
+    _m("tik_node_net_sent_bytes", "gauge", "Bytes sent.", "nodex"),
+    _m("tik_node_net_recv_bytes", "gauge", "Bytes received.", "nodex"),
+    # -- external surfaces (not registry instruments) --------------------
+    _m("tik_cluster_workers", "gauge",
+       "Non-terminated worker count (controller exporter).", "control",
+       source="external"),
+    _m("tik_pending_launches", "gauge",
+       "Launches in flight (controller exporter).", "control",
+       source="external"),
+    _m("tik_active_updaters", "gauge",
+       "Node updaters running (controller exporter).", "control",
+       source="external"),
+    _m("tik_collector_uptime_seconds", "gauge",
+       "Built-in prometheus collector uptime.", "runtimes",
+       source="external"),
+]
+
+METRICS: Dict[str, MetricSpec] = {}
+for _spec in _ALL:
+    if _spec.name in METRICS:
+        raise ValueError(f"duplicate metric name {_spec.name!r}")
+    METRICS[_spec.name] = _spec
+del _ALL, _spec
+
+
+# Span taxonomy: dotted names mirroring the fault-seam registry
+# (faults/seams.py) where the two share an instrumentation point.
+SPANS: Dict[str, str] = {
+    "gcp.rest.request":       "one authenticated REST call incl. retries",
+    "provider.create_node":   "node launcher -> provider create",
+    "provider.terminate_nodes": "scaler -> provider terminate",
+    "scaler.reconcile":       "one full reconciliation pass",
+    "scaler.decision":        "a scale decision; attrs carry action + why",
+    "executor.run":           "one command over ssh/local executor",
+    "updater.wait_ready":     "boot probe until the node answers",
+    "updater.sync_files":     "file-mount rsync",
+    "updater.setup":          "initialization + setup commands",
+    "updater.start_services": "start commands",
+    "checkpoint.save":        "checkpoint save dispatch",
+    "checkpoint.restore":     "checkpoint restore",
+    "discovery.render":       "registry -> targets/dns render pass",
+    "serve.enqueue":          "request submit -> queued",
+    "serve.prefill":          "prompt prefill + cache insert (first token)",
+    "serve.decode_step":      "one engine decode step over all slots",
+    "serve.decode":           "per-request decode window (first->last token)",
+    "train.window":           "one log_every window of training steps",
+}

@@ -23,12 +23,15 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import time
 from typing import Any, Optional
 
 import jax
 
+from cloudtik_tpu import telemetry
 from cloudtik_tpu.faults import seams
 from cloudtik_tpu.faults.plan import DIRECTIVE_TORN_WRITE
+from cloudtik_tpu.telemetry import instruments as ti
 
 logger = logging.getLogger(__name__)
 
@@ -79,12 +82,24 @@ class Checkpointer:
         if force or self._manager.should_save(step):
             directive = seams.fire("checkpoint.save", step=step,
                                    directory=self.config.directory)
-        saved = self._manager.save(
-            step,
-            args=self._ocp.args.Composite(
-                state=self._ocp.args.StandardSave(state)),
-            force=force,
-        )
+        t0 = time.perf_counter()
+        # async saves: the span/histogram cover the dispatch (device ->
+        # host copy), not background durability — attr async says which
+        with telemetry.span("checkpoint.save", step=step,
+                            async_save=self.config.async_save):
+            try:
+                saved = self._manager.save(
+                    step,
+                    args=self._ocp.args.Composite(
+                        state=self._ocp.args.StandardSave(state)),
+                    force=force,
+                )
+            except Exception:
+                ti.CHECKPOINT_SAVES.inc(result="failed")
+                raise
+        if saved:
+            ti.CHECKPOINT_SAVE_SECONDS.observe(time.perf_counter() - t0)
+            ti.CHECKPOINT_SAVES.inc(result="ok")
         if saved and directive == DIRECTIVE_TORN_WRITE:
             # drill point: let the write land, then tear it — the step
             # LOOKS committed (dir present, listed by latest_step) but
@@ -143,14 +158,19 @@ class Checkpointer:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.config.directory}")
         abstract = jax.tree.map(_as_abstract, state_like)
-        if partial:
-            return self._restore_partial(abstract, step)
-        restored = self._manager.restore(
-            step,
-            args=self._ocp.args.Composite(
-                state=self._ocp.args.StandardRestore(abstract)),
-        )
-        return restored["state"]
+        t0 = time.perf_counter()
+        with telemetry.span("checkpoint.restore", step=step,
+                            partial=partial):
+            if partial:
+                restored_state = self._restore_partial(abstract, step)
+            else:
+                restored_state = self._manager.restore(
+                    step,
+                    args=self._ocp.args.Composite(
+                        state=self._ocp.args.StandardRestore(abstract)),
+                )["state"]
+        ti.CHECKPOINT_RESTORE_SECONDS.observe(time.perf_counter() - t0)
+        return restored_state
 
     def _restore_partial(self, abstract: Any, step: int) -> Any:
         """Subtree restore via PyTreeRestore(partial_restore=True) against

@@ -20,7 +20,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from cloudtik_tpu import telemetry
 from cloudtik_tpu.parallel.mesh import MeshConfig, build_mesh
+from cloudtik_tpu.telemetry import instruments as ti
 from cloudtik_tpu.parallel.sharding import (
     AxisRules, DEFAULT_RULES, batch_sharding, tree_to_shardings_safe)
 from cloudtik_tpu.train.checkpoint import CheckpointConfig, Checkpointer
@@ -410,11 +412,18 @@ class Trainer:
         window_steps = 0
         with jax.sharding.set_mesh(self.mesh):
             for _ in range(num_steps):
+                t_step = time.perf_counter()
                 batch = next(data_iter)
                 batch = jax.device_put(batch, self.data_sharding)
                 self.state, metrics = jitted(self.state, batch)
                 self.step += 1
                 window_steps += 1
+                # dispatch wall time per step (async runtimes retire
+                # compute later; the log-window sync below is the
+                # honest throughput number)
+                ti.TRAIN_STEP_SECONDS.observe(
+                    time.perf_counter() - t_step)
+                ti.TRAIN_STEPS.inc()
                 if (self.checkpointer is not None
                         and self.config.checkpoint_every
                         and self.step % self.config.checkpoint_every == 0):
@@ -429,10 +438,16 @@ class Trainer:
                     dt = time.perf_counter() - t_window
                     tokens_s = tokens_per_step * window_steps / dt
                     entry.update(step=self.step, tokens_per_sec=tokens_s)
+                    ti.TRAIN_TOKENS_PER_SEC.set(tokens_s)
                     if self.spec.flops_per_token and peak:
                         mfu = (self.spec.flops_per_token * tokens_s
                                / (peak * n_devices))
                         entry["mfu"] = mfu
+                        ti.TRAIN_MFU.set(mfu)
+                    telemetry.add_span(
+                        "train.window", time.time() - dt, dt,
+                        step=self.step, steps=window_steps,
+                        tokens_per_sec=round(tokens_s, 1))
                     history.append(entry)
                     for cb in callbacks:
                         cb(self, entry)

@@ -54,6 +54,9 @@ TIK_STATE_NAMESPACE_DEFAULT = "tik"
 
 # --- metrics -----------------------------------------------------------------
 TIK_METRICS_PORT_DEFAULT = env_integer("TIK_METRICS_PORT", 44217)
+# telemetry HTTP exposition (/metrics, /trace, /trace/summary) served by
+# head services; `tik trace`/`tik metrics` fetch from it
+TIK_TELEMETRY_PORT_DEFAULT = env_integer("TIK_TELEMETRY_PORT", 9103)
 
 # --- files on nodes ----------------------------------------------------------
 def tik_home() -> str:

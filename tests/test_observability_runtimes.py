@@ -1,0 +1,187 @@
+"""Prometheus collector + grafana dashboards (previously untested).
+
+Satellite coverage from the observability PR: scrape-config rendering
+includes the nodex AND telemetry targets, the built-in collector's
+aggregation/query surfaces behave, and the provisioned dashboard JSON
+references only metric names that resolve against the telemetry
+catalog (telemetry/names.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+import pytest
+import yaml
+
+from cloudtik_tpu.runtimes.prometheus.collector import Collector
+from cloudtik_tpu.utils.constants import TIK_TELEMETRY_PORT_DEFAULT
+
+NODEX_TEXT = """\
+# HELP tik_node_cpu_percent CPU utilization
+# TYPE tik_node_cpu_percent gauge
+tik_node_cpu_percent 12.5
+tik_node_memory_percent{foo="bar"} 33.0
+"""
+
+
+class TestScrapeConfigRendering:
+    def _configure(self, tmp_path, runtime_config=None):
+        from cloudtik_tpu.runtimes.prometheus.runtime import (
+            PrometheusRuntime)
+        rt = PrometheusRuntime(runtime_config or {})
+        rt.node_configure({
+            "is_head": True,
+            "conf_dir": str(tmp_path),
+            "head_ip": "10.0.0.2",
+            "config": {
+                "cluster_name": "obs",
+                "runtime": {"types": ["nodex"]},
+            },
+        })
+        with open(os.path.join(str(tmp_path), "targets.json")) as f:
+            return json.load(f)
+
+    def test_targets_include_nodex_and_telemetry(self, tmp_path):
+        groups = self._configure(tmp_path)
+        by_job = {g["labels"]["job"]: g for g in groups}
+        assert "nodex" in by_job
+        assert by_job["nodex"]["targets"] == ["10.0.0.2:9100"]
+        assert "telemetry" in by_job
+        assert by_job["telemetry"]["targets"] == [
+            f"10.0.0.2:{TIK_TELEMETRY_PORT_DEFAULT}"]
+        assert by_job["telemetry"]["labels"]["cluster"] == "obs"
+
+    def test_telemetry_target_can_be_disabled(self, tmp_path):
+        groups = self._configure(tmp_path,
+                                 {"scrape_telemetry": False})
+        jobs = {g["labels"]["job"] for g in groups}
+        assert "telemetry" not in jobs
+        assert "nodex" in jobs
+
+    def test_prometheus_yml_points_at_targets_file(self, tmp_path):
+        self._configure(tmp_path)
+        doc = yaml.safe_load(
+            open(os.path.join(str(tmp_path), "prometheus.yml")))
+        file_sd = doc["scrape_configs"][0]["file_sd_configs"][0]
+        assert file_sd["files"] == [
+            os.path.join(str(tmp_path), "targets.json")]
+
+
+class TestCollector:
+    @pytest.fixture
+    def collector(self, tmp_path):
+        collector = Collector(str(tmp_path), scrape_interval_s=0.1)
+        with open(os.path.join(str(tmp_path), "targets.json"), "w") as f:
+            json.dump([{"targets": ["10.0.0.3:9100"],
+                        "labels": {"job": "nodex", "cluster": "c"}}], f)
+        return collector
+
+    def test_load_targets_and_down_state(self, collector):
+        targets = collector.load_targets()
+        assert targets == [{"address": "10.0.0.3:9100",
+                            "labels": {"job": "nodex", "cluster": "c"}}]
+        collector.state.update("10.0.0.3:9100", targets[0]["labels"],
+                               None, "connection refused")
+        text = collector.render_metrics()
+        assert 'up{instance="10.0.0.3:9100",cluster="c",job="nodex"} 0' \
+            in text
+
+    def test_render_metrics_aggregates_with_instance(self, collector):
+        labels = {"job": "nodex", "cluster": "c"}
+        collector.state.update("10.0.0.3:9100", labels, NODEX_TEXT, None)
+        collector.state.update("10.0.0.4:9100", labels,
+                               NODEX_TEXT.replace("12.5", "99.0"), None)
+        text = collector.render_metrics()
+        assert 'tik_node_cpu_percent{instance="10.0.0.3:9100"} 12.5' \
+            in text
+        assert 'tik_node_cpu_percent{instance="10.0.0.4:9100"} 99.0' \
+            in text
+        # merged labels keep the sample's own labels first
+        assert 'tik_node_memory_percent{foo="bar",' \
+            'instance="10.0.0.3:9100"} 33.0' in text
+        # HELP/TYPE emitted once though two targets carry them
+        assert text.count("# HELP tik_node_cpu_percent") == 1
+        assert "tik_collector_uptime_seconds" in text
+
+    def test_instant_query_exact_name(self, collector):
+        labels = {"job": "nodex", "cluster": "c"}
+        collector.state.update("10.0.0.3:9100", labels, NODEX_TEXT, None)
+        result = collector.instant_query("tik_node_cpu_percent")
+        assert len(result) == 1
+        assert result[0]["metric"]["instance"] == "10.0.0.3:9100"
+        assert result[0]["value"][1] == "12.5"
+        assert collector.instant_query("tik_node_cpu") == []
+
+    def test_collector_scrapes_telemetry_server(self, tmp_path):
+        """End to end: the built-in collector scrapes a live telemetry
+        endpoint and re-exposes its series."""
+        from cloudtik_tpu import telemetry
+        from cloudtik_tpu.telemetry import http as telemetry_http
+        from cloudtik_tpu.telemetry import instruments as ti
+        telemetry.enable()
+        server = telemetry_http.start_server(0, host="127.0.0.1")
+        try:
+            ti.DISCOVERY_SYNCS.inc(result="ok")
+            collector = Collector(str(tmp_path))
+            with open(os.path.join(str(tmp_path), "targets.json"),
+                      "w") as f:
+                json.dump([{
+                    "targets": [f"127.0.0.1:{server.port}"],
+                    "labels": {"job": "telemetry"}}], f)
+            collector.scrape_once()
+            text = collector.render_metrics()
+            assert "tik_discovery_sync_total" in text
+        finally:
+            server.stop()
+            telemetry.reset()
+
+
+class TestDashboards:
+    def _metric_tokens(self, dashboard):
+        exprs = [t["expr"] for p in dashboard["panels"]
+                 for t in p["targets"]]
+        return set(re.findall(r"\btik_[a-z0-9_]+\b", " ".join(exprs)))
+
+    def test_dashboards_reference_only_cataloged_metrics(self):
+        from cloudtik_tpu.runtimes.grafana.dashboards import (
+            ai_workload_dashboard, cluster_overview_dashboard)
+        from cloudtik_tpu.telemetry.names import METRICS
+        suffixes = ("_bucket", "_sum", "_count")
+        for dashboard in (cluster_overview_dashboard(),
+                          ai_workload_dashboard()):
+            for token in self._metric_tokens(dashboard):
+                base = token
+                for suffix in suffixes:
+                    if token.endswith(suffix):
+                        base = token[: -len(suffix)]
+                        break
+                assert base in METRICS, \
+                    f"{dashboard['uid']} references unknown {token}"
+
+    def test_ai_dashboard_covers_serving_and_training(self):
+        from cloudtik_tpu.runtimes.grafana.dashboards import (
+            ai_workload_dashboard)
+        tokens = self._metric_tokens(ai_workload_dashboard())
+        assert {"tik_serve_ttft_seconds_bucket",
+                "tik_serve_tpot_seconds_bucket",
+                "tik_train_mfu"} <= tokens
+
+    def test_write_dashboards_provisions_files(self, tmp_path):
+        from cloudtik_tpu.runtimes.grafana.dashboards import (
+            write_dashboards)
+        created = write_dashboards(str(tmp_path))
+        names = {os.path.basename(p) for p in created}
+        assert names == {"tik.yaml", "cluster-overview.json",
+                         "ai-workloads.json"}
+        for path in created:
+            assert os.path.exists(path)
+        overview = json.load(open(
+            os.path.join(str(tmp_path), "dashboards",
+                         "cluster-overview.json")))
+        assert overview["uid"] == "tik-cluster-overview"
+        provider = yaml.safe_load(open(
+            os.path.join(str(tmp_path), "dashboards", "tik.yaml")))
+        assert provider["providers"][0]["type"] == "file"

@@ -247,12 +247,16 @@ class TestGrafanaDashboards:
         assert dash["uid"] == "tik-cluster-overview"
         exprs = " ".join(
             t["expr"] for p in dash["panels"] for t in p["targets"])
-        # every metric the dashboard queries is actually emitted
-        import cloudtik_tpu.runtimes.nodex.exporter as nodex
-        nodex_src = open(nodex.__file__).read()
+        # every metric the dashboard queries is actually emitted: node
+        # gauges are registry instruments the nodex exporter sets
+        # (telemetry/instruments.py builds them from the catalog)
+        import cloudtik_tpu.telemetry.instruments  # noqa: F401 (build)
+        from cloudtik_tpu.telemetry.core import REGISTRY
         for metric in ("tik_node_cpu_percent", "tik_node_memory_percent",
                        "tik_node_disk_percent", "tik_node_net_sent_bytes"):
-            assert metric in exprs and metric in nodex_src
+            assert metric in exprs
+            instrument = REGISTRY.get(metric)
+            assert instrument is not None and instrument.kind == "gauge"
         import cloudtik_tpu.control.controller as controller
         ctrl_src = open(controller.__file__).read()
         for metric in ("tik_cluster_workers", "tik_pending_launches"):

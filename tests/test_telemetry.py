@@ -1,0 +1,406 @@
+"""Unified telemetry: core semantics, the zero-cost disabled path, and
+the end-to-end serve/train drills from the PR acceptance criteria.
+
+The two load-bearing properties:
+
+  * DISABLED is free: with TIK_TELEMETRY=off every instrumented path is
+    one attribute check — a tripwire replaces the internal record paths
+    and real instrumented surfaces (REST client, executor, engine
+    submit) run without tripping it.
+  * ENABLED tells the truth: a serve drill produces a span tree linking
+    enqueue -> prefill -> decode for one request, populated TTFT/TPOT
+    histograms, and `tik trace export` emits Chrome-trace JSON that
+    json.load parses with >= 10 events; a trainer smoke run emits
+    finite step-time / tokens-per-sec / MFU.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from cloudtik_tpu import telemetry
+from cloudtik_tpu.telemetry import core as tcore
+from cloudtik_tpu.telemetry import instruments as ti
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.enable()
+    telemetry.reset()
+    yield
+    telemetry.enable()
+    telemetry.reset()
+
+
+class TestCore:
+    def test_span_nesting_links_parent(self):
+        with telemetry.span("scaler.reconcile") as outer:
+            with telemetry.span("executor.run", node_id="n1") as inner:
+                pass
+        records = telemetry.spans()
+        assert [r["name"] for r in records] == \
+            ["executor.run", "scaler.reconcile"]
+        assert records[0]["parent"] == outer.span_id
+        assert records[0]["id"] == inner.span_id
+        assert records[1]["parent"] is None
+
+    def test_span_ring_is_bounded_and_counts_drops(self):
+        ring = tcore.SpanRing(size=8)
+        for i in range(20):
+            ring.append({"i": i})
+        assert len(ring) == 8
+        assert [r["i"] for r in ring.snapshot()] == list(range(12, 20))
+
+    def test_span_records_error_attr(self):
+        with pytest.raises(ValueError):
+            with telemetry.span("checkpoint.save"):
+                raise ValueError("boom")
+        assert telemetry.spans()[-1]["attrs"]["error"] == "ValueError"
+
+    def test_histogram_buckets_cumulative(self):
+        h = tcore.Histogram("tik_t", "t", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h._record(v, {})
+        snap = h.snapshot()
+        assert snap["counts"] == [1, 2, 1, 1]   # per-bucket + Inf
+        assert snap["count"] == 5
+        text_registry = tcore.Registry()
+        text_registry._register(h)
+        from cloudtik_tpu.telemetry.export import render_prometheus
+        text = render_prometheus(text_registry)
+        assert 'tik_t_bucket{le="1"} 3' in text
+        assert 'tik_t_bucket{le="+Inf"} 5' in text
+        assert "tik_t_count 5" in text
+
+    def test_duplicate_registration_raises(self):
+        registry = tcore.Registry()
+        registry.counter("tik_x_total", "x")
+        with pytest.raises(ValueError, match="already registered"):
+            registry.counter("tik_x_total", "x again")
+
+    def test_prometheus_roundtrip(self):
+        ti.SERVE_REQUESTS.inc(result="ok")
+        ti.TRAIN_MFU.set(0.37)
+        samples = telemetry.parse_prometheus(
+            telemetry.render_prometheus())
+        by_name = {(s["name"], tuple(sorted(s["labels"].items()))): s
+                   for s in samples}
+        assert by_name[("tik_serve_requests_total",
+                        (("result", "ok"),))]["value"] == 1.0
+        assert by_name[("tik_train_mfu", ())]["value"] == 0.37
+
+    def test_concurrent_observers(self):
+        def work():
+            for _ in range(500):
+                ti.EXECUTOR_RUN_SECONDS.observe(0.01)
+                ti.EXECUTOR_RUNS.inc(result="ok")
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert ti.EXECUTOR_RUNS.value(result="ok") == 4000
+        assert ti.EXECUTOR_RUN_SECONDS.snapshot()["count"] == 4000
+
+
+class TestDisabledPathIsFree:
+    """TIK_TELEMETRY=off => no spans, no metric mutations, anywhere."""
+
+    @pytest.fixture
+    def tripwire(self, monkeypatch):
+        def boom(*a, **k):
+            raise AssertionError(
+                "telemetry record path reached while disabled")
+
+        monkeypatch.setattr(tcore.Counter, "_record", boom)
+        monkeypatch.setattr(tcore.Gauge, "_record", boom)
+        monkeypatch.setattr(tcore.Histogram, "_record", boom)
+        monkeypatch.setattr(tcore.SpanRing, "append", boom)
+        monkeypatch.setenv("TIK_TELEMETRY", "off")
+        telemetry.configure_from_env()
+        yield
+        telemetry.enable()
+
+    def test_env_gate(self, monkeypatch):
+        monkeypatch.setenv("TIK_TELEMETRY", "off")
+        assert telemetry.configure_from_env() is False
+        monkeypatch.delenv("TIK_TELEMETRY")
+        assert telemetry.configure_from_env() is True
+
+    def test_primitives_are_noops(self, tripwire):
+        assert telemetry.span("scaler.reconcile", x=1) \
+            is telemetry.NOOP_SPAN
+        with telemetry.span("executor.run"):
+            pass
+        telemetry.add_span("serve.decode", 0.0, 1.0)
+        ti.SERVE_TTFT.observe(0.1)
+        ti.SERVE_REQUESTS.inc(result="ok")
+        ti.TRAIN_MFU.set(0.5)
+        assert telemetry.spans() == []
+
+    def test_instrumented_surfaces_stay_silent(self, tripwire, tmp_path):
+        # gcp REST client (fake transport), executor run (recorded
+        # runner), serve submit/reject, scaler decision helper — the
+        # layers the telemetry threads through
+        from cloudtik_tpu.providers.gcp.rest import (
+            RestClient, RestResponse)
+        client = RestClient(
+            transport=lambda m, u, b, h: RestResponse(200, {"ok": 1}),
+            token_provider=lambda: "tok")
+        assert client.get("https://example/x") == {"ok": 1}
+
+        from cloudtik_tpu.control.executor.local import (
+            LocalCommandExecutor)
+
+        class Runner:
+            def check_output(self, *a, **k):
+                return b"out"
+
+        out = LocalCommandExecutor(process_runner=Runner(),
+                                   node_id="n1").run(
+            "echo hi", with_output=True)
+        assert out == "out"
+
+        from cloudtik_tpu.serve.engine import DecodeEngine, Request
+        rejected = DecodeEngine.__new__(DecodeEngine)  # no device state
+        # reject path runs _finish_request without touching slots
+        from cloudtik_tpu.serve.engine import EngineConfig
+        rejected.ec = EngineConfig(slots=1, max_len=8)
+        req = Request([])
+        rejected.submit(req)
+        with pytest.raises(ValueError):
+            req.wait(timeout=1)
+
+
+class TestServeDrill:
+    """Engine lifecycle: span tree, latency histograms, CLI export."""
+
+    @pytest.fixture(scope="class")
+    def engine(self):
+        from cloudtik_tpu.models import transformer as T
+        from cloudtik_tpu.serve.engine import DecodeEngine, EngineConfig
+        cfg = T.config("tiny", dtype=jax.numpy.float32,
+                       attention_impl="reference", remat=False)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        engine = DecodeEngine(
+            params, cfg,
+            EngineConfig(slots=2, max_len=64, prefill_buckets=(8, 16)))
+        engine.start()
+        yield engine
+        engine.stop()
+
+    def test_request_span_tree_and_histograms(self, engine):
+        from cloudtik_tpu.serve.engine import Request
+        req = engine.submit(Request([3, 1, 4, 1, 5], max_new_tokens=8))
+        tokens = req.wait(timeout=300)
+        assert len(tokens) == 8
+        # lifecycle timestamps stamped in order
+        assert req.created <= req.admitted <= req.first_token_time \
+            <= req.done_time
+        by_name = {}
+        for record in telemetry.spans():
+            if record["attrs"].get("request") == req.request_id:
+                by_name[record["name"]] = record
+        # the tree: enqueue -> prefill -> decode linked by request id
+        assert {"serve.enqueue", "serve.prefill",
+                "serve.decode"} <= set(by_name)
+        assert by_name["serve.prefill"]["ts"] >= \
+            by_name["serve.enqueue"]["ts"]
+        assert by_name["serve.decode"]["attrs"]["tokens"] == 8
+        assert any(r["name"] == "serve.decode_step"
+                   for r in telemetry.spans())
+        # populated latency histograms
+        assert ti.SERVE_TTFT.snapshot()["count"] >= 1
+        assert ti.SERVE_TPOT.snapshot()["count"] >= 1
+        assert ti.SERVE_QUEUE_WAIT.snapshot()["count"] >= 1
+        assert ti.SERVE_REQUESTS.value(result="ok") >= 1
+
+    def test_cancel_frees_slot(self, engine):
+        from cloudtik_tpu.serve.engine import Request, RequestCancelled
+        victim = engine.submit(Request([9, 8, 7], max_new_tokens=40))
+        for _ in range(400):
+            if len(victim.tokens) >= 2:
+                break
+            threading.Event().wait(0.02)
+        assert victim.cancel() is True
+        with pytest.raises(RequestCancelled):
+            victim.wait(timeout=60)
+        assert victim.done_time is not None
+        # the freed slot admits new work
+        follow_up = engine.submit(Request([1, 2, 3], max_new_tokens=4))
+        assert len(follow_up.wait(timeout=300)) == 4
+        assert ti.SERVE_REQUESTS.value(result="cancelled") >= 1
+        # cancelling a finished request is a no-op
+        assert follow_up.cancel() is False
+
+    def test_cancel_queued_request_is_prompt_under_saturation(
+            self, engine):
+        """A queued cancel must not wait for a slot to free: it holds
+        no slot state and finishes immediately."""
+        from cloudtik_tpu.serve.engine import Request, RequestCancelled
+        hogs = [engine.submit(Request([5, i + 1], max_new_tokens=50))
+                for i in range(engine.ec.slots)]
+        queued = engine.submit(Request([1, 2], max_new_tokens=4))
+        for _ in range(400):     # wait until every slot is occupied
+            if all(len(h.tokens) >= 1 for h in hogs):
+                break
+            threading.Event().wait(0.02)
+        assert queued.cancel() is True
+        with pytest.raises(RequestCancelled):
+            queued.wait(timeout=5)
+        for hog in hogs:
+            hog.cancel()
+        for hog in hogs:
+            with pytest.raises(RequestCancelled):
+                hog.wait(timeout=60)
+
+    def test_trace_export_cli(self, engine, tmp_path):
+        from click.testing import CliRunner
+
+        from cloudtik_tpu.scripts.cli import cli
+        from cloudtik_tpu.telemetry import http as telemetry_http
+        from cloudtik_tpu.serve.engine import Request
+        engine.submit(Request([2, 7, 1], max_new_tokens=12)).wait(
+            timeout=300)
+        server = telemetry_http.start_server(0, host="127.0.0.1")
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            out_file = tmp_path / "trace.json"
+            runner = CliRunner()
+            result = runner.invoke(
+                cli, ["trace", "export", "--url", url,
+                      "-o", str(out_file)])
+            assert result.exit_code == 0, result.output
+            with open(out_file) as f:
+                trace = json.load(f)
+            events = trace["traceEvents"]
+            assert len(events) >= 10
+            assert {"name", "ph", "ts", "dur", "pid", "tid"} <= \
+                set(events[0])
+            assert any(e["name"] == "serve.prefill" for e in events)
+
+            result = runner.invoke(cli, ["trace", "summary",
+                                         "--url", url])
+            assert result.exit_code == 0, result.output
+            assert "serve.decode_step" in result.output
+
+            result = runner.invoke(cli, ["metrics", "dump", "--url",
+                                         url, "--json"])
+            assert result.exit_code == 0, result.output
+            names = {s["name"] for s in json.loads(result.output)}
+            assert "tik_serve_ttft_seconds_bucket" in names
+        finally:
+            server.stop()
+
+
+class TestTrainerSmoke:
+    def test_step_metrics_present_and_finite(self):
+        from cloudtik_tpu.models import transformer as T
+        from cloudtik_tpu.parallel.mesh import MeshConfig
+        from cloudtik_tpu.train.data import synthetic_lm_batches
+        from cloudtik_tpu.train.optim import OptimizerConfig
+        from cloudtik_tpu.train.trainer import (
+            Trainer, TrainerConfig, transformer_spec)
+        cfg = T.config("tiny", attention_impl="reference")
+        trainer = Trainer(transformer_spec(cfg), TrainerConfig(
+            global_batch_size=8, seq_len=32,
+            mesh=MeshConfig(data=2, fsdp=4),
+            optimizer=OptimizerConfig(learning_rate=1e-3),
+            log_every=2))
+        data = synthetic_lm_batches(8, 32, cfg.vocab_size, seed=3)
+        trainer.fit(data, num_steps=4)
+        assert ti.TRAIN_STEPS.value() == 4
+        step_hist = ti.TRAIN_STEP_SECONDS.snapshot()
+        assert step_hist["count"] == 4 and math.isfinite(step_hist["sum"])
+        tokens_s = ti.TRAIN_TOKENS_PER_SEC.value()
+        mfu = ti.TRAIN_MFU.value()
+        assert tokens_s is not None and math.isfinite(tokens_s) \
+            and tokens_s > 0
+        assert mfu is not None and math.isfinite(mfu) and mfu > 0
+        windows = [r for r in telemetry.spans()
+                   if r["name"] == "train.window"]
+        assert len(windows) == 2
+        assert windows[-1]["attrs"]["steps"] == 2
+
+
+class TestExporterPrimed:
+    def test_nodex_exporter_serves_registry_and_primes_cpu(self):
+        import urllib.request
+
+        from cloudtik_tpu.runtimes.nodex import exporter
+        server = exporter.start_exporter(0, interval_s=30.0)
+        try:
+            # the collect thread's first pass must land real values;
+            # poll briefly for it
+            for _ in range(100):
+                if ti.NODE_MEMORY_PERCENT.value() is not None:
+                    break
+                threading.Event().wait(0.02)
+            assert ti.NODE_MEMORY_PERCENT.value() > 0
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/metrics",
+                    timeout=5) as resp:
+                text = resp.read().decode()
+            assert "tik_node_memory_percent" in text
+            # same port exposes the whole registry, not just node gauges
+            ti.SERVE_REQUESTS.inc(result="ok")
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/metrics",
+                    timeout=5) as resp:
+                assert "tik_serve_requests_total" in resp.read().decode()
+        finally:
+            server.stop()
+
+
+class TestClusterMetricsSurface:
+    def test_summary_has_lost_nodes_and_heartbeat_age(self):
+        from cloudtik_tpu.control.metrics import ClusterMetrics
+        metrics = ClusterMetrics()
+        metrics.update_heartbeat("10.0.0.5", "w-1", heartbeat_time=100.0)
+        metrics.set_lost_nodes({"w-2": "10.0.0.6"})
+        ages = metrics.heartbeat_ages(now=130.0)
+        assert ages == {"w-1": 30.0}
+        summary = metrics.summary()
+        assert summary["lost_nodes"] == {"w-2": "10.0.0.6"}
+        assert "w-1" in summary["heartbeat_age_s"]
+
+    def test_decision_spans_carry_why(self):
+        """Scaler decisions surface WHY: demand / idle / lost node."""
+        from tests.mock_infra import MockProvider  # noqa: F401
+        # the decision helper is driven by the full scaler in
+        # test_scaler.py; here assert the span/metric shape directly
+        from cloudtik_tpu.control.scaler import ClusterScaler
+        scaler = ClusterScaler.__new__(ClusterScaler)
+        scaler._decide("terminate", "idle_timeout", node_id="w-3",
+                       count=2)
+        scaler._decide("launch", "demand", node_type="worker", count=1)
+        decisions = [r for r in telemetry.spans()
+                     if r["name"] == "scaler.decision"]
+        assert decisions[0]["attrs"]["reason"] == "idle_timeout"
+        assert decisions[1]["attrs"]["action"] == "launch"
+        assert ti.SCALER_TERMINATIONS.value(reason="idle_timeout") == 2
+
+
+def test_span_overhead_is_bounded():
+    """Guardrail, not a benchmark: an enabled span must stay cheap
+    (micro-numbers live in benchmarks/telemetry_overhead.py)."""
+    import timeit
+    n = 2000
+    enabled = timeit.timeit(
+        lambda: telemetry.span("executor.run").__enter__().__exit__(
+            None, None, None), number=n) / n
+    telemetry.disable()
+    try:
+        disabled = timeit.timeit(
+            lambda: telemetry.span("executor.run"), number=n) / n
+    finally:
+        telemetry.enable()
+    assert disabled < 5e-6, f"disabled span cost {disabled * 1e6:.2f}us"
+    assert enabled < 1e-4, f"enabled span cost {enabled * 1e6:.2f}us"

@@ -1,0 +1,25 @@
+"""Tier-1 wiring for the telemetry-name static check.
+
+The check itself lives in tools/check_telemetry_names.py (also runnable
+standalone); it enforces that every metric/span name is registered
+exactly once, matches ``tik_[a-z0-9_]+``, and that docs/grafana/alert
+references resolve against the catalog.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+def test_telemetry_names_are_consistent():
+    sys.path.insert(0, TOOLS)
+    try:
+        import check_telemetry_names
+        errors = check_telemetry_names.run_checks()
+    finally:
+        sys.path.remove(TOOLS)
+    assert not errors, "\n".join(errors)

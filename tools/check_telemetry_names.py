@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Static telemetry-name check (standalone and tier-1 via
+tests/test_telemetry_names.py).
+
+Verifies, against the authoritative catalog in
+cloudtik_tpu/telemetry/names.py:
+
+  1. every cataloged metric name matches ``tik_[a-z0-9_]+``;
+  2. every in-process instrument the registry holds is cataloged as
+     source=registry, and vice versa (created exactly once — duplicate
+     registration raises at import, absent registration fails here);
+  3. every registry-metric name literal appears exactly once in the
+     source tree (telemetry/instruments.py) — no shadow registrations;
+  4. every ``telemetry.span("...")`` / ``add_span("...")`` literal in
+     the source is a declared span, and every declared span name occurs
+     somewhere in the source;
+  5. the grafana dashboards reference only resolvable metric names
+     (histogram _bucket/_sum/_count suffixes resolve to their base);
+  6. docs/observability.md's metric catalog covers every cataloged
+     metric, every declared span, and references nothing unknown.
+
+Run: ``python tools/check_telemetry_names.py`` (exit 1 on failure).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+from typing import List
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+METRIC_NAME_RE = re.compile(r"^tik_[a-z0-9_]+$")
+METRIC_TOKEN_RE = re.compile(r"\btik_[a-z0-9_]+\b")
+HISTO_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _source_files() -> List[str]:
+    out = []
+    for base, _dirs, files in os.walk(
+            os.path.join(REPO_ROOT, "cloudtik_tpu")):
+        if "__pycache__" in base:
+            continue
+        out.extend(os.path.join(base, f) for f in files
+                   if f.endswith(".py"))
+    return sorted(out)
+
+
+def _resolves(token: str, known) -> bool:
+    if token in known:
+        return True
+    for suffix in HISTO_SUFFIXES:
+        if token.endswith(suffix) and token[: -len(suffix)] in known:
+            return True
+    return False
+
+
+def run_checks() -> List[str]:
+    from cloudtik_tpu.telemetry import instruments  # noqa: F401  (build)
+    from cloudtik_tpu.telemetry.core import REGISTRY
+    from cloudtik_tpu.telemetry.names import METRICS, SPANS
+
+    errors: List[str] = []
+
+    # 1. name shape
+    for name in METRICS:
+        if not METRIC_NAME_RE.match(name):
+            errors.append(f"metric {name!r} does not match tik_[a-z0-9_]+")
+    for name in SPANS:
+        if not re.match(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$", name):
+            errors.append(f"span {name!r} is not a dotted lowercase name")
+
+    # 2. registry <-> catalog
+    registered = {i.name for i in REGISTRY.instruments()}
+    cataloged = {n for n, s in METRICS.items() if s.source == "registry"}
+    for name in sorted(registered - cataloged):
+        errors.append(f"instrument {name!r} registered but not cataloged "
+                      "in telemetry/names.py")
+    for name in sorted(cataloged - registered):
+        errors.append(f"metric {name!r} cataloged as registry-sourced "
+                      "but no instrument exists")
+    for name in registered:
+        inst = REGISTRY.get(name)
+        spec = METRICS.get(name)
+        if spec and inst and inst.kind != spec.kind:
+            errors.append(f"{name}: instrument kind {inst.kind!r} != "
+                          f"cataloged {spec.kind!r}")
+
+    # 3. registered exactly once: a registry metric's name literal lives
+    # in telemetry/names.py (declaration, once) and is constructed from
+    # the catalog in telemetry/instruments.py (once); anywhere else in
+    # the library the literal must not appear — emit sites go through
+    # instrument objects, dashboards are checked separately (5).
+    sources = {path: open(path, encoding="utf-8").read()
+               for path in _source_files()}
+
+    def _hits(name: str, predicate) -> int:
+        return sum(text.count(f'"{name}"')
+                   for path, text in sources.items() if predicate(path))
+
+    telemetry_dir = os.path.join("cloudtik_tpu", "telemetry")
+    # files that legitimately NAME metrics in query/alert expressions
+    # (their references are resolved against the catalog in check 5)
+    expression_files = (os.path.join("grafana", "dashboards.py"),
+                        os.path.join("prometheus", "alerts.py"))
+    for name in sorted(cataloged):
+        declared = _hits(name, lambda p: p.endswith(
+            os.path.join(telemetry_dir, "names.py")))
+        built = _hits(name, lambda p: p.endswith(
+            os.path.join(telemetry_dir, "instruments.py")))
+        elsewhere = _hits(name, lambda p: (
+            telemetry_dir not in p
+            and not p.endswith(expression_files)))
+        if declared != 1:
+            errors.append(f"metric {name!r} declared {declared}x in "
+                          "telemetry/names.py (must be exactly once)")
+        if built != 1:
+            errors.append(f"metric {name!r} built {built}x in "
+                          "telemetry/instruments.py (must be exactly "
+                          "once)")
+        if elsewhere:
+            errors.append(f"metric name literal {name!r} appears "
+                          f"{elsewhere}x outside the telemetry package "
+                          "— register instruments only via the catalog")
+
+    # 4. span literals <-> catalog
+    used_spans = set()
+    for path, text in sources.items():
+        if path.endswith(os.path.join("telemetry", "names.py")):
+            continue
+        for m in re.finditer(
+                r"(?:telemetry\.span|telemetry\.add_span|self\._phase)"
+                r"\(\s*\n?\s*\"([a-z0-9_.]+)\"", text):
+            used_spans.add(m.group(1))
+            if m.group(1) not in SPANS:
+                errors.append(f"{os.path.relpath(path, REPO_ROOT)}: span "
+                              f"{m.group(1)!r} not declared in "
+                              "telemetry/names.py")
+    for name in sorted(SPANS):
+        if not any(f'"{name}"' in text for path, text in sources.items()
+                   if not path.endswith(
+                       os.path.join("telemetry", "names.py"))):
+            errors.append(f"declared span {name!r} is never fired in "
+                          "cloudtik_tpu source")
+
+    # 5. grafana dashboards + prometheus alert rules resolve
+    from cloudtik_tpu.runtimes.grafana.dashboards import (
+        ai_workload_dashboard, cluster_overview_dashboard)
+    from cloudtik_tpu.runtimes.prometheus.alerts import default_rules
+    known = set(METRICS)
+    for label, blob in (
+            ("dashboard tik-cluster-overview",
+             json.dumps(cluster_overview_dashboard())),
+            ("dashboard tik-ai-workloads",
+             json.dumps(ai_workload_dashboard())),
+            ("prometheus alert rules", json.dumps(default_rules()))):
+        for token in set(METRIC_TOKEN_RE.findall(blob)):
+            if not _resolves(token, known):
+                errors.append(f"{label}: expression references unknown "
+                              f"metric {token!r}")
+
+    # 6. docs catalog coverage
+    doc_path = os.path.join(REPO_ROOT, "docs", "observability.md")
+    if not os.path.exists(doc_path):
+        errors.append("docs/observability.md is missing")
+    else:
+        doc = open(doc_path, encoding="utf-8").read()
+        for name in sorted(METRICS):
+            if name not in doc:
+                errors.append(
+                    f"docs/observability.md does not document {name}")
+        for name in sorted(SPANS):
+            if name not in doc:
+                errors.append(
+                    f"docs/observability.md does not document span {name}")
+        for token in set(METRIC_TOKEN_RE.findall(doc)):
+            if not _resolves(token, known):
+                errors.append("docs/observability.md references unknown "
+                              f"metric {token!r}")
+    return errors
+
+
+def main() -> int:
+    errors = run_checks()
+    if errors:
+        for error in errors:
+            print(f"FAIL: {error}")
+        print(f"{len(errors)} telemetry-name problem(s).")
+        return 1
+    from cloudtik_tpu.telemetry.names import METRICS, SPANS
+    print(f"OK: {len(METRICS)} metrics, {len(SPANS)} spans — catalog, "
+          "registry, source, dashboards, and docs all agree.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
