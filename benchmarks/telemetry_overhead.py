@@ -39,6 +39,17 @@ def main() -> int:
                               n)
     disabled_observe_ns = _ns(
         lambda: ti.EXECUTOR_RUN_SECONDS.observe(0.01), n)
+    # propagation + flight recorder compiled in must not move the
+    # disabled numbers: emit with no journal, trace_context enter/exit,
+    # and current_traceparent are all attribute checks when off
+    from cloudtik_tpu.telemetry import events
+    disabled_event_emit_ns = _ns(
+        lambda: events.emit("tik_scaler_decision", action="launch",
+                            reason="demand"), n)
+    disabled_trace_context_ns = _ns(
+        lambda: telemetry.trace_context(None).__enter__().__exit__(
+            None, None, None), n)
+    disabled_traceparent_ns = _ns(telemetry.current_traceparent, n)
 
     telemetry.enable()
     telemetry.reset()
@@ -66,6 +77,11 @@ def main() -> int:
             "disabled_counter_inc_ns": round(disabled_counter_ns, 1),
             "disabled_histogram_observe_ns":
                 round(disabled_observe_ns, 1),
+            "disabled_event_emit_ns": round(disabled_event_emit_ns, 1),
+            "disabled_trace_context_ns":
+                round(disabled_trace_context_ns, 1),
+            "disabled_current_traceparent_ns":
+                round(disabled_traceparent_ns, 1),
             "enabled_span_ns": round(enabled_span_ns, 1),
             "enabled_counter_inc_ns": round(enabled_counter_ns, 1),
             "enabled_histogram_observe_ns":

@@ -49,6 +49,20 @@ def collect_local(archive_dir: str,
         with open(dst, "w") as f:
             json.dump(_process_table(), f, indent=1)
         created.append(dst)
+    # flight-recorder journal (telemetry/events.py): copied explicitly
+    # so the control plane's decision record lands in every dump even
+    # when the journal lives outside the shipped log dirs
+    from cloudtik_tpu.telemetry import events as tevents
+    for src in tevents.journal_files():
+        dst = os.path.join(archive_dir, "events", os.path.basename(src))
+        try:
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            shutil.copy(src, dst)
+        except OSError:
+            # a live daemon may rotate the journal between listing and
+            # copy — losing one generation must not lose the whole dump
+            continue
+        created.append(dst)
     return created
 
 

@@ -88,3 +88,19 @@ def _shell_env_prefix(env: Optional[Dict[str, str]]) -> str:
     import shlex
     parts = [f"export {k}={shlex.quote(str(v))};" for k, v in env.items()]
     return " ".join(parts) + " "
+
+
+def _propagation_env(span, env: Optional[Dict[str, str]]
+                     ) -> Optional[Dict[str, str]]:
+    """The remote half of trace propagation: export the executor.run
+    span's traceparent into the command environment, so the child
+    process adopts it (telemetry.adopt_traceparent_from_env) and its
+    spans join the head-side trace that issued the command.  With
+    telemetry disabled `span` is the noop span and this returns `env`
+    untouched."""
+    traceparent = getattr(span, "traceparent", None)
+    if traceparent is None:
+        return env
+    merged = dict(env or {})
+    merged.setdefault(telemetry.TRACEPARENT_ENV, traceparent)
+    return merged

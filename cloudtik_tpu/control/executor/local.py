@@ -14,7 +14,8 @@ import subprocess
 from typing import Any, Dict, Optional
 
 from cloudtik_tpu.control.executor.base import (
-    CommandError, CommandExecutor, _shell_env_prefix, run_telemetry)
+    CommandError, CommandExecutor, _propagation_env, _shell_env_prefix,
+    run_telemetry)
 from cloudtik_tpu.faults import seams
 
 
@@ -31,8 +32,9 @@ class LocalCommandExecutor(CommandExecutor):
         # bare node_id, same as the SSH executor fires — fault-plan
         # match filters must behave identically on local/virtual drills
         seams.fire("executor.run", node_id=self.node_id, cmd=cmd)
-        full_cmd = _shell_env_prefix(environment_variables) + cmd
-        with run_telemetry(self.node_id, cmd):
+        with run_telemetry(self.node_id, cmd) as span:
+            full_cmd = _shell_env_prefix(
+                _propagation_env(span, environment_variables)) + cmd
             if not with_output and self.process_runner is subprocess:
                 # real execution path: stream per-line with the node
                 # prefix while keeping a bounded tail for the failure

@@ -13,7 +13,8 @@ import subprocess
 from typing import Any, Dict, List, Optional
 
 from cloudtik_tpu.control.executor.base import (
-    CommandError, CommandExecutor, _shell_env_prefix, run_telemetry)
+    CommandError, CommandExecutor, _propagation_env, _shell_env_prefix,
+    run_telemetry)
 from cloudtik_tpu.faults import seams
 from cloudtik_tpu.utils.retry import (
     RetriesExhausted, RetryPolicy, call_with_retry)
@@ -94,16 +95,17 @@ class SSHCommandExecutor(CommandExecutor):
     def run(self, cmd, *, environment_variables=None, with_output=False,
             run_env="auto", timeout=None, shutdown_after_run=False):
         seams.fire("executor.run", node_id=self.node_id, cmd=cmd)
-        remote_cmd = _shell_env_prefix(environment_variables) + cmd
-        if shutdown_after_run:
-            remote_cmd += "; sudo shutdown -h now"
-        wrapped = _quote("true && source ~/.bashrc && "
-                         "export OMP_NUM_THREADS=1 && " + remote_cmd)
-        final = self._ssh_base() + [
-            f"{self.ssh_user}@{self.ssh_ip}",
-            f"bash --login -c -i {wrapped}",
-        ]
-        with run_telemetry(self.node_id, cmd):
+        with run_telemetry(self.node_id, cmd) as span:
+            remote_cmd = _shell_env_prefix(
+                _propagation_env(span, environment_variables)) + cmd
+            if shutdown_after_run:
+                remote_cmd += "; sudo shutdown -h now"
+            wrapped = _quote("true && source ~/.bashrc && "
+                             "export OMP_NUM_THREADS=1 && " + remote_cmd)
+            final = self._ssh_base() + [
+                f"{self.ssh_user}@{self.ssh_ip}",
+                f"bash --login -c -i {wrapped}",
+            ]
             try:
                 if with_output:
                     out = self.process_runner.check_output(

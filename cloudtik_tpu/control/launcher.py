@@ -20,6 +20,7 @@ from cloudtik_tpu.core.tags import (
     TAG_LAUNCH_CONFIG, TAG_NODE_KIND, TAG_NODE_STATUS, TAG_USER_NODE_TYPE)
 from cloudtik_tpu import telemetry
 from cloudtik_tpu.faults import seams
+from cloudtik_tpu.telemetry import events
 from cloudtik_tpu.telemetry import instruments as ti
 
 logger = logging.getLogger(__name__)
@@ -87,11 +88,18 @@ class NodeLauncher(threading.Thread):
     def run(self) -> None:
         while not self._stop.is_set():
             try:
-                node_type, count = self.queue.get(timeout=1.0)
+                item = self.queue.get(timeout=1.0)
             except queue.Empty:
                 continue
+            # asks are (node_type, count[, traceparent]): the scaler
+            # stamps the reconcile pass's traceparent on each ask, so
+            # the provider spans this thread records join the scale-up
+            # trace that demanded them
+            node_type, count = item[0], item[1]
+            traceparent = item[2] if len(item) > 2 else None
             try:
-                self.launch(node_type, count)
+                with telemetry.trace_context(traceparent):
+                    self.launch(node_type, count)
             except Exception:
                 logger.exception("launch of %d x %s failed", count, node_type)
             finally:
@@ -133,6 +141,8 @@ class NodeLauncher(threading.Thread):
                         nt.get("resources", {}), nt.get("labels", {}))
                     launched = count
             ti.NODE_LAUNCHES.inc(launched, node_type=node_type)
+            events.emit("tik_node_launch", node_type=node_type,
+                        count=launched)
         except NodeLaunchException as e:
             self._record_launch_failure(node_type, count, launched)
             logger.error("node launch failed (%s): %s", e.category,
@@ -151,5 +161,9 @@ class NodeLauncher(threading.Thread):
         count what came up before the failure, fail only the rest."""
         if launched:
             ti.NODE_LAUNCHES.inc(launched, node_type=node_type)
+            events.emit("tik_node_launch", node_type=node_type,
+                        count=launched)
         ti.NODE_LAUNCH_FAILURES.inc(max(count - launched, 1),
                                     node_type=node_type)
+        events.emit("tik_node_launch_failed", node_type=node_type,
+                    count=max(count - launched, 1))

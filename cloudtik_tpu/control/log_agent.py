@@ -50,19 +50,44 @@ class LogAgent:
         self.retained_batches = retained_batches
         self._offsets: Dict[str, int] = {}    # file path -> read offset
         self._stop = threading.Event()
-        self._seq = 0
+        self._seq: Optional[int] = None       # seeded on first poll
 
     def discover_files(self) -> List[str]:
         files = []
         for _name, log_dir in self.log_dirs.items():
-            files.extend(glob.glob(os.path.join(
-                os.path.expanduser(log_dir), "**", "*.log"), recursive=True))
-            files.extend(glob.glob(os.path.join(
-                os.path.expanduser(log_dir), "**", "*.out"), recursive=True))
+            # *.jsonl: the flight-recorder journal (telemetry/events.py)
+            # ships alongside service logs, so the head's copy of each
+            # node's decision record survives the node
+            for pattern in ("*.log", "*.out", "*.jsonl"):
+                files.extend(glob.glob(os.path.join(
+                    os.path.expanduser(log_dir), "**", pattern),
+                    recursive=True))
         return sorted(set(files))
+
+    def _seed_seq(self) -> int:
+        """Restart-safe sequence start: resume AFTER the highest batch
+        this node already shipped instead of restarting at 0 — a
+        restarted agent overwriting old keys would hand consumers
+        already-seen sequence numbers with different content (their
+        high-water dedup would silently drop the new lines)."""
+        try:
+            top = -1
+            for key in self.state.table_keys(
+                    LOG_NS, prefix=f"{self.node_id}:"):
+                try:
+                    top = max(top, int(key.rpartition(":")[2]))
+                except ValueError:
+                    continue
+            return top + 1
+        except Exception:
+            logger.warning("cannot seed log batch sequence; starting "
+                           "at 0", exc_info=True)
+            return 0
 
     def poll_once(self) -> int:
         """Read new lines from all files and publish; returns lines read."""
+        if self._seq is None:
+            self._seq = self._seed_seq()
         published = 0
         for path in self.discover_files():
             try:

@@ -16,6 +16,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import psutil
 
+from cloudtik_tpu import telemetry
 from cloudtik_tpu.control.state import (
     StateClient, TABLE_HEARTBEAT, TABLE_METRICS, TABLE_PROCESSES)
 from cloudtik_tpu.faults import seams
@@ -90,6 +91,10 @@ class NodeAgent:
                 detect_node_resources)
             total_resources = detect_node_resources()
         self.total_resources = total_resources
+        # the updater's start command exported TIK_TRACEPARENT when the
+        # head launched this node: adopt it so this process's spans join
+        # the boot trace (no-op when the env var is absent/invalid)
+        telemetry.adopt_traceparent_from_env()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # TIK_NATIVE_AGENT=1: /proc-reading C++ sampler (SURVEY §2.4 —

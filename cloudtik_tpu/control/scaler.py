@@ -33,6 +33,7 @@ from cloudtik_tpu.core.tags import (
     TAG_RUNTIME_CONFIG, TAG_USER_NODE_TYPE)
 from cloudtik_tpu import telemetry
 from cloudtik_tpu.faults import seams
+from cloudtik_tpu.telemetry import events
 from cloudtik_tpu.telemetry import instruments as ti
 from cloudtik_tpu.utils.constants import (
     TIK_BOOT_GRACE_S, TIK_MAX_CONCURRENT_LAUNCHES,
@@ -179,9 +180,12 @@ class ClusterScaler:
     def _decide(self, action: str, reason: str, **attrs) -> None:
         """Record a scale decision: a zero-length `scaler.decision` span
         carrying WHY (demand, lost node, idle timeout, ...) plus the
-        termination counter when the action removes nodes."""
+        termination counter when the action removes nodes, and the same
+        WHY journaled durably in the flight recorder."""
         telemetry.add_span("scaler.decision", time.time(), 0.0,
                            action=action, reason=reason, **attrs)
+        events.emit("tik_scaler_decision", action=action, reason=reason,
+                    **attrs)
         if action == "terminate":
             ti.SCALER_TERMINATIONS.inc(
                 attrs.get("count", 1), reason=reason)
@@ -425,6 +429,7 @@ class ClusterScaler:
             restart_only=restart_only,
             shared_memory_ratio=shared_memory_ratio(
                 self.config, node_type),
+            traceparent=telemetry.current_traceparent(),
         )
         self.updaters[node_id] = updater
         updater.start()
@@ -478,7 +483,10 @@ class ClusterScaler:
                 "Adding {} node(s) of type %s." % node_type,
                 quantity=count)
             self.pending_launches.inc(node_type, count)
-            self.launch_queue.put((node_type, count))
+            # stamp the reconcile pass's trace on the ask so the
+            # launcher thread's provider spans join this scale-up trace
+            self.launch_queue.put(
+                (node_type, count, telemetry.current_traceparent()))
 
     # ------------------------------------------------------------------
     def summary(self) -> Dict[str, Any]:

@@ -97,6 +97,11 @@ class NodeServicesStarter:
         self.state_client: Optional[StateClient] = None
         self.runtime_failures: Dict[str, str] = {}
         self.telemetry_server = None
+        # trace propagation: the executor that launched this node's
+        # start command exported TIK_TRACEPARENT — adopt it so every
+        # span this process records joins the head-side boot trace
+        from cloudtik_tpu import telemetry
+        telemetry.adopt_traceparent_from_env()
 
     # ------------------------------------------------------------------
     def start_head_processes(self) -> None:
@@ -182,6 +187,18 @@ class NodeServicesStarter:
 
     def _start_common_agents(self) -> None:
         from cloudtik_tpu.runtimes import delivery
+        from cloudtik_tpu.telemetry import events
+
+        # flight recorder (telemetry/events.py): daemons journal their
+        # control-plane transitions durably; the journal lives under the
+        # shipped log dirs so the log agent and cluster dumps carry it
+        try:
+            events.install()
+            events.emit("tik_node_services_start", node_id=self.node_id,
+                        is_head=self.is_head)
+        except OSError:
+            logger.warning("flight recorder not installed",
+                           exc_info=True)
 
         runtimes = iter_runtimes(self.config)
         process_specs = []
@@ -260,6 +277,8 @@ class NodeServicesStarter:
                 svc.stop()
         if self.telemetry_server:
             self.telemetry_server.stop()
+        from cloudtik_tpu.telemetry import events
+        events.uninstall()
         if self.state_server:
             self.state_server.stop()
 

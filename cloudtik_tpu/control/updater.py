@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Optional
 
 from cloudtik_tpu import telemetry
 from cloudtik_tpu.control.executor.base import CommandError, CommandExecutor
+from cloudtik_tpu.telemetry import events
 from cloudtik_tpu.telemetry import instruments as ti
 from cloudtik_tpu.core.node_provider import NodeProvider
 from cloudtik_tpu.core.tags import (
@@ -71,6 +72,7 @@ class NodeUpdater:
         restart_only: bool = False,
         no_restart: bool = False,
         shared_memory_ratio: float = 0.0,
+        traceparent: Optional[str] = None,
     ):
         self.node_id = node_id
         self.provider = provider
@@ -87,6 +89,11 @@ class NodeUpdater:
         self.restart_only = restart_only
         self.no_restart = no_restart
         self.shared_memory_ratio = shared_memory_ratio
+        # trace context of the operation that spawned this updater
+        # (the scaler's reconcile pass): this thread's phase spans and
+        # the commands it issues join that trace instead of minting
+        # disconnected per-phase traces
+        self.traceparent = traceparent
         self.error: Optional[Exception] = None
 
     def _set_status(self, status: str) -> None:
@@ -94,17 +101,23 @@ class NodeUpdater:
 
     def run(self) -> None:
         try:
-            self.do_update()
-            ti.NODE_UPDATES.inc(result="ok")
+            with telemetry.trace_context(self.traceparent):
+                self.do_update()
+            self._record_result("ok")
         except Exception as e:
             self.error = e
-            ti.NODE_UPDATES.inc(result="failed")
+            self._record_result("failed")
             try:
                 self._set_status(STATUS_UPDATE_FAILED)
             except Exception:
                 pass
             logger.exception("node %s update failed", self.node_id)
             raise
+
+    def _record_result(self, result: str) -> None:
+        ti.NODE_UPDATES.inc(result=result)
+        events.emit("tik_node_update", node_id=self.node_id,
+                    result=result, restart_only=self.restart_only)
 
     def _phase(self, name: str):
         """Span + tik_updater_phase_seconds for one bootstrap phase."""
@@ -193,12 +206,13 @@ class NodeUpdaterThread(NodeUpdater, threading.Thread):
 
     def run(self) -> None:  # type: ignore[override]
         try:
-            self.do_update()
+            with telemetry.trace_context(self.traceparent):
+                self.do_update()
             self.exitcode = 0
-            ti.NODE_UPDATES.inc(result="ok")
+            self._record_result("ok")
         except Exception as e:
             self.error = e
-            ti.NODE_UPDATES.inc(result="failed")
+            self._record_result("failed")
             try:
                 self._set_status(STATUS_UPDATE_FAILED)
             except Exception:

@@ -135,6 +135,13 @@ class FaultPlan:
                 break
         if fired_point is None:
             return None
+        # journal the firing BEFORE applying (a `raise` fault must still
+        # leave its record); import here — telemetry.events reaches back
+        # into faults for the torn-write directive
+        if seam != "events.append":    # the journal's own seam: no loop
+            from cloudtik_tpu.telemetry import events
+            events.emit("tik_fault_fired", seam=seam,
+                        kind=fired_point.kind)
         # apply OUTSIDE the lock: a latency sleep or a provider call here
         # must stall only this seam's caller, not every instrumented
         # thread in the process

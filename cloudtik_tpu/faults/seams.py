@@ -21,6 +21,7 @@ Seam registry (keep docs/fault-injection.md in sync):
   state.get / state.put           StateClient kv+tables {table, key}
   node_agent.heartbeat            heartbeat publish     {ip, node_id}   supports drop
   checkpoint.save                 Checkpointer.save     {step, directory} supports torn_write
+  events.append                   flight recorder append {name, path}    supports torn_write
   serve.decode_step               DecodeEngine._step    {active}
   utils.retry                     every retry sleep     {fn, attempt}
 """

@@ -29,6 +29,8 @@ def start_exporter(port: int, interval_s: float = 10.0):
     # exporting metrics IS this process's job: force the registry on
     # even when the host env carries TIK_TELEMETRY=off for workloads
     telemetry.enable()
+    # join the boot trace when the start command carried one
+    telemetry.adopt_traceparent_from_env()
 
     # prime the cpu sampler: the first cpu_percent(interval=None) call
     # has no reference window and returns a meaningless 0.0 — take the

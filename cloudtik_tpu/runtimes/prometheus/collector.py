@@ -31,6 +31,31 @@ from urllib.parse import parse_qs, urlparse
 
 _SAMPLE_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+([^\s]+)")
+_QUERY_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+def load_file_sd_targets(conf_dir: str,
+                         jobs=None) -> List[Dict[str, Any]]:
+    """Parse prometheus file-SD targets.json under `conf_dir` into
+    [{address, labels}] — the shared discovery input of the metrics
+    collector and the trace collector.  `jobs` (when given) keeps only
+    groups whose `job` label is in it."""
+    path = os.path.join(os.path.expanduser(conf_dir), "targets.json")
+    try:
+        with open(path) as f:
+            groups = json.load(f)
+    except (OSError, ValueError):
+        return []
+    out = []
+    for group in groups:
+        labels = dict(group.get("labels", {}))
+        if jobs is not None and labels.get("job") not in jobs:
+            continue
+        for address in group.get("targets", []):
+            out.append({"address": address, "labels": labels})
+    return out
 
 
 class ScrapeState:
@@ -41,13 +66,15 @@ class ScrapeState:
         self.targets: Dict[str, Dict[str, Any]] = {}
 
     def update(self, address: str, labels: Dict[str, str],
-               text: Optional[str], error: Optional[str]) -> None:
+               text: Optional[str], error: Optional[str],
+               duration_s: float = 0.0) -> None:
         with self.lock:
             self.targets[address] = {
                 "address": address,
                 "labels": labels,
                 "up": error is None,
                 "last_scrape": time.time(),
+                "scrape_duration_s": duration_s,
                 "error": error,
                 "text": text or "",
             }
@@ -67,30 +94,22 @@ class Collector:
 
     # -- target discovery (file-SD) ---------------------------------------
     def load_targets(self) -> List[Dict[str, Any]]:
-        path = os.path.join(self.conf_dir, "targets.json")
-        try:
-            with open(path) as f:
-                groups = json.load(f)
-        except (OSError, ValueError):
-            return []
-        out = []
-        for group in groups:
-            for address in group.get("targets", []):
-                out.append({"address": address,
-                            "labels": dict(group.get("labels", {}))})
-        return out
+        return load_file_sd_targets(self.conf_dir)
 
     # -- scraping ----------------------------------------------------------
     def scrape_once(self) -> None:
         for target in self.load_targets():
             address = target["address"]
             url = f"http://{address}/metrics"
+            t0 = time.perf_counter()
             try:
                 with urllib.request.urlopen(url, timeout=3) as resp:
                     text = resp.read().decode(errors="replace")
-                self.state.update(address, target["labels"], text, None)
+                self.state.update(address, target["labels"], text, None,
+                                  time.perf_counter() - t0)
             except Exception as e:
-                self.state.update(address, target["labels"], None, str(e))
+                self.state.update(address, target["labels"], None,
+                                  str(e), time.perf_counter() - t0)
 
     def run_scraper(self) -> None:
         while not self._stop.is_set():
@@ -98,7 +117,16 @@ class Collector:
             self._stop.wait(self.scrape_interval_s)
 
     # -- query -------------------------------------------------------------
-    def instant_query(self, metric: str) -> List[Dict[str, Any]]:
+    def instant_query(self, query: str) -> List[Dict[str, Any]]:
+        """Instant lookup: an exact metric name, optionally narrowed by
+        equality label matchers — `name{label="v",l2="w"}`.  Matchers
+        resolve against the union of the sample's own labels, the
+        target's file-SD labels, and `instance`."""
+        q = _QUERY_RE.match(query.strip())
+        if not q:
+            return []
+        metric = q.group(1)
+        matchers = dict(_LABEL_RE.findall(q.group(2) or ""))
         results = []
         for target in self.state.snapshot().values():
             if not target["up"]:
@@ -107,13 +135,19 @@ class Collector:
                 if line.startswith("#"):
                     continue
                 m = _SAMPLE_RE.match(line)
-                if m and m.group(1) == metric:
-                    results.append({
-                        "metric": {"__name__": metric,
-                                   "instance": target["address"],
-                                   **target["labels"]},
-                        "value": [time.time(), m.group(3)],
-                    })
+                if not (m and m.group(1) == metric):
+                    continue
+                labels = {
+                    **target["labels"],
+                    **dict(_LABEL_RE.findall(m.group(2) or "")),
+                    "instance": target["address"],
+                }
+                if any(labels.get(k) != v for k, v in matchers.items()):
+                    continue
+                results.append({
+                    "metric": {"__name__": metric, **labels},
+                    "value": [time.time(), m.group(3)],
+                })
         return results
 
     def render_metrics(self) -> str:
@@ -125,6 +159,9 @@ class Collector:
             "# HELP tik_collector_uptime_seconds Collector uptime.",
             "# TYPE tik_collector_uptime_seconds gauge",
             f"tik_collector_uptime_seconds {time.time() - self.started_at}",
+            "# HELP scrape_duration_seconds Wall time of the last "
+            "scrape of each target.",
+            "# TYPE scrape_duration_seconds gauge",
         ]
         seen_headers: set = set()
         for target in self.state.snapshot().values():
@@ -133,6 +170,10 @@ class Collector:
             lines.append(
                 f'up{{instance="{target["address"]}"{labels}}} '
                 f'{1 if target["up"] else 0}')
+            lines.append(
+                f'scrape_duration_seconds'
+                f'{{instance="{target["address"]}"{labels}}} '
+                f'{target.get("scrape_duration_s", 0.0):.6f}')
             if not target["up"]:
                 continue
             for raw in target["text"].splitlines():

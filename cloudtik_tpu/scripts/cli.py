@@ -881,6 +881,183 @@ def metrics_dump(url, config_file, as_json):
         click.echo(body, nl=False)
 
 
+# ---------------------------------------------------------------- cluster --
+
+@cli.group()
+def cluster():
+    """Cluster-wide observability aggregated on the head
+    (docs/observability.md)."""
+
+
+@cluster.group(name="trace")
+def cluster_trace():
+    """Cross-node traces: scrape every node's /trace endpoint
+    (discovered from the prometheus runtime's file-SD targets) and
+    stitch the spans by trace_id into one Chrome-trace with a process
+    lane per node."""
+
+
+def _trace_collector(conf_dir):
+    from cloudtik_tpu.runtimes.prometheus.trace_collector import (
+        TraceCollector)
+    if conf_dir is None:
+        from cloudtik_tpu.utils.constants import tik_home
+        conf_dir = os.path.join(tik_home(), "prometheus")
+    return TraceCollector(conf_dir)
+
+
+_conf_dir_opt = click.option(
+    "--conf-dir", default=None,
+    help="Prometheus file-SD config dir holding targets.json "
+         "(default: <tik home>/prometheus).")
+
+
+@cluster_trace.command(name="export")
+@_conf_dir_opt
+@click.option("--trace-id", default=None,
+              help="Only spans of this trace.")
+@click.option("--output", "-o", default=None,
+              help="Write the stitched Chrome-trace here "
+                   "(default: stdout).")
+def cluster_trace_export(conf_dir, trace_id, output):
+    """Export one stitched Chrome-trace across all nodes."""
+    collector = _trace_collector(conf_dir)
+    trace, sources = collector.export(trace_id=trace_id)
+    if not sources:
+        raise click.ClickException(
+            "no trace targets discovered (is targets.json rendered? "
+            "see docs/observability.md)")
+    for source in sources:
+        if source["error"]:
+            cli_logger.warning("target {} unreachable: {}",
+                               source["address"], source["error"])
+    if output:
+        with open(output, "w") as f:
+            json.dump(trace, f, indent=1)
+        cli_logger.success(
+            "Wrote {} events from {} node(s) to {}.",
+            len(trace["traceEvents"]),
+            sum(1 for s in sources if s["events"]), output)
+    else:
+        click.echo(json.dumps(trace, indent=1))
+
+
+@cluster_trace.command(name="summary")
+@_conf_dir_opt
+@click.option("--trace-id", default=None,
+              help="Only this trace.")
+def cluster_trace_summary(conf_dir, trace_id):
+    """Per-trace span counts, node lanes, and wall extents."""
+    collector = _trace_collector(conf_dir)
+    rows = collector.summary()
+    if trace_id:
+        rows = [r for r in rows if r["trace_id"] == trace_id]
+    if not rows:
+        cli_logger.info("No traces collected.")
+        return
+    click.echo(f"{'trace':<34}  {'spans':>5}  {'nodes':>5}  "
+               f"{'duration':>10}  root")
+    for row in rows:
+        click.echo(
+            f"{row['trace_id']:<34}  {row['spans']:>5}  "
+            f"{len(row['nodes']):>5}  "
+            f"{row['duration_s'] * 1e3:>8.2f}ms  {row['root']}")
+
+
+# ----------------------------------------------------------------- events --
+
+@cli.group(name="events")
+def events_group():
+    """Flight recorder: the durable JSONL journal of control-plane
+    decisions (docs/observability.md).  Each record carries the
+    traceparent active when it was written, linking the WHY to the
+    distributed trace of the operation."""
+
+
+_events_path_opt = click.option(
+    "--path", default=None,
+    help="Journal path (default: <tik home>/logs/events.jsonl).")
+
+
+def _format_event(record):
+    import datetime as _dt
+    ts = _dt.datetime.fromtimestamp(record.get("ts", 0)).strftime(
+        "%Y-%m-%d %H:%M:%S.%f")[:-3]
+    name = record.get("name", "?")
+    extras = " ".join(
+        f"{k}={v}" for k, v in record.items()
+        if k not in ("ts", "seq", "name"))
+    return f"{ts}  {name}  {extras}".rstrip()
+
+
+@events_group.command(name="dump")
+@_events_path_opt
+@click.option("--json", "as_json", is_flag=True,
+              help="Emit raw records as a JSON array.")
+@click.option("--trace-id", default=None,
+              help="Only events stamped with this trace.")
+def events_dump(path, as_json, trace_id):
+    """Replay the journal, causally ordered (torn lines skipped)."""
+    from cloudtik_tpu.telemetry import events as tevents
+    records = tevents.read_events(path)
+    if trace_id:
+        records = [r for r in records
+                   if trace_id in r.get("traceparent", "")]
+    records.sort(key=lambda r: r.get("ts", 0))
+    if as_json:
+        click.echo(json.dumps(records, indent=1, default=str))
+        return
+    if not records:
+        cli_logger.info("No events recorded.")
+        return
+    for record in records:
+        click.echo(_format_event(record))
+
+
+@events_group.command(name="tail")
+@_events_path_opt
+@click.option("--lines", "-n", default=10, show_default=True)
+@click.option("--follow", "-f", is_flag=True,
+              help="Keep streaming appended events.")
+def events_tail(path, lines, follow):
+    """Show the newest journal events; -f follows appends."""
+    import time as _time
+
+    from cloudtik_tpu.telemetry import events as tevents
+    records = tevents.read_events(path)
+    for record in records[-lines:]:
+        click.echo(_format_event(record))
+    if not follow:
+        return
+    files = tevents.journal_files(path)
+    offset = os.path.getsize(files[-1]) if files else 0
+    try:
+        while True:
+            _time.sleep(0.5)
+            files = tevents.journal_files(path)
+            if not files:
+                continue
+            current = files[-1]
+            size = os.path.getsize(current)
+            if size < offset:        # rotated under us
+                offset = 0
+            if size == offset:
+                continue
+            with open(current, "rb") as f:
+                f.seek(offset)
+                chunk = f.read()
+            # only complete lines: the tail may be mid-append
+            complete, _, _rest = chunk.rpartition(b"\n")
+            offset += len(complete) + 1 if complete else 0
+            for line in complete.splitlines():
+                try:
+                    click.echo(_format_event(json.loads(line)))
+                except ValueError:
+                    continue
+    except KeyboardInterrupt:
+        pass
+
+
 # ------------------------------------------------------------------ chaos --
 
 @cli.group()

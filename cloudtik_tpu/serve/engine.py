@@ -45,6 +45,7 @@ import numpy as np
 
 from cloudtik_tpu import telemetry
 from cloudtik_tpu.faults import seams
+from cloudtik_tpu.telemetry import events
 from cloudtik_tpu.telemetry import instruments as ti
 from cloudtik_tpu.models.generate import (
     _NEG, _rms_norm, forward_step, init_cache)
@@ -98,6 +99,10 @@ class Request:
         self.tokens: List[int] = []
         self.error: Optional[Exception] = None
         self.request_id = next(_request_ids)
+        # trace handoff: stamped at submit() with the enqueue span's
+        # traceparent, re-entered by the loop thread so the whole
+        # request (enqueue -> prefill -> decode) is one trace
+        self.traceparent: Optional[str] = None
         self.created: float = time.time()
         self.admitted: Optional[float] = None
         self.first_token_time: Optional[float] = None
@@ -143,6 +148,8 @@ class Request:
                     self.error = RequestCancelled("request cancelled")
                     self.done_time = time.time()
                     ti.SERVE_REQUESTS.inc(result="cancelled")
+                    events.emit("tik_serve_cancel",
+                                request=self.request_id)
                     self._done.set()
         return True
 
@@ -315,7 +322,8 @@ class DecodeEngine:
         request._engine = self
         with telemetry.span("serve.enqueue",
                             request=request.request_id,
-                            prompt_len=len(request.prompt)):
+                            prompt_len=len(request.prompt)) as span:
+            request.traceparent = getattr(span, "traceparent", None)
             self._queue.put(request)
         ti.SERVE_QUEUE_DEPTH.set(self._queue.qsize())
         self._wake.set()
@@ -375,10 +383,17 @@ class DecodeEngine:
             if len(req.tokens) > 1:
                 ti.SERVE_TPOT.observe(
                     (req.done_time - first) / (len(req.tokens) - 1))
-            telemetry.add_span(
-                "serve.decode", first, req.done_time - first,
-                request=req.request_id, tokens=len(req.tokens),
-                result=result)
+            with telemetry.trace_context(req.traceparent):
+                telemetry.add_span(
+                    "serve.decode", first, req.done_time - first,
+                    request=req.request_id, tokens=len(req.tokens),
+                    result=result)
+        if result == "cancelled":
+            # in the request's trace (not whatever ambient context the
+            # cancelling thread carries) so `tik events dump --trace-id`
+            # replays the cancellation next to the admission
+            with telemetry.trace_context(req.traceparent):
+                events.emit("tik_serve_cancel", request=req.request_id)
         ti.SERVE_REQUESTS.inc(result=result)
         req._done.set()
 
@@ -429,18 +444,25 @@ class DecodeEngine:
                 req.admitted = time.time()
                 ti.SERVE_QUEUE_WAIT.observe(req.admitted - req.created)
                 true_len = len(req.prompt)
-                with telemetry.span("serve.prefill",
-                                    request=req.request_id,
-                                    prompt_len=true_len, slot=slot_id):
-                    padded = np.zeros((1, self._bucket(true_len)),
-                                      np.int32)
-                    padded[0, :true_len] = req.prompt
-                    pk, pv, first = self._prefill(
-                        self.params, jnp.asarray(padded),
-                        jnp.asarray(true_len, jnp.int32))
-                    self._ks, self._vs = self._insert(
-                        self._ks, self._vs, pk, pv, slot_id)
-                    first_tok = int(first)
+                # re-enter the request's trace: this is the loop thread,
+                # so the submit-side context does not carry over
+                with telemetry.trace_context(req.traceparent):
+                    events.emit("tik_serve_admission",
+                                request=req.request_id, slot=slot_id,
+                                prompt_len=true_len)
+                    with telemetry.span("serve.prefill",
+                                        request=req.request_id,
+                                        prompt_len=true_len,
+                                        slot=slot_id):
+                        padded = np.zeros((1, self._bucket(true_len)),
+                                          np.int32)
+                        padded[0, :true_len] = req.prompt
+                        pk, pv, first = self._prefill(
+                            self.params, jnp.asarray(padded),
+                            jnp.asarray(true_len, jnp.int32))
+                        self._ks, self._vs = self._insert(
+                            self._ks, self._vs, pk, pv, slot_id)
+                        first_tok = int(first)
                 req.tokens.append(first_tok)
                 req.first_token_time = time.time()
                 ti.SERVE_TTFT.observe(req.first_token_time - req.created)

@@ -25,6 +25,8 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional
 
+from cloudtik_tpu import telemetry
+
 logger = logging.getLogger(__name__)
 
 
@@ -221,7 +223,13 @@ class ServeServer:
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(
                         self.rfile.read(length) or b"{}")
-                    self._send(200, fn(payload))
+                    # adopt the caller's W3C traceparent header (a
+                    # gateway or remote client minted it) so the whole
+                    # served request — engine spans included — is one
+                    # trace; without one each request is its own trace
+                    with telemetry.trace_context(
+                            self.headers.get("traceparent")):
+                        self._send(200, fn(payload))
                 except Exception as e:
                     logger.exception("serve request failed")
                     self._send(400, {"error": str(e)})

@@ -22,15 +22,23 @@ Export::
 """
 
 from cloudtik_tpu.telemetry.core import (  # noqa: F401
-    NOOP_SPAN, REGISTRY, SPAN_RING, add_span, configure_from_env,
-    disable, enable, enabled, reset, span, spans, timed_span)
+    NOOP_SPAN, REGISTRY, SPAN_RING, TRACEPARENT_ENV, add_span,
+    adopt_traceparent, adopt_traceparent_from_env,
+    clear_adopted_traceparent, configure_from_env, current_traceparent,
+    disable, enable, enabled, format_traceparent, parse_traceparent,
+    reset, span, spans, timed_span, trace_context)
 from cloudtik_tpu.telemetry.export import (  # noqa: F401
     chrome_trace, parse_prometheus, render_prometheus, trace_summary)
-from cloudtik_tpu.telemetry.names import METRICS, SPANS  # noqa: F401
+from cloudtik_tpu.telemetry.names import (  # noqa: F401
+    EVENTS, METRICS, SPANS)
 
 __all__ = [
-    "NOOP_SPAN", "REGISTRY", "SPAN_RING", "METRICS", "SPANS",
-    "add_span", "chrome_trace", "configure_from_env", "disable",
-    "enable", "enabled", "parse_prometheus", "render_prometheus",
-    "reset", "span", "spans", "timed_span", "trace_summary",
+    "EVENTS", "METRICS", "NOOP_SPAN", "REGISTRY", "SPANS", "SPAN_RING",
+    "TRACEPARENT_ENV", "add_span", "adopt_traceparent",
+    "adopt_traceparent_from_env", "chrome_trace",
+    "clear_adopted_traceparent", "configure_from_env",
+    "current_traceparent", "disable", "enable", "enabled",
+    "format_traceparent", "parse_prometheus", "parse_traceparent",
+    "render_prometheus", "reset", "span", "spans", "timed_span",
+    "trace_context", "trace_summary",
 ]

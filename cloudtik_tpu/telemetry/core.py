@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import itertools
 import os
+import re
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -283,8 +284,134 @@ REGISTRY = Registry()
 # ------------------------------------------------------------------ spans --
 
 _SPAN_RING_SIZE = max(int(os.environ.get("TIK_TELEMETRY_RING", "4096")), 16)
-_span_ids = itertools.count(1)
 _tls = threading.local()
+
+# W3C-traceparent-style identifiers: 32-hex trace ids, 16-hex span ids.
+# Each is a random per-process prefix plus a process-local counter —
+# unique across the cluster w.h.p. without paying an os.urandom call per
+# span on the enabled hot path.
+_TRACE_PREFIX = os.urandom(12).hex()          # 24 of the 32 trace chars
+_SPAN_PREFIX = os.urandom(4).hex()            # 8 of the 16 span chars
+_trace_ids = itertools.count(1)
+_span_ids = itertools.count(1)
+
+# env var the executors export into remote commands; child processes
+# adopt it via adopt_traceparent_from_env()
+TRACEPARENT_ENV = "TIK_TRACEPARENT"
+_TRACEPARENT_RE = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$")
+
+
+def _new_trace_id() -> str:
+    return _TRACE_PREFIX + format(next(_trace_ids) & 0xFFFFFFFF, "08x")
+
+
+def _new_span_id() -> str:
+    return _SPAN_PREFIX + format(next(_span_ids) & 0xFFFFFFFF, "08x")
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def parse_traceparent(
+        traceparent: Optional[str]) -> Optional[Tuple[str, str]]:
+    """`00-<trace>-<span>-<flags>` -> (trace_id, span_id), else None."""
+    if not traceparent:
+        return None
+    m = _TRACEPARENT_RE.match(str(traceparent).strip())
+    if m is None:
+        return None
+    return m.group(1), m.group(2)
+
+
+# Process-wide remote parent, adopted once at boot from TIK_TRACEPARENT
+# (the executor that launched this process exported it): root spans with
+# no more specific context become children of it, so e.g. every span a
+# node-boot command's process records joins the head-side trace that
+# started the boot.  (trace_id, span_id-or-None).
+_AMBIENT: Optional[Tuple[str, Optional[str]]] = None
+
+
+def adopt_traceparent(traceparent: Optional[str]) -> bool:
+    """Adopt a remote parent for this PROCESS; returns True if valid."""
+    global _AMBIENT
+    parsed = parse_traceparent(traceparent)
+    if parsed is None:
+        return False
+    _AMBIENT = parsed
+    return True
+
+
+def adopt_traceparent_from_env() -> bool:
+    """Adopt TIK_TRACEPARENT from the environment when present/valid."""
+    return adopt_traceparent(os.environ.get(TRACEPARENT_ENV))
+
+
+def clear_adopted_traceparent() -> None:
+    global _AMBIENT
+    _AMBIENT = None
+
+
+def _resolve_context() -> Tuple[str, Optional[str]]:
+    """(trace_id, parent_span_id) a new span on this thread belongs to:
+    the innermost open span, else the thread's trace_context, else the
+    process ambient, else a freshly minted root trace."""
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        span_id, trace_id = stack[-1]
+        return trace_id, span_id
+    ambient = getattr(_tls, "ambient", None) or _AMBIENT
+    if ambient is not None:
+        return ambient[0], ambient[1]
+    return _new_trace_id(), None
+
+
+def current_traceparent() -> Optional[str]:
+    """traceparent of the innermost open span (or the adopted ambient
+    context) on this thread; None when disabled or no context active."""
+    if not STATE.enabled:
+        return None
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        span_id, trace_id = stack[-1]
+        return format_traceparent(trace_id, span_id)
+    ambient = getattr(_tls, "ambient", None) or _AMBIENT
+    if ambient is not None and ambient[1] is not None:
+        return format_traceparent(ambient[0], ambient[1])
+    return None
+
+
+class trace_context:
+    """Ambient trace parent for a block on THIS thread — the
+    cross-thread / cross-process handoff primitive.  Pass the
+    traceparent a peer minted (HTTP header, serve Request attr,
+    executor env) and spans opened inside join that trace as children;
+    with no/invalid traceparent a fresh trace is minted so the block is
+    still one coherent trace.  No-op when telemetry is disabled."""
+
+    __slots__ = ("_traceparent", "_prev", "_active")
+
+    def __init__(self, traceparent: Optional[str] = None):
+        self._traceparent = traceparent
+        self._prev: Optional[Tuple[str, Optional[str]]] = None
+        self._active = False
+
+    def __enter__(self) -> "trace_context":
+        if not STATE.enabled:
+            return self
+        self._active = True
+        self._prev = getattr(_tls, "ambient", None)
+        parsed = parse_traceparent(self._traceparent)
+        _tls.ambient = parsed if parsed is not None \
+            else (_new_trace_id(), None)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._active:
+            _tls.ambient = self._prev
+            self._active = False
+        return False
 
 
 class SpanRing:
@@ -331,7 +458,8 @@ class SpanRing:
 SPAN_RING = SpanRing()
 
 
-def _parent_stack() -> List[int]:
+def _parent_stack() -> List[Tuple[str, str]]:
+    """Per-thread stack of (span_id, trace_id) for the open spans."""
     stack = getattr(_tls, "stack", None)
     if stack is None:
         stack = _tls.stack = []
@@ -342,6 +470,8 @@ class _NoopSpan:
     """Shared do-nothing span for the disabled path (zero allocation)."""
 
     __slots__ = ()
+
+    traceparent: Optional[str] = None
 
     def __enter__(self) -> "_NoopSpan":
         return self
@@ -357,13 +487,15 @@ NOOP_SPAN = _NoopSpan()
 
 
 class Span:
-    __slots__ = ("name", "attrs", "span_id", "parent_id", "_t0", "_wall")
+    __slots__ = ("name", "attrs", "span_id", "parent_id", "trace_id",
+                 "_t0", "_wall")
 
     def __init__(self, name: str, attrs: Dict[str, Any]):
         self.name = name
         self.attrs = attrs
-        self.span_id = next(_span_ids)
-        self.parent_id: Optional[int] = None
+        self.span_id = _new_span_id()
+        self.parent_id: Optional[str] = None
+        self.trace_id: Optional[str] = None
         self._t0 = 0.0
         self._wall = 0.0
 
@@ -371,11 +503,17 @@ class Span:
         self.attrs.update(attrs)
         return self
 
+    @property
+    def traceparent(self) -> Optional[str]:
+        """Handoff string for children of this span (valid once
+        entered): exported as TIK_TRACEPARENT by the executors."""
+        if self.trace_id is None:
+            return None
+        return format_traceparent(self.trace_id, self.span_id)
+
     def __enter__(self) -> "Span":
-        stack = _parent_stack()
-        if stack:
-            self.parent_id = stack[-1]
-        stack.append(self.span_id)
+        self.trace_id, self.parent_id = _resolve_context()
+        _parent_stack().append((self.span_id, self.trace_id))
         self._wall = time.time()
         self._t0 = time.perf_counter()
         return self
@@ -383,7 +521,7 @@ class Span:
     def __exit__(self, exc_type, exc, tb) -> bool:
         duration = time.perf_counter() - self._t0
         stack = _parent_stack()
-        if stack and stack[-1] == self.span_id:
+        if stack and stack[-1][0] == self.span_id:
             stack.pop()
         if exc_type is not None:
             self.attrs.setdefault("error", exc_type.__name__)
@@ -393,6 +531,7 @@ class Span:
             "dur": duration,
             "id": self.span_id,
             "parent": self.parent_id,
+            "trace": self.trace_id,
             "tid": threading.get_ident(),
             "attrs": self.attrs,
         })
@@ -419,12 +558,14 @@ def add_span(name: str, start_time: float, duration: float,
     stamped from its lifecycle timestamps)."""
     if not STATE.enabled:
         return
+    trace_id, parent_id = _resolve_context()
     _finish_span({
         "name": name,
         "ts": float(start_time),
         "dur": max(float(duration), 0.0),
-        "id": next(_span_ids),
-        "parent": None,
+        "id": _new_span_id(),
+        "parent": parent_id,
+        "trace": trace_id,
         "tid": threading.get_ident(),
         "attrs": attrs,
     })

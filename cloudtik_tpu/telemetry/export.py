@@ -112,6 +112,10 @@ def chrome_trace(spans: Optional[List[dict]] = None) -> Dict[str, Any]:
         args["span_id"] = record["id"]
         if record.get("parent") is not None:
             args["parent_id"] = record["parent"]
+        if record.get("trace") is not None:
+            # the cross-node join key: the trace collector stitches
+            # every node's export into one timeline by this id
+            args["trace_id"] = record["trace"]
         events.append({
             "name": record["name"],
             "cat": "tik",

@@ -1,6 +1,7 @@
 """The authoritative telemetry name catalog.
 
-Every metric and span name the tree emits is declared HERE, exactly once.
+Every metric, span, and flight-recorder event name the tree emits is
+declared HERE, exactly once.
 `tools/check_telemetry_names.py` (run standalone or as the tier-1 test
 tests/test_telemetry_names.py) enforces that:
 
@@ -158,6 +159,43 @@ for _spec in _ALL:
         raise ValueError(f"duplicate metric name {_spec.name!r}")
     METRICS[_spec.name] = _spec
 del _ALL, _spec
+
+
+# Flight-recorder event catalog (telemetry/events.py): the durable
+# control-plane transitions journaled to the events JSONL.  Same
+# one-declaration law as metrics: every `events.emit("...")` literal in
+# the tree must name an entry here, each entry must be emitted
+# somewhere, and docs/observability.md documents all of them
+# (tools/check_telemetry_names.py enforces it).
+_EVENT_LIST = [
+    ("tik_node_services_start",
+     "a node's service daemons booted (node lifecycle)."),
+    ("tik_node_launch",
+     "the launcher asked the provider to create nodes."),
+    ("tik_node_launch_failed",
+     "a provider node launch raised."),
+    ("tik_node_update",
+     "a node updater finished, by result (node lifecycle)."),
+    ("tik_scaler_decision",
+     "one scale decision with its why (action + reason attrs)."),
+    ("tik_checkpoint_commit",
+     "a checkpoint save committed or failed, by step."),
+    ("tik_serve_admission",
+     "a serve request took a decode slot."),
+    ("tik_serve_cancel",
+     "a serve request was cancelled."),
+    ("tik_fault_fired",
+     "an armed fault plan fired at a seam (chaos drills)."),
+]
+
+EVENTS: Dict[str, str] = {}
+for _name, _help in _EVENT_LIST:
+    if _name in EVENTS:
+        raise ValueError(f"duplicate event name {_name!r}")
+    if _name in METRICS:
+        raise ValueError(f"event name {_name!r} collides with a metric")
+    EVENTS[_name] = _help
+del _EVENT_LIST, _name, _help
 
 
 # Span taxonomy: dotted names mirroring the fault-seam registry

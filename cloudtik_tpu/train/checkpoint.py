@@ -31,6 +31,7 @@ import jax
 from cloudtik_tpu import telemetry
 from cloudtik_tpu.faults import seams
 from cloudtik_tpu.faults.plan import DIRECTIVE_TORN_WRITE
+from cloudtik_tpu.telemetry import events
 from cloudtik_tpu.telemetry import instruments as ti
 
 logger = logging.getLogger(__name__)
@@ -96,10 +97,13 @@ class Checkpointer:
                 )
             except Exception:
                 ti.CHECKPOINT_SAVES.inc(result="failed")
+                events.emit("tik_checkpoint_commit", step=step,
+                            result="failed")
                 raise
         if saved:
             ti.CHECKPOINT_SAVE_SECONDS.observe(time.perf_counter() - t0)
             ti.CHECKPOINT_SAVES.inc(result="ok")
+            events.emit("tik_checkpoint_commit", step=step, result="ok")
         if saved and directive == DIRECTIVE_TORN_WRITE:
             # drill point: let the write land, then tear it — the step
             # LOOKS committed (dir present, listed by latest_step) but

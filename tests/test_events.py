@@ -1,0 +1,200 @@
+"""Flight recorder (telemetry/events.py): rotation at the size cap,
+crash-safety against torn final lines, the disabled-path no-op
+discipline, the CLI surfaces, and cluster-dump inclusion."""
+
+from __future__ import annotations
+
+import json
+import os
+import tarfile
+
+import pytest
+from click.testing import CliRunner
+
+from cloudtik_tpu import telemetry
+from cloudtik_tpu.faults import seams
+from cloudtik_tpu.faults.plan import FaultPlan, FaultPoint
+from cloudtik_tpu.scripts.cli import cli
+from cloudtik_tpu.telemetry import events
+
+
+@pytest.fixture(autouse=True)
+def _clean_events():
+    telemetry.enable()
+    telemetry.reset()
+    yield
+    telemetry.enable()
+    telemetry.reset()
+    events.uninstall()
+
+
+class TestJournal:
+    def test_append_read_roundtrip(self, tmp_path):
+        journal = events.install(str(tmp_path / "events.jsonl"))
+        events.emit("tik_scaler_decision", action="launch",
+                    reason="demand", node_type="w", count=2)
+        events.emit("tik_node_launch", node_type="w", count=2)
+        records = events.read_events()
+        assert [r["name"] for r in records] == \
+            ["tik_scaler_decision", "tik_node_launch"]
+        assert records[0]["reason"] == "demand"
+        assert records[0]["seq"] == 1 and records[1]["seq"] == 2
+        assert records[0]["ts"] <= records[1]["ts"]
+        assert journal.files() == [str(tmp_path / "events.jsonl")]
+
+    def test_traceparent_stamped_from_active_span(self, tmp_path):
+        events.install(str(tmp_path / "events.jsonl"))
+        with telemetry.span("scaler.reconcile") as op:
+            events.emit("tik_scaler_decision", action="recover",
+                        reason="heartbeat_timeout")
+        record = events.read_events()[0]
+        assert record["traceparent"] == \
+            telemetry.format_traceparent(op.trace_id, op.span_id)
+        # outside any context: no stamp, not a crash
+        events.emit("tik_node_launch", node_type="w", count=1)
+        assert "traceparent" not in events.read_events()[-1]
+
+    def test_rotation_keeps_newest_events_bounded(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        events.install(path, max_bytes=2048)
+        for i in range(200):
+            events.emit("tik_scaler_decision", action="launch",
+                        reason="demand", i=i)
+        files = events.journal_files(path)
+        assert files == [path + ".1", path]
+        # bounded: no file grows past the cap by more than one record
+        assert os.path.getsize(path) <= 2048 + 256
+        assert os.path.getsize(path + ".1") <= 2048 + 256
+        records = events.read_events(path)
+        assert records[-1]["i"] == 199          # newest never lost
+        assert records[0]["i"] > 0              # oldest aged out
+
+    def test_torn_final_line_skipped_not_fatal(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        events.install(path)
+        events.emit("tik_serve_admission", request=1, slot=0)
+        plan = FaultPlan([FaultPoint("events.append", "torn_write",
+                                     times=1)])
+        with seams.armed(plan):
+            events.emit("tik_serve_admission", request=2, slot=0)
+        assert plan.trace and plan.trace[0]["kind"] == "torn_write"
+        # the torn record is dropped, the good one survives
+        recs, skipped = events.read_file(path)
+        assert [r["request"] for r in recs] == [1]
+        assert skipped == 1
+        # appends AFTER the torn line stay readable (terminated tail)
+        events.emit("tik_serve_admission", request=3, slot=0)
+        recs, skipped = events.read_file(path)
+        assert [r["request"] for r in recs] == [1, 3]
+        assert skipped == 1
+
+    def test_read_missing_journal_is_empty(self, tmp_path):
+        assert events.read_events(str(tmp_path / "nope.jsonl")) == []
+        recs, skipped = events.read_file(str(tmp_path / "nope.jsonl"))
+        assert recs == [] and skipped == 0
+
+
+class TestEmitGate:
+    def test_emit_without_journal_is_noop(self, tmp_path,
+                                          monkeypatch):
+        monkeypatch.setenv("TIK_HOME", str(tmp_path))
+        assert events.installed() is None
+        events.emit("tik_scaler_decision", action="launch",
+                    reason="demand")
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), "logs", "events.jsonl"))
+
+    def test_disabled_telemetry_never_reaches_the_journal(
+            self, tmp_path, monkeypatch):
+        """TIK_TELEMETRY=off: the journal path is a tripwire and every
+        emitting surface stays silent."""
+        events.install(str(tmp_path / "events.jsonl"))
+
+        def boom(*a, **k):
+            raise AssertionError("journal reached while disabled")
+
+        monkeypatch.setattr(events.EventJournal, "append", boom)
+        telemetry.disable()
+        events.emit("tik_node_launch", node_type="w", count=1)
+        from cloudtik_tpu.control.scaler import ClusterScaler
+        scaler = ClusterScaler.__new__(ClusterScaler)
+        scaler._decide("terminate", "idle_timeout", node_id="w-1")
+        from cloudtik_tpu.serve.engine import (
+            Request, RequestCancelled)
+        request = Request([1, 2])
+        assert request.cancel() is True
+        with pytest.raises(RequestCancelled):
+            request.wait(timeout=1)
+
+    def test_full_disk_degrades_without_raising(self, tmp_path,
+                                                monkeypatch):
+        events.install(str(tmp_path / "events.jsonl"))
+
+        def full(*a, **k):
+            raise OSError("no space left on device")
+
+        monkeypatch.setattr(events.EventJournal, "append", full)
+        events.emit("tik_node_launch", node_type="w", count=1)  # no raise
+
+
+class TestEventsCLI:
+    def test_dump_orders_and_filters_by_trace(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        events.install(path)
+        with telemetry.span("scaler.reconcile") as op:
+            events.emit("tik_scaler_decision", action="launch",
+                        reason="demand")
+        events.emit("tik_node_launch", node_type="w", count=1)
+        result = CliRunner().invoke(cli, ["events", "dump",
+                                          "--path", path])
+        assert result.exit_code == 0, result.output
+        lines = result.output.strip().splitlines()
+        assert "tik_scaler_decision" in lines[0]
+        assert "tik_node_launch" in lines[1]
+        result = CliRunner().invoke(cli, [
+            "events", "dump", "--path", path, "--json",
+            "--trace-id", op.trace_id])
+        assert result.exit_code == 0, result.output
+        records = json.loads(result.output)
+        assert [r["name"] for r in records] == ["tik_scaler_decision"]
+
+    def test_tail_shows_newest(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        events.install(path)
+        for i in range(5):
+            events.emit("tik_node_launch", node_type="w", count=i)
+        result = CliRunner().invoke(cli, ["events", "tail",
+                                          "--path", path, "-n", "2"])
+        assert result.exit_code == 0, result.output
+        lines = result.output.strip().splitlines()
+        assert len(lines) == 2
+        assert "count=3" in lines[0] and "count=4" in lines[1]
+
+
+class TestClusterDumpIncludesJournal:
+    def test_collect_local_and_archive_carry_the_journal(
+            self, tmp_path, monkeypatch):
+        from cloudtik_tpu.control.cluster_dump import (
+            collect_local, create_archive)
+        events.install(str(tmp_path / "journal" / "events.jsonl"),
+                       max_bytes=2048)
+        for i in range(200):   # force a rotated generation too
+            events.emit("tik_scaler_decision", action="launch",
+                        reason="demand", i=i)
+        staging = tmp_path / "staging"
+        created = collect_local(str(staging), log_dirs=[],
+                                conf_paths=[], processes=False)
+        copied = sorted(os.path.basename(p) for p in created)
+        assert copied == ["events.jsonl", "events.jsonl.1"]
+        dumped = events.read_events(
+            os.path.join(str(staging), "events", "events.jsonl"))
+        assert dumped and dumped[-1]["i"] == 199
+
+        archive = create_archive(
+            output_path=str(tmp_path / "dump.tar.gz"),
+            cluster_name="c",
+            collect=lambda s: collect_local(
+                s, log_dirs=[], conf_paths=[], processes=False))
+        with tarfile.open(archive) as tar:
+            names = tar.getnames()
+        assert any(n.endswith("events/events.jsonl") for n in names)

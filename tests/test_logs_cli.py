@@ -128,6 +128,58 @@ class TestLogRetention:
         assert len(keys) == 3                   # window holds
         assert keys[-1] == batch_key("n1", 7)   # newest retained
 
+    def test_restarted_agent_resumes_after_shipped_batches(
+            self, tmp_path):
+        """batch_key sequences are restart-safe: a new agent seeds from
+        the batches already in the head table instead of 0, so it never
+        hands consumers an already-seen sequence number with different
+        content."""
+        from cloudtik_tpu.control.log_agent import (
+            LOG_NS, LogAgent, batch_key)
+        from cloudtik_tpu.control.state import (
+            InMemoryStateBackend, StateClient)
+
+        state = StateClient(InMemoryStateBackend())
+        log_dir = tmp_path / "logs"
+        log_dir.mkdir()
+        f = log_dir / "svc.log"
+        f.write_text("one\ntwo\n")
+        first = LogAgent(state, "n1", {"d": str(log_dir)})
+        first.poll_once()
+        assert batch_key("n1", 0) in state.table_list(LOG_NS)
+
+        # agent restarts (fresh process, no memory of seq)
+        with open(f, "a") as fh:
+            fh.write("three\n")
+        second = LogAgent(state, "n1", {"d": str(log_dir)})
+        second.poll_once()
+        keys = sorted(state.table_list(LOG_NS))
+        assert keys == [batch_key("n1", 0), batch_key("n1", 1)]
+        # the restarted batch holds the WHOLE file again (offsets are
+        # per-process) but under a NEW key — no silent overwrite
+        assert state.table_get(LOG_NS, keys[1])["lines"] == [
+            "one", "two", "three"]
+
+    def test_agent_ships_flight_recorder_journal(self, tmp_path):
+        """*.jsonl journals (telemetry/events.py) ship alongside
+        service logs."""
+        from cloudtik_tpu.control.log_agent import LOG_NS, LogAgent
+        from cloudtik_tpu.control.state import (
+            InMemoryStateBackend, StateClient)
+
+        state = StateClient(InMemoryStateBackend())
+        log_dir = tmp_path / "logs"
+        log_dir.mkdir()
+        (log_dir / "svc.log").write_text("a line\n")
+        (log_dir / "events.jsonl").write_text(
+            '{"ts": 1, "name": "tik_scaler_decision"}\n')
+        agent = LogAgent(state, "n1", {"d": str(log_dir)})
+        agent.poll_once()
+        import os
+        shipped = {os.path.basename(b["file"])
+                   for b in state.table_list(LOG_NS).values()}
+        assert shipped == {"svc.log", "events.jsonl"}
+
     def test_ranged_key_reads(self):
         """The tail path's primitive: keys(after=high-water) returns only
         newer batch keys (round-4 verdict weak #4)."""

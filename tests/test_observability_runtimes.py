@@ -115,6 +115,60 @@ class TestCollector:
         assert result[0]["value"][1] == "12.5"
         assert collector.instant_query("tik_node_cpu") == []
 
+    def test_instant_query_label_matchers(self, collector):
+        labels = {"job": "nodex", "cluster": "c"}
+        collector.state.update("10.0.0.3:9100", labels, NODEX_TEXT, None)
+        collector.state.update("10.0.0.4:9100", labels,
+                               NODEX_TEXT.replace('foo="bar"',
+                                                  'foo="baz"'), None)
+        # sample-label matcher narrows to one series
+        result = collector.instant_query(
+            'tik_node_memory_percent{foo="bar"}')
+        assert len(result) == 1
+        assert result[0]["metric"]["instance"] == "10.0.0.3:9100"
+        assert result[0]["metric"]["foo"] == "bar"
+        # target-label and instance matchers resolve too
+        result = collector.instant_query(
+            'tik_node_cpu_percent{instance="10.0.0.4:9100"}')
+        assert len(result) == 1
+        assert collector.instant_query(
+            'tik_node_cpu_percent{job="nodex"}') and True
+        # a non-matching label value is empty, not an error
+        assert collector.instant_query(
+            'tik_node_memory_percent{foo="nope"}') == []
+        assert collector.instant_query("not a query {") == []
+
+    def test_scrape_duration_per_target(self, collector, tmp_path):
+        """scrape_once records wall time per target — up or down —
+        and render_metrics exposes it as scrape_duration_seconds."""
+        from cloudtik_tpu import telemetry
+        from cloudtik_tpu.telemetry import http as telemetry_http
+        telemetry.enable()
+        server = telemetry_http.start_server(0, host="127.0.0.1")
+        try:
+            with open(os.path.join(str(tmp_path), "targets.json"),
+                      "w") as f:
+                json.dump([
+                    {"targets": [f"127.0.0.1:{server.port}"],
+                     "labels": {"job": "telemetry"}},
+                    {"targets": ["127.0.0.1:1"],      # refused: down
+                     "labels": {"job": "nodex"}},
+                ], f)
+            collector.scrape_once()
+            snapshot = collector.state.snapshot()
+            assert snapshot[f"127.0.0.1:{server.port}"][
+                "scrape_duration_s"] > 0
+            assert snapshot["127.0.0.1:1"]["scrape_duration_s"] > 0
+            text = collector.render_metrics()
+            assert "# TYPE scrape_duration_seconds gauge" in text
+            assert ('scrape_duration_seconds{instance='
+                    f'"127.0.0.1:{server.port}"') in text
+            assert 'scrape_duration_seconds{instance="127.0.0.1:1"' \
+                in text
+        finally:
+            server.stop()
+            telemetry.reset()
+
     def test_collector_scrapes_telemetry_server(self, tmp_path):
         """End to end: the built-in collector scrapes a live telemetry
         endpoint and re-exposes its series."""

@@ -1,0 +1,338 @@
+"""Distributed tracing: traceparent propagation + cross-node stitching.
+
+The chaos-drill-style acceptance path: a head-side operation fans into a
+child process through the LOCAL executor's TIK_TRACEPARENT export, the
+child adopts the parent, the head-side trace collector scrapes both
+processes' /trace endpoints (loopback only), and `tik cluster trace
+export` yields ONE stitched Chrome-trace with two process lanes sharing
+one trace_id — while `tik events dump` replays the journaled decisions
+stamped with the same trace.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+import pytest
+from click.testing import CliRunner
+
+from cloudtik_tpu import telemetry
+from cloudtik_tpu.control.executor.local import LocalCommandExecutor
+from cloudtik_tpu.scripts.cli import cli
+from cloudtik_tpu.telemetry import events
+from cloudtik_tpu.telemetry import http as telemetry_http
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    telemetry.enable()
+    telemetry.reset()
+    telemetry.clear_adopted_traceparent()
+    yield
+    telemetry.enable()
+    telemetry.reset()
+    telemetry.clear_adopted_traceparent()
+    events.uninstall()
+
+
+class _RecordingRunner:
+    def __init__(self):
+        self.calls = []
+
+    def check_output(self, cmd, **kwargs):
+        self.calls.append(cmd)
+        return b""
+
+    def check_call(self, cmd, **kwargs):
+        self.calls.append(cmd)
+
+
+class TestTraceContext:
+    def test_traceparent_parse_format_roundtrip(self):
+        tp = telemetry.format_traceparent("ab" * 16, "cd" * 8)
+        assert tp == f"00-{'ab' * 16}-{'cd' * 8}-01"
+        assert telemetry.parse_traceparent(tp) == ("ab" * 16, "cd" * 8)
+        assert telemetry.parse_traceparent("garbage") is None
+        assert telemetry.parse_traceparent(None) is None
+        assert telemetry.parse_traceparent("00-short-beef-01") is None
+
+    def test_nested_spans_share_trace_roots_do_not(self):
+        with telemetry.span("scaler.reconcile") as outer:
+            with telemetry.span("executor.run", node_id="n1") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_id == outer.span_id
+        with telemetry.span("scaler.reconcile") as other:
+            pass
+        assert other.trace_id != outer.trace_id
+        records = telemetry.spans()
+        assert all(r["trace"] for r in records)
+
+    def test_trace_context_joins_remote_parent(self):
+        tp = telemetry.format_traceparent("12" * 16, "34" * 8)
+        with telemetry.trace_context(tp):
+            with telemetry.span("updater.setup") as span:
+                assert span.trace_id == "12" * 16
+                assert span.parent_id == "34" * 8
+        # context restored: a later root span mints its own trace
+        with telemetry.span("updater.setup") as after:
+            assert after.trace_id != "12" * 16
+
+    def test_trace_context_without_parent_mints_one_trace(self):
+        with telemetry.trace_context():
+            with telemetry.span("serve.enqueue", request=1) as a:
+                pass
+            with telemetry.span("serve.prefill", request=1) as b:
+                pass
+        assert a.trace_id == b.trace_id
+        assert a.parent_id is None and b.parent_id is None
+
+    def test_add_span_joins_ambient_trace(self):
+        tp = telemetry.format_traceparent("56" * 16, "78" * 8)
+        with telemetry.trace_context(tp):
+            telemetry.add_span("serve.decode", time.time(), 0.01,
+                               request=9)
+        record = telemetry.spans()[-1]
+        assert record["trace"] == "56" * 16
+        assert record["parent"] == "78" * 8
+
+    def test_process_adoption_from_env(self, monkeypatch):
+        tp = telemetry.format_traceparent("ef" * 16, "01" * 8)
+        monkeypatch.setenv(telemetry.TRACEPARENT_ENV, tp)
+        assert telemetry.adopt_traceparent_from_env() is True
+        with telemetry.span("executor.run") as span:
+            assert span.trace_id == "ef" * 16
+            assert span.parent_id == "01" * 8
+
+    def test_adoption_rejects_garbage(self, monkeypatch):
+        monkeypatch.setenv(telemetry.TRACEPARENT_ENV, "not-a-parent")
+        assert telemetry.adopt_traceparent_from_env() is False
+        with telemetry.span("executor.run") as span:
+            assert span.parent_id is None
+
+    def test_chrome_trace_carries_trace_id(self):
+        with telemetry.span("scaler.reconcile") as op:
+            pass
+        events_json = telemetry.chrome_trace()["traceEvents"]
+        assert events_json[-1]["args"]["trace_id"] == op.trace_id
+
+
+class TestExecutorPropagation:
+    def test_local_executor_exports_traceparent(self):
+        runner = _RecordingRunner()
+        executor = LocalCommandExecutor(process_runner=runner,
+                                        node_id="w-1")
+        with telemetry.span("scaler.reconcile") as op:
+            executor.run("echo hi", with_output=True)
+        cmd = runner.calls[0]
+        assert "export TIK_TRACEPARENT=" in cmd
+        exported = cmd.split("TIK_TRACEPARENT=")[1].split(";")[0]
+        trace_id, span_id = telemetry.parse_traceparent(
+            exported.strip("'\""))
+        # the exported parent is the executor.run span of THIS trace
+        assert trace_id == op.trace_id
+        assert span_id != op.span_id
+
+    def test_ssh_executor_exports_traceparent(self):
+        from cloudtik_tpu.control.executor.ssh import SSHCommandExecutor
+        runner = _RecordingRunner()
+        executor = SSHCommandExecutor(
+            node_id="w-2", ssh_ip="10.0.0.9", process_runner=runner)
+        with telemetry.span("updater.setup", node_id="w-2"):
+            executor.run("uptime", with_output=True)
+        blob = " ".join(runner.calls[0])
+        assert "TIK_TRACEPARENT=" in blob
+
+    def test_caller_env_wins_over_propagation(self):
+        runner = _RecordingRunner()
+        executor = LocalCommandExecutor(process_runner=runner,
+                                        node_id="w-1")
+        with telemetry.span("scaler.reconcile"):
+            executor.run("echo hi", with_output=True,
+                         environment_variables={
+                             "TIK_TRACEPARENT": "explicit"})
+        assert "TIK_TRACEPARENT=explicit" in runner.calls[0]
+
+    def test_disabled_path_exports_nothing(self):
+        telemetry.disable()
+        runner = _RecordingRunner()
+        executor = LocalCommandExecutor(process_runner=runner,
+                                        node_id="w-1")
+        executor.run("echo hi", with_output=True)
+        assert runner.calls[0] == "echo hi"
+        assert telemetry.current_traceparent() is None
+        with telemetry.trace_context("00-" + "ab" * 16 + "-"
+                                     + "cd" * 8 + "-01"):
+            assert telemetry.current_traceparent() is None
+
+
+_CHILD_SCRIPT = """\
+import os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from cloudtik_tpu import telemetry
+from cloudtik_tpu.telemetry import http as telemetry_http
+telemetry.enable()
+adopted = telemetry.adopt_traceparent_from_env()
+with telemetry.span("updater.setup", node_id="w-1", adopted=adopted):
+    time.sleep(0.01)
+server = telemetry_http.start_server(0, host="127.0.0.1")
+with open(sys.argv[1] + ".tmp", "w") as f:
+    f.write("%d %d" % (server.port, os.getpid()))
+os.rename(sys.argv[1] + ".tmp", sys.argv[1])
+time.sleep(120)
+"""
+
+
+class TestClusterTraceDrill:
+    """The acceptance drill: one head-side operation, a real child
+    process spawned through the local executor, two scraped /trace
+    endpoints, one stitched trace."""
+
+    def test_stitched_export_spans_two_process_lanes(self, tmp_path):
+        import cloudtik_tpu
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(cloudtik_tpu.__file__)))
+        script = tmp_path / "child.py"
+        script.write_text(_CHILD_SCRIPT.format(repo=repo))
+        info = tmp_path / "child.info"
+        child_log = tmp_path / "child.log"
+        journal = tmp_path / "events.jsonl"
+        events.install(str(journal))
+
+        executor = LocalCommandExecutor(node_id="w-1")
+        with telemetry.span("scaler.reconcile") as op:
+            head_trace = op.trace_id
+            events.emit("tik_scaler_decision", action="launch",
+                        reason="demand", node_type="worker", count=1)
+            executor.run(
+                f"nohup {sys.executable} {script} {info} "
+                f"> {child_log} 2>&1 &")
+
+        deadline = time.time() + 90
+        while not info.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        assert info.exists(), (
+            "child process never came up: "
+            + (child_log.read_text()
+               if child_log.exists() else "no log"))
+        child_port, child_pid = map(int, info.read_text().split())
+
+        head_server = telemetry_http.start_server(0, host="127.0.0.1")
+        try:
+            with open(tmp_path / "targets.json", "w") as f:
+                json.dump([
+                    {"targets": [f"127.0.0.1:{head_server.port}"],
+                     "labels": {"job": "telemetry", "node": "head"}},
+                    {"targets": [f"127.0.0.1:{child_port}"],
+                     "labels": {"job": "nodex", "node": "w-1"}},
+                    # a non-telemetry job must be ignored, not scraped
+                    {"targets": ["127.0.0.1:1"],
+                     "labels": {"job": "haproxy"}},
+                ], f)
+
+            out_file = tmp_path / "stitched.json"
+            result = CliRunner().invoke(cli, [
+                "cluster", "trace", "export",
+                "--conf-dir", str(tmp_path), "-o", str(out_file)])
+            assert result.exit_code == 0, result.output
+            with open(out_file) as f:
+                trace = json.load(f)
+
+            sharing = [e for e in trace["traceEvents"]
+                       if e.get("ph") == "X"
+                       and (e.get("args") or {}).get("trace_id")
+                       == head_trace]
+            lanes = {e["pid"] for e in sharing}
+            names = {e["name"] for e in sharing}
+            assert len(lanes) >= 2, (
+                f"one trace must span both processes; got lanes "
+                f"{lanes} names {names}")
+            assert {"scaler.reconcile", "executor.run",
+                    "updater.setup"} <= names
+            lane_names = {e["args"]["name"]
+                          for e in trace["traceEvents"]
+                          if e.get("ph") == "M"}
+            assert any("head" in n for n in lane_names)
+            assert any("w-1" in n for n in lane_names)
+
+            # summary lists the trace as crossing both nodes
+            result = CliRunner().invoke(cli, [
+                "cluster", "trace", "summary",
+                "--conf-dir", str(tmp_path)])
+            assert result.exit_code == 0, result.output
+            row = [line for line in result.output.splitlines()
+                   if head_trace in line]
+            assert row and "scaler.reconcile" in row[0]
+
+            # the flight recorder replays the decision behind the op,
+            # stamped with the SAME trace
+            result = CliRunner().invoke(cli, [
+                "events", "dump", "--path", str(journal),
+                "--trace-id", head_trace, "--json"])
+            assert result.exit_code == 0, result.output
+            records = json.loads(result.output)
+            assert [r["name"] for r in records] == \
+                ["tik_scaler_decision"]
+            assert records[0]["reason"] == "demand"
+            assert head_trace in records[0]["traceparent"]
+
+            # filtered export keeps only the one trace
+            result = CliRunner().invoke(cli, [
+                "cluster", "trace", "export",
+                "--conf-dir", str(tmp_path),
+                "--trace-id", head_trace])
+            assert result.exit_code == 0, result.output
+            filtered = json.loads(result.output)
+            assert all(
+                (e.get("args") or {}).get("trace_id") == head_trace
+                for e in filtered["traceEvents"]
+                if e.get("ph") == "X")
+        finally:
+            head_server.stop()
+            try:
+                os.kill(child_pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+
+
+class TestServedRequestTrace:
+    """The serve half of the drill: one HTTP-less engine request is one
+    trace — enqueue, prefill, and the decode window share a trace_id —
+    and its admission is journaled with the same trace."""
+
+    def test_request_spans_and_admission_share_one_trace(self, tmp_path):
+        import jax
+
+        from cloudtik_tpu.models import transformer as T
+        from cloudtik_tpu.serve.engine import (
+            DecodeEngine, EngineConfig, Request)
+        events.install(str(tmp_path / "events.jsonl"))
+        cfg = T.config("tiny", dtype=jax.numpy.float32,
+                       attention_impl="reference", remat=False)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        engine = DecodeEngine(
+            params, cfg,
+            EngineConfig(slots=1, max_len=32, prefill_buckets=(8,)))
+        engine.start()
+        try:
+            request = engine.submit(Request([3, 1, 4], max_new_tokens=4))
+            tokens = request.wait(timeout=300)
+            assert len(tokens) == 4
+            trace_id, _ = telemetry.parse_traceparent(
+                request.traceparent)
+            by_name = {r["name"]: r for r in telemetry.spans()
+                       if r["attrs"].get("request")
+                       == request.request_id}
+            assert {"serve.enqueue", "serve.prefill",
+                    "serve.decode"} <= set(by_name)
+            assert {r["trace"] for r in by_name.values()} == {trace_id}
+            admissions = [r for r in events.read_events()
+                          if r["name"] == "tik_serve_admission"]
+            assert admissions and trace_id in admissions[0][
+                "traceparent"]
+        finally:
+            engine.stop()

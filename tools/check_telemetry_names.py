@@ -17,7 +17,12 @@ cloudtik_tpu/telemetry/names.py:
   5. the grafana dashboards reference only resolvable metric names
      (histogram _bucket/_sum/_count suffixes resolve to their base);
   6. docs/observability.md's metric catalog covers every cataloged
-     metric, every declared span, and references nothing unknown.
+     metric, every declared span, and references nothing unknown;
+  7. the flight-recorder event catalog (EVENTS) obeys the same law:
+     every name matches ``tik_[a-z0-9_]+`` and collides with no metric,
+     is declared exactly once, every ``events.emit("...")`` literal in
+     the source is cataloged, every cataloged event is emitted
+     somewhere, and docs/observability.md documents all of them.
 
 Run: ``python tools/check_telemetry_names.py`` (exit 1 on failure).
 """
@@ -61,7 +66,7 @@ def _resolves(token: str, known) -> bool:
 def run_checks() -> List[str]:
     from cloudtik_tpu.telemetry import instruments  # noqa: F401  (build)
     from cloudtik_tpu.telemetry.core import REGISTRY
-    from cloudtik_tpu.telemetry.names import METRICS, SPANS
+    from cloudtik_tpu.telemetry.names import EVENTS, METRICS, SPANS
 
     errors: List[str] = []
 
@@ -72,6 +77,11 @@ def run_checks() -> List[str]:
     for name in SPANS:
         if not re.match(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$", name):
             errors.append(f"span {name!r} is not a dotted lowercase name")
+    for name in EVENTS:
+        if not METRIC_NAME_RE.match(name):
+            errors.append(f"event {name!r} does not match tik_[a-z0-9_]+")
+        if name in METRICS:
+            errors.append(f"event {name!r} collides with a metric name")
 
     # 2. registry <-> catalog
     registered = {i.name for i in REGISTRY.instruments()}
@@ -146,7 +156,33 @@ def run_checks() -> List[str]:
             errors.append(f"declared span {name!r} is never fired in "
                           "cloudtik_tpu source")
 
-    # 5. grafana dashboards + prometheus alert rules resolve
+    # 7. flight-recorder events: declared once, every emit literal
+    # cataloged, every cataloged event emitted somewhere
+    emit_re = re.compile(
+        r"events\.emit\(\s*\n?\s*\"(tik_[a-z0-9_]+)\"")
+    used_events = set()
+    for path, text in sources.items():
+        if path.endswith(os.path.join("telemetry", "names.py")):
+            continue
+        for m in emit_re.finditer(text):
+            used_events.add(m.group(1))
+            if m.group(1) not in EVENTS:
+                errors.append(f"{os.path.relpath(path, REPO_ROOT)}: "
+                              f"event {m.group(1)!r} not declared in "
+                              "telemetry/names.py")
+    for name in sorted(EVENTS):
+        declared = _hits(name, lambda p: p.endswith(
+            os.path.join(telemetry_dir, "names.py")))
+        if declared != 1:
+            errors.append(f"event {name!r} declared {declared}x in "
+                          "telemetry/names.py (must be exactly once)")
+        if name not in used_events:
+            errors.append(f"declared event {name!r} is never emitted "
+                          "in cloudtik_tpu source")
+
+    # 5. grafana dashboards + prometheus alert rules resolve — against
+    # METRICS only: an event is a journal record, never a Prometheus
+    # series, so a panel/alert naming one would render "no data"
     from cloudtik_tpu.runtimes.grafana.dashboards import (
         ai_workload_dashboard, cluster_overview_dashboard)
     from cloudtik_tpu.runtimes.prometheus.alerts import default_rules
@@ -176,8 +212,14 @@ def run_checks() -> List[str]:
             if name not in doc:
                 errors.append(
                     f"docs/observability.md does not document span {name}")
+        for name in sorted(EVENTS):
+            if name not in doc:
+                errors.append(
+                    f"docs/observability.md does not document event "
+                    f"{name}")
+        # docs may name both metrics and flight-recorder events
         for token in set(METRIC_TOKEN_RE.findall(doc)):
-            if not _resolves(token, known):
+            if not _resolves(token, known | set(EVENTS)):
                 errors.append("docs/observability.md references unknown "
                               f"metric {token!r}")
     return errors
@@ -190,9 +232,10 @@ def main() -> int:
             print(f"FAIL: {error}")
         print(f"{len(errors)} telemetry-name problem(s).")
         return 1
-    from cloudtik_tpu.telemetry.names import METRICS, SPANS
-    print(f"OK: {len(METRICS)} metrics, {len(SPANS)} spans — catalog, "
-          "registry, source, dashboards, and docs all agree.")
+    from cloudtik_tpu.telemetry.names import EVENTS, METRICS, SPANS
+    print(f"OK: {len(METRICS)} metrics, {len(SPANS)} spans, "
+          f"{len(EVENTS)} events — catalog, registry, source, "
+          "dashboards, and docs all agree.")
     return 0
 
 
