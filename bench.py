@@ -19,11 +19,129 @@ HBM entirely.
 from __future__ import annotations
 
 import json
+import os
+import signal
+import subprocess
 import sys
 import time
 import traceback
 
 METRIC = "llama1b_train_mfu_bf16_seq2048"
+
+# The probe child reports STRUCTURED progress: one PROBE:{json} line per
+# phase, so a failure names the phase it died in (import vs device init)
+# instead of an opaque timeout (the BENCH_r05 failure mode).
+_PROBE_SRC = r"""
+import importlib.util, json, os, sys
+def report(info):
+    print("PROBE:" + json.dumps(info), flush=True)
+info = {"phase": "start",
+        "jax_platforms": os.environ.get("JAX_PLATFORMS"),
+        "pjrt_device": os.environ.get("PJRT_DEVICE"),
+        "libtpu_present": bool(importlib.util.find_spec("libtpu")
+                               or importlib.util.find_spec(
+                                   "jax_plugins"))}
+report(info)
+try:
+    # report each phase BEFORE entering it: a hang inside the phase
+    # (wedged libtpu during import, dead relay during device init)
+    # must leave that phase's name as the last line on stdout
+    info["phase"] = "import"
+    report(info)
+    import jax
+    info["jax_version"] = jax.__version__
+    info["phase"] = "device_init"
+    report(info)
+    devices = jax.devices()
+    info["phase"] = "done"
+    info["devices"] = [str(d) for d in devices]
+    report(info)
+except Exception as e:
+    info["error"] = f"{type(e).__name__}: {e}"
+    report(info)
+    sys.exit(3)
+"""
+
+
+def probe_devices_once(probe_s: float, probe_cmd=None):
+    """One bounded device probe in a killable subprocess.
+
+    Returns (ok, diagnostics): diagnostics always carries the last
+    phase the child reached, JAX_PLATFORMS, libtpu presence, and the
+    devices or the import/init exception.  The child runs in its own
+    process GROUP and on timeout the whole group is SIGKILLed, so a
+    wedged libtpu grab cannot leak a zombie holding the chip into the
+    next attempt.
+    """
+    cmd = probe_cmd or [sys.executable, "-c", _PROBE_SRC]
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, start_new_session=True)
+    timed_out = False
+    try:
+        stdout, stderr = proc.communicate(timeout=probe_s)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        stdout, stderr = proc.communicate()
+    diagnostics = {
+        "phase": "spawn",
+        "jax_platforms": os.environ.get("JAX_PLATFORMS"),
+        "timed_out": timed_out,
+        "returncode": None if timed_out else proc.returncode,
+    }
+    for line in (stdout or "").splitlines():
+        if line.startswith("PROBE:"):
+            try:
+                diagnostics.update(json.loads(line[len("PROBE:"):]))
+            except ValueError:
+                pass
+    if timed_out:
+        diagnostics["error"] = (
+            f"probe timed out after {probe_s:.0f}s in phase "
+            f"{diagnostics['phase']!r} (process group killed)")
+    elif proc.returncode != 0 and "error" not in diagnostics:
+        diagnostics["error"] = (
+            f"probe exited {proc.returncode}: {(stderr or '')[-400:]}")
+    ok = not timed_out and proc.returncode == 0 \
+        and diagnostics.get("phase") == "done"
+    return ok, diagnostics
+
+
+def run_device_probe(probe_s: float, budget_s: float,
+                     retry_wait_s: float, probe_cmd=None):
+    """Retrying probe over a budget; returns the success diagnostics or
+    raises DeviceProbeError carrying the last attempt's diagnostics."""
+    deadline = time.monotonic() + budget_s
+    attempt = 0
+    diagnostics = {"error": "no probe attempted"}
+    while True:
+        attempt += 1
+        ok, diagnostics = probe_devices_once(probe_s, probe_cmd)
+        diagnostics["attempts"] = attempt
+        if ok:
+            print(f"# devices (attempt {attempt}): "
+                  f"{diagnostics.get('devices')}", file=sys.stderr)
+            return diagnostics
+        remaining = deadline - time.monotonic()
+        print(f"# probe attempt {attempt} failed "
+              f"({diagnostics.get('error')}); {remaining:.0f}s of "
+              "probe budget left", file=sys.stderr)
+        if remaining < retry_wait_s + probe_s:
+            raise DeviceProbeError(
+                f"device probe failed after {attempt} attempts over "
+                f"{budget_s:.0f}s budget: {diagnostics.get('error')}",
+                diagnostics)
+        time.sleep(retry_wait_s)
+
+
+class DeviceProbeError(RuntimeError):
+    def __init__(self, message: str, diagnostics: dict):
+        super().__init__(message)
+        self.diagnostics = diagnostics
 
 
 def run_bench(model: str = "tpu_1b", seq_len: int = 2048,
@@ -102,9 +220,6 @@ def main():
     # Watchdog: a wedged device grant (the axon tunnel can stick for a
     # while after a killed TPU process) would otherwise hang forever with
     # no JSON line at all; better to emit the failure record.
-    import os
-    import signal
-
     def _alarm(_sig, _frame):
         raise TimeoutError("bench watchdog expired (device grant wedged?)")
 
@@ -119,48 +234,26 @@ def main():
         # RETRY on a schedule across a probe budget, so a relay that
         # comes back mid-window still produces a measurement instead of
         # one 300 s attempt consuming the whole window.
-        import subprocess
         probe_s = float(os.environ.get("TIK_BENCH_PROBE_TIMEOUT_S", "60"))
         budget_s = float(os.environ.get("TIK_BENCH_PROBE_BUDGET_S", "900"))
         retry_wait_s = float(
             os.environ.get("TIK_BENCH_PROBE_RETRY_WAIT_S", "45"))
-        deadline = time.monotonic() + budget_s
-        attempt = 0
-        last_probe_err = "no probe attempted"
-        while True:
-            attempt += 1
-            try:
-                probe = subprocess.run(
-                    [sys.executable, "-c",
-                     "import jax; print(jax.devices())"],
-                    capture_output=True, text=True, timeout=probe_s)
-            except subprocess.TimeoutExpired:
-                last_probe_err = f"probe timed out after {probe_s:.0f}s"
-                probe = None
-            if probe is not None and probe.returncode == 0:
-                print(f"# devices (attempt {attempt}): "
-                      f"{probe.stdout.strip().splitlines()[-1]}",
-                      file=sys.stderr)
-                break
-            if probe is not None:
-                last_probe_err = f"probe exited {probe.returncode}: " \
-                                 f"{probe.stderr[-400:]}"
-            remaining = deadline - time.monotonic()
-            print(f"# probe attempt {attempt} failed ({last_probe_err}); "
-                  f"{remaining:.0f}s of probe budget left", file=sys.stderr)
-            if remaining < retry_wait_s + probe_s:
-                raise RuntimeError(
-                    f"device probe failed after {attempt} attempts over "
-                    f"{budget_s:.0f}s budget: {last_probe_err}")
-            time.sleep(retry_wait_s)
+        run_device_probe(probe_s, budget_s, retry_wait_s)
         signal.alarm(int(os.environ.get("TIK_BENCH_TIMEOUT_S", "2700")))
         result = run_bench()
         signal.alarm(0)
-    except Exception:
+    except Exception as e:
         traceback.print_exc()
-        print(json.dumps({
+        record = {
             "metric": METRIC, "value": 0.0, "unit": "% MFU",
-            "vs_baseline": 0.0, "error": "bench failed; see stderr"}))
+            "vs_baseline": 0.0, "error": "bench failed; see stderr"}
+        # probe failures carry the actionable story (phase reached,
+        # JAX_PLATFORMS, libtpu presence, init exception) in-band, so
+        # the trajectory JSON alone diagnoses a BENCH_r05-style miss
+        if isinstance(e, DeviceProbeError):
+            record["error"] = str(e)
+            record["diagnostics"] = e.diagnostics
+        print(json.dumps(record))
         return 0
     mfu_pct = result["mfu"] * 100
     print(json.dumps({

@@ -50,6 +50,15 @@ def main() -> int:
         lambda: telemetry.trace_context(None).__enter__().__exit__(
             None, None, None), n)
     disabled_traceparent_ns = _ns(telemetry.current_traceparent, n)
+    # goodput ledger + step profiler compiled in must not move the
+    # disabled numbers either: attribution and step segmentation are
+    # attribute checks when off
+    from cloudtik_tpu.telemetry import goodput, stepprof
+    disabled_goodput_attr_ns = _ns(
+        lambda: goodput.LEDGER.attribute("step_compute", 0.01), n)
+    _prof = stepprof.StepProfiler(goodput.LEDGER)
+    disabled_step_record_ns = _ns(
+        lambda: _prof.record_step(1, 0.001, 0.001, 0.01), n)
 
     telemetry.enable()
     telemetry.reset()
@@ -63,6 +72,10 @@ def main() -> int:
                              n)
     enabled_observe_ns = _ns(
         lambda: ti.EXECUTOR_RUN_SECONDS.observe(0.01), n)
+    enabled_goodput_attr_ns = _ns(
+        lambda: goodput.LEDGER.attribute("step_compute", 0.01), n // 2)
+    enabled_step_record_ns = _ns(
+        lambda: _prof.record_step(1, 0.001, 0.001, 0.01), n // 10)
     telemetry.reset()
 
     result = {
@@ -82,10 +95,18 @@ def main() -> int:
                 round(disabled_trace_context_ns, 1),
             "disabled_current_traceparent_ns":
                 round(disabled_traceparent_ns, 1),
+            "disabled_goodput_attribute_ns":
+                round(disabled_goodput_attr_ns, 1),
+            "disabled_step_record_ns":
+                round(disabled_step_record_ns, 1),
             "enabled_span_ns": round(enabled_span_ns, 1),
             "enabled_counter_inc_ns": round(enabled_counter_ns, 1),
             "enabled_histogram_observe_ns":
                 round(enabled_observe_ns, 1),
+            "enabled_goodput_attribute_ns":
+                round(enabled_goodput_attr_ns, 1),
+            "enabled_step_record_ns":
+                round(enabled_step_record_ns, 1),
         },
     }
     print(json.dumps(result))

@@ -107,6 +107,25 @@ def ai_workload_dashboard() -> Dict[str, Any]:
                "s", 0, 32),
         _panel(10, "Serve queue depth",
                "tik_serve_queue_depth", "short", 12, 32),
+        # -- Goodput row: where every TPU-second goes ---------------------
+        {"id": 11, "type": "row", "title": "Goodput", "collapsed": False,
+         "gridPos": {"h": 1, "w": 24, "x": 0, "y": 40}, "panels": []},
+        _panel(12, "Goodput fraction",
+               "tik_goodput_fraction", "percentunit", 0, 41),
+        _panel(13, "TPU-seconds by bucket",
+               "rate(tik_goodput_seconds_total[5m])", "percentunit",
+               12, 41),
+        _panel(14, "Input-pipeline wait (p95)",
+               "histogram_quantile(0.95, "
+               "rate(tik_train_data_wait_seconds_bucket[5m]))",
+               "s", 0, 49),
+        _panel(15, "Straggler lag / slot idle",
+               "tik_train_straggler_lag_seconds "
+               "or tik_serve_slot_idle_fraction", "short", 12, 49),
+        _panel(16, "Alerts firing",
+               "tik_alerts_firing", "short", 0, 57),
+        _panel(17, "XLA compiles",
+               "rate(tik_train_compiles_total[5m])", "ops", 12, 57),
     ]
     return {
         "uid": "tik-ai-workloads",

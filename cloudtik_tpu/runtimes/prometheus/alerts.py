@@ -1,17 +1,43 @@
-"""Built-in Prometheus alerting rules.
+"""Alert rules: declarative thresholds the head collector evaluates.
 
-Reference parity: runtime/prometheus conf — the reference provisions
-alerting for its metrics stack.  Rules over the series this framework
-emits (nodex node gauges + controller reconcile gauges): node pressure
-(cpu/memory/disk), scrape-target loss (node down), and a stuck
-reconcile loop (pending launches never draining).
+Two layers:
+
+  * :func:`default_rules` — classic Prometheus rule-file YAML for
+    clusters running the real prometheus binary (reference parity:
+    runtime/prometheus conf provisions alerting).
+  * the **alert engine** — :class:`AlertRule` + :class:`AlertEngine`,
+    evaluated by the *built-in* collector every scrape cycle, so
+    zero-egress TPU images get alerting without a prometheus binary.
+    Rule kinds: ``threshold`` (value vs a bound, optionally a
+    histogram quantile computed from ``_bucket`` deltas between
+    cycles), ``absence`` (no series for a metric — a vanished
+    heartbeat source), and ``regression`` (current value vs a rolling
+    baseline of its own history — step-time p95 creep).  Rules fire
+    after `for_cycles` consecutive breaches, journal
+    ``tik_alert_fired`` / ``tik_alert_resolved`` to the flight
+    recorder, surface at ``/api/v1/alerts``, and export a
+    ``tik_alerts_firing`` gauge per rule.
+
+The default catalog (:func:`default_alert_rules`) watches the goodput
+fraction, train step-time regression, heartbeat absence, and serve
+TTFT — `tools/check_telemetry_names.py` verifies every referenced
+metric resolves against telemetry/names.py and every rule is
+documented in docs/observability.md.  `tik alerts list|eval` is the
+operator surface.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict
+import dataclasses
+import statistics
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
 
 import yaml
+
+from cloudtik_tpu.telemetry import events
 
 
 def default_rules(cpu_threshold: float = 95.0,
@@ -78,3 +104,323 @@ def write_rules(conf_dir: str, **thresholds) -> str:
     with open(path, "w") as f:
         yaml.safe_dump(default_rules(**thresholds), f, sort_keys=False)
     return path
+
+
+# ===================================================== alert engine ==
+
+STATE_OK = "ok"
+STATE_PENDING = "pending"
+STATE_FIRING = "firing"
+
+KIND_THRESHOLD = "threshold"
+KIND_ABSENCE = "absence"
+KIND_REGRESSION = "regression"
+
+_OPS = {
+    ">": lambda a, b: a > b,
+    "<": lambda a, b: a < b,
+    ">=": lambda a, b: a >= b,
+    "<=": lambda a, b: a <= b,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertRule:
+    """One declarative rule over the scraped sample stream."""
+
+    name: str
+    kind: str                       # threshold | absence | regression
+    metric: str                     # catalog name (base for quantiles)
+    summary: str
+    severity: str = "warning"
+    labels: Tuple[Tuple[str, str], ...] = ()    # equality matchers
+    op: str = ">"                   # threshold comparison
+    threshold: float = 0.0
+    quantile: Optional[float] = None  # compute from _bucket deltas
+    aggregate: str = "max"          # across matching series
+    for_cycles: int = 1             # consecutive breaches to fire
+    window: int = 20                # regression: baseline history size
+    min_samples: int = 5            # regression: baseline size to arm
+    pct: float = 0.25               # regression: tolerated increase
+
+    def __post_init__(self):
+        if self.kind not in (KIND_THRESHOLD, KIND_ABSENCE,
+                             KIND_REGRESSION):
+            raise ValueError(f"{self.name}: unknown kind {self.kind!r}")
+        if self.op not in _OPS:
+            raise ValueError(f"{self.name}: unknown op {self.op!r}")
+
+
+def default_alert_rules() -> List[AlertRule]:
+    """The built-in catalog the head collector evaluates."""
+    return [
+        AlertRule(
+            name="GoodputLow", kind=KIND_THRESHOLD,
+            metric="tik_goodput_fraction",
+            labels=(("job", "train"),), aggregate="min",
+            op="<", threshold=0.5, for_cycles=2, severity="warning",
+            summary="training goodput fraction below 50% — run "
+                    "`tik goodput` for the bucket breakdown"),
+        AlertRule(
+            name="StepTimeRegression", kind=KIND_REGRESSION,
+            metric="tik_train_step_seconds", quantile=0.95,
+            pct=0.25, window=20, min_samples=5, for_cycles=2,
+            severity="warning",
+            summary="train step p95 regressed >25% vs its rolling "
+                    "baseline — capture an xprof window "
+                    "(`tik profile capture`)"),
+        AlertRule(
+            name="HeartbeatAbsent", kind=KIND_ABSENCE,
+            metric="tik_heartbeats_published_total",
+            for_cycles=3, severity="critical",
+            summary="no node-agent heartbeat series scraped — agents "
+                    "down or the telemetry endpoint unreachable"),
+        AlertRule(
+            name="ServeTTFTHigh", kind=KIND_THRESHOLD,
+            metric="tik_serve_ttft_seconds", quantile=0.95,
+            op=">", threshold=2.0, for_cycles=3, severity="warning",
+            summary="serve time-to-first-token p95 above 2s"),
+    ]
+
+
+def _match(labels: Dict[str, str],
+           matchers: Tuple[Tuple[str, str], ...]) -> bool:
+    return all(labels.get(k, "") == v for k, v in matchers)
+
+
+def _histogram_quantile(q: float,
+                        buckets: List[Tuple[float, float]]) -> \
+        Optional[float]:
+    """Prometheus-style quantile over (upper_bound, count) per-bucket
+    (non-cumulative) counts with linear interpolation."""
+    buckets = sorted(buckets)
+    total = sum(c for _b, c in buckets)
+    if total <= 0:
+        return None
+    rank = q * total
+    seen = 0.0
+    lower = 0.0
+    for bound, count in buckets:
+        if seen + count >= rank:
+            if bound == float("inf"):
+                return lower   # best effort: the last finite bound
+            if count <= 0:
+                return bound
+            frac = (rank - seen) / count
+            return lower + (bound - lower) * frac
+        seen += count
+        if bound != float("inf"):
+            lower = bound
+    return lower
+
+
+class _RuleState:
+    __slots__ = ("state", "streak", "since", "value", "last_eval",
+                 "history", "prev_buckets", "last_quantile")
+
+    def __init__(self, window: int):
+        self.state = STATE_OK
+        self.streak = 0
+        self.since: Optional[float] = None
+        self.value: Optional[float] = None
+        self.last_eval: Optional[float] = None
+        self.history: deque = deque(maxlen=max(window, 1))
+        self.prev_buckets: Optional[Dict[Tuple[Tuple[str, str], ...],
+                                         Dict[float, float]]] = None
+        # last computed quantile, held across cycles that bring no new
+        # observations (zero bucket delta / a flapped scrape) so a
+        # quiet cycle cannot erase a breach streak
+        self.last_quantile: Optional[float] = None
+
+
+class AlertEngine:
+    """Evaluates the rule catalog against parsed Prometheus samples
+    ({name, labels, value} dicts) once per scrape cycle."""
+
+    def __init__(self, rules: Optional[List[AlertRule]] = None):
+        self.rules = list(rules) if rules is not None \
+            else default_alert_rules()
+        names = [r.name for r in self.rules]
+        if len(names) != len(set(names)):
+            raise ValueError(f"duplicate alert rule names in {names}")
+        self._lock = threading.Lock()
+        self._states = {r.name: _RuleState(r.window) for r in self.rules}
+
+    # -- value extraction -------------------------------------------------
+    def _series_value(self, rule: AlertRule,
+                      samples: List[Dict[str, Any]]) -> Optional[float]:
+        values = [float(s["value"]) for s in samples
+                  if s.get("name") == rule.metric
+                  and isinstance(s.get("value"), (int, float))
+                  and _match(s.get("labels", {}), rule.labels)]
+        if not values:
+            return None
+        if rule.aggregate == "min":
+            return min(values)
+        if rule.aggregate == "sum":
+            return sum(values)
+        if rule.aggregate == "avg":
+            return sum(values) / len(values)
+        return max(values)
+
+    def _quantile_value(self, rule: AlertRule, state: _RuleState,
+                        samples: List[Dict[str, Any]]) -> \
+            Optional[float]:
+        """Quantile of the metric's `_bucket` distribution, over the
+        DELTA since the previous cycle — recent latency, not
+        since-boot latency.  The first cycle uses the cumulative
+        counts (delta from zero); a cycle with no new observations (or
+        no scraped buckets at all) HOLDS the last computed quantile —
+        the latency estimate is unchanged, so a quiet cycle must not
+        read as recovery."""
+        bucket_name = rule.metric + "_bucket"
+        current: Dict[Tuple[Tuple[str, str], ...],
+                      Dict[float, float]] = {}
+        for sample in samples:
+            if sample.get("name") != bucket_name:
+                continue
+            labels = dict(sample.get("labels", {}))
+            le = labels.pop("le", None)
+            if le is None or not _match(labels, rule.labels):
+                continue
+            try:
+                bound = float("inf") if le == "+Inf" else float(le)
+                value = float(sample["value"])
+            except (TypeError, ValueError):
+                continue
+            key = tuple(sorted(labels.items()))
+            current.setdefault(key, {})[bound] = \
+                current.get(key, {}).get(bound, 0.0) + value
+        if not current:
+            return state.last_quantile
+        prev = state.prev_buckets or {}
+        state.prev_buckets = current
+        # merge series, convert cumulative counts to per-bucket deltas
+        merged: Dict[float, float] = {}
+        for key, bounds in current.items():
+            prev_bounds = prev.get(key, {})
+            cumulative = 0.0
+            prev_cumulative = 0.0
+            for bound in sorted(bounds):
+                delta_cum = bounds[bound] - prev_bounds.get(bound, 0.0)
+                per_bucket = max(
+                    delta_cum - (cumulative - prev_cumulative), 0.0)
+                cumulative = bounds[bound]
+                prev_cumulative = prev_bounds.get(bound, 0.0)
+                merged[bound] = merged.get(bound, 0.0) + per_bucket
+        value = _histogram_quantile(rule.quantile,
+                                    list(merged.items()))
+        if value is None:
+            return state.last_quantile
+        state.last_quantile = value
+        return value
+
+    # -- evaluation -------------------------------------------------------
+    def _breach(self, rule: AlertRule, state: _RuleState,
+                samples: List[Dict[str, Any]]) -> Tuple[bool, Any]:
+        if rule.kind == KIND_ABSENCE:
+            matched = sum(
+                1 for s in samples
+                if (s.get("name") == rule.metric
+                    or s.get("name", "").startswith(rule.metric + "_"))
+                and _match(s.get("labels", {}), rule.labels))
+            return matched == 0, float(matched)
+        if rule.quantile is not None:
+            value = self._quantile_value(rule, state, samples)
+        else:
+            value = self._series_value(rule, samples)
+        if value is None:
+            return None, None       # no data: hold state, not recovery
+        if rule.kind == KIND_THRESHOLD:
+            return _OPS[rule.op](value, rule.threshold), value
+        # regression: current vs rolling baseline of its own history
+        baseline = statistics.median(state.history) \
+            if len(state.history) >= rule.min_samples else None
+        if baseline is None or baseline <= 0:
+            state.history.append(value)
+            return False, value
+        breach = value > baseline * (1.0 + rule.pct)
+        # only healthy samples feed the baseline: a sustained
+        # regression must not poison its own reference and
+        # self-resolve while nothing recovered
+        if not breach:
+            state.history.append(value)
+        return breach, value
+
+    def evaluate(self, samples: List[Dict[str, Any]],
+                 now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """One evaluation cycle; returns the post-cycle state list."""
+        now = time.time() if now is None else now
+        with self._lock:
+            for rule in self.rules:
+                state = self._states[rule.name]
+                breach, value = self._breach(rule, state, samples)
+                if value is not None:
+                    state.value = value
+                state.last_eval = now
+                if breach is None:
+                    # no data this cycle: neither breach nor recovery —
+                    # state and streak hold (a flapped scrape must not
+                    # erase a near-firing streak or resolve an alert)
+                    continue
+                if breach:
+                    state.streak += 1
+                    if state.streak >= rule.for_cycles:
+                        if state.state != STATE_FIRING:
+                            state.state = STATE_FIRING
+                            state.since = now
+                            events.emit(
+                                "tik_alert_fired", rule=rule.name,
+                                severity=rule.severity, value=value,
+                                threshold=rule.threshold,
+                                summary=rule.summary)
+                    elif state.state == STATE_OK:
+                        state.state = STATE_PENDING
+                        state.since = now
+                else:
+                    if state.state == STATE_FIRING:
+                        events.emit("tik_alert_resolved",
+                                    rule=rule.name, value=value)
+                    state.state = STATE_OK
+                    state.streak = 0
+                    state.since = None
+            return self._state_locked()
+
+    def _state_locked(self) -> List[Dict[str, Any]]:
+        out = []
+        for rule in self.rules:
+            state = self._states[rule.name]
+            out.append({
+                "name": rule.name,
+                "kind": rule.kind,
+                "metric": rule.metric,
+                "state": state.state,
+                "value": state.value,
+                "threshold": rule.threshold,
+                "severity": rule.severity,
+                "summary": rule.summary,
+                "since": state.since,
+                "last_eval": state.last_eval,
+            })
+        return out
+
+    def state(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return self._state_locked()
+
+    def firing(self) -> List[Dict[str, Any]]:
+        return [a for a in self.state() if a["state"] == STATE_FIRING]
+
+
+def samples_from_exposition(text: str,
+                            extra_labels: Optional[Dict[str, str]]
+                            = None) -> List[Dict[str, Any]]:
+    """Prometheus exposition text -> engine sample stream, with
+    target-level labels merged under the sample's own labels."""
+    from cloudtik_tpu.telemetry.export import parse_prometheus
+    samples = parse_prometheus(text)
+    if extra_labels:
+        for sample in samples:
+            sample["labels"] = {**extra_labels, **sample["labels"]}
+    return samples
+

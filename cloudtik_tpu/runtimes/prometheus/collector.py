@@ -34,6 +34,23 @@ _SAMPLE_RE = re.compile(
 _QUERY_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?$")
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+# query-side matchers support the promql operator set: = != =~ !~
+_MATCHER_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)\s*(=~|!~|!=|=)\s*"([^"]*)"')
+
+
+def _matcher_ok(value: str, op: str, operand: str) -> bool:
+    """One label matcher against a (possibly absent -> "") value.
+    Regex matchers are fully anchored, as in promql."""
+    if op == "=":
+        return value == operand
+    if op == "!=":
+        return value != operand
+    try:
+        matched = re.fullmatch(operand, value) is not None
+    except re.error:
+        return False
+    return matched if op == "=~" else not matched
 
 
 def load_file_sd_targets(conf_dir: str,
@@ -85,11 +102,14 @@ class ScrapeState:
 
 
 class Collector:
-    def __init__(self, conf_dir: str, scrape_interval_s: float = 5.0):
+    def __init__(self, conf_dir: str, scrape_interval_s: float = 5.0,
+                 alert_rules=None):
+        from cloudtik_tpu.runtimes.prometheus.alerts import AlertEngine
         self.conf_dir = os.path.expanduser(conf_dir)
         self.scrape_interval_s = scrape_interval_s
         self.state = ScrapeState()
         self.started_at = time.time()
+        self.alerts = AlertEngine(alert_rules)
         self._stop = threading.Event()
 
     # -- target discovery (file-SD) ---------------------------------------
@@ -114,19 +134,41 @@ class Collector:
     def run_scraper(self) -> None:
         while not self._stop.is_set():
             self.scrape_once()
+            self.evaluate_alerts()
             self._stop.wait(self.scrape_interval_s)
+
+    # -- alerting ----------------------------------------------------------
+    def alert_samples(self) -> List[Dict[str, Any]]:
+        """The sample stream the alert engine sees: every up target's
+        exposition parsed, target labels + instance merged in."""
+        from cloudtik_tpu.runtimes.prometheus.alerts import (
+            samples_from_exposition)
+        samples: List[Dict[str, Any]] = []
+        for target in self.state.snapshot().values():
+            if not target["up"]:
+                continue
+            samples.extend(samples_from_exposition(
+                target["text"],
+                {**target["labels"], "instance": target["address"]}))
+        return samples
+
+    def evaluate_alerts(self) -> List[Dict[str, Any]]:
+        """One alert-engine cycle over the latest scrapes (called after
+        every scrape pass)."""
+        return self.alerts.evaluate(self.alert_samples())
 
     # -- query -------------------------------------------------------------
     def instant_query(self, query: str) -> List[Dict[str, Any]]:
         """Instant lookup: an exact metric name, optionally narrowed by
-        equality label matchers — `name{label="v",l2="w"}`.  Matchers
-        resolve against the union of the sample's own labels, the
-        target's file-SD labels, and `instance`."""
+        label matchers — `name{l="v",l2!="w",l3=~"re.*"}` (`=`, `!=`,
+        `=~`, `!~`; regexes fully anchored).  Matchers resolve against
+        the union of the sample's own labels, the target's file-SD
+        labels, and `instance`; an absent label matches as ""."""
         q = _QUERY_RE.match(query.strip())
         if not q:
             return []
         metric = q.group(1)
-        matchers = dict(_LABEL_RE.findall(q.group(2) or ""))
+        matchers = _MATCHER_RE.findall(q.group(2) or "")
         results = []
         for target in self.state.snapshot().values():
             if not target["up"]:
@@ -142,7 +184,8 @@ class Collector:
                     **dict(_LABEL_RE.findall(m.group(2) or "")),
                     "instance": target["address"],
                 }
-                if any(labels.get(k) != v for k, v in matchers.items()):
+                if any(not _matcher_ok(labels.get(k, ""), op, v)
+                       for k, op, v in matchers):
                     continue
                 results.append({
                     "metric": {"__name__": metric, **labels},
@@ -162,7 +205,14 @@ class Collector:
             "# HELP scrape_duration_seconds Wall time of the last "
             "scrape of each target.",
             "# TYPE scrape_duration_seconds gauge",
+            "# HELP tik_alerts_firing 1 per firing alert rule, 0 "
+            "otherwise.",
+            "# TYPE tik_alerts_firing gauge",
         ]
+        for alert in self.alerts.state():
+            lines.append(
+                f'tik_alerts_firing{{rule="{alert["name"]}"}} '
+                f'{1 if alert["state"] == "firing" else 0}')
         seen_headers: set = set()
         for target in self.state.snapshot().values():
             labels = "".join(
@@ -235,6 +285,11 @@ def make_handler(collector: Collector):
                     "status": "success",
                     "data": {"activeTargets": active}}),
                     "application/json")
+            elif parsed.path == "/api/v1/alerts":
+                self._send(200, json.dumps({
+                    "status": "success",
+                    "data": {"alerts": collector.alerts.state()}}),
+                    "application/json")
             elif parsed.path == "/api/v1/query":
                 query = parse_qs(parsed.query).get("query", [""])[0]
                 self._send(200, json.dumps({
@@ -250,6 +305,13 @@ def make_handler(collector: Collector):
 
 def serve(port: int, conf_dir: str,
           scrape_interval_s: float = 5.0) -> None:
+    # daemon boot: install the flight recorder so alert fired/resolved
+    # transitions are journaled durably (library imports never install)
+    from cloudtik_tpu.telemetry import events
+    try:
+        events.install()
+    except OSError:
+        pass
     collector = Collector(conf_dir, scrape_interval_s)
     threading.Thread(target=collector.run_scraper, daemon=True,
                      name="tik-prom-scraper").start()

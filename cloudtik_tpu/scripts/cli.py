@@ -363,10 +363,12 @@ def head_process_status():
 @head.command(name="resource-metrics")
 def head_resource_metrics():
     """Per-node resource metrics published by the node agents, plus
-    heartbeat freshness and runtime-reported lost nodes."""
+    heartbeat freshness, runtime-reported lost nodes, and per-host
+    training progress with straggler detection."""
     import time as _time
 
     from cloudtik_tpu.control.state import TABLE_HEARTBEAT, TABLE_METRICS
+    from cloudtik_tpu.telemetry import stepprof
     _config, state = _head_state()
     heartbeats = state.table_list(TABLE_HEARTBEAT)
     now = _time.time()
@@ -378,11 +380,15 @@ def head_resource_metrics():
     controller = state.table_list("controller").get("status", {})
     lost_nodes = (controller.get("summary", {}).get("metrics", {})
                   .get("lost_nodes", {}))
+    train_progress = state.table_list(stepprof.TABLE_TRAIN_PROGRESS)
     click.echo(json.dumps({
         "metrics": state.table_list(TABLE_METRICS),
         "heartbeats": heartbeats,
         "heartbeat_age_s": heartbeat_age_s,
         "lost_nodes": lost_nodes,
+        "train_progress": train_progress,
+        "stragglers": stepprof.detect_stragglers(train_progress,
+                                                 now=now),
     }, indent=2, default=str))
 
 
@@ -879,6 +885,192 @@ def metrics_dump(url, config_file, as_json):
         click.echo(json.dumps(parse_prometheus(body), indent=1))
     else:
         click.echo(body, nl=False)
+
+
+# ---------------------------------------------------------------- goodput --
+
+@cli.command(name="goodput")
+@_telemetry_url_opt
+@_telemetry_config_opt
+@click.option("--file", "snapshot_file", default=None,
+              type=click.Path(exists=True),
+              help="Read a ledger snapshot JSON (written via "
+                   "TIK_GOODPUT_SNAPSHOT) instead of fetching "
+                   "/metrics.")
+@click.option("--job", default=None,
+              help="Only this job label (default: every job).")
+@click.option("--json", "as_json", is_flag=True,
+              help="Emit the breakdown(s) as JSON.")
+def goodput_cmd(url, config_file, snapshot_file, job, as_json):
+    """Where every TPU-second went: the goodput bucket breakdown.
+
+    Buckets (docs/observability.md "Goodput ledger"): step_compute,
+    compile, data_wait, host_transfer, checkpoint_save,
+    checkpoint_restore, restart_replay, slot_idle, idle — summing to
+    total wall time."""
+    from cloudtik_tpu.telemetry import goodput as tgoodput
+    if snapshot_file:
+        with open(snapshot_file) as f:
+            snap = json.load(f)
+        records = snap if isinstance(snap, list) else [snap]
+        if job is not None:
+            records = [r for r in records if r.get("job") == job]
+    else:
+        from cloudtik_tpu.telemetry import parse_prometheus
+        body = _telemetry_fetch(url, config_file, "/metrics")
+        records = tgoodput.breakdown_from_samples(
+            parse_prometheus(body), job=job)
+    if as_json:
+        click.echo(json.dumps(records, indent=1))
+        return
+    if not records:
+        cli_logger.info("No goodput ledger data (is a job running "
+                        "with telemetry on?).")
+        return
+    for record in records:
+        click.echo(tgoodput.format_breakdown(record))
+
+
+# ----------------------------------------------------------------- alerts --
+
+@cli.group(name="alerts")
+def alerts_group():
+    """Alert rules the head collector evaluates every scrape cycle
+    (docs/observability.md "Alert rules")."""
+
+
+@alerts_group.command(name="list")
+@click.option("--url", default=None,
+              help="Collector base URL (default "
+                   "http://127.0.0.1:9090); fetches /api/v1/alerts.")
+@click.option("--catalog", is_flag=True,
+              help="Print the built-in rule catalog instead of live "
+                   "state (no collector needed).")
+@click.option("--json", "as_json", is_flag=True)
+def alerts_list(url, catalog, as_json):
+    """Show live alert state from the collector (or the catalog)."""
+    from cloudtik_tpu.runtimes.prometheus.alerts import (
+        default_alert_rules)
+    if catalog:
+        rows = [{"name": r.name, "kind": r.kind, "metric": r.metric,
+                 "severity": r.severity, "summary": r.summary}
+                for r in default_alert_rules()]
+    else:
+        import urllib.error
+        import urllib.request
+        base = (url or "http://127.0.0.1:9090").rstrip("/")
+        try:
+            with urllib.request.urlopen(
+                    base + "/api/v1/alerts", timeout=10) as resp:
+                payload = json.loads(resp.read().decode())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            raise click.ClickException(
+                f"cannot fetch {base}/api/v1/alerts: {e} (is the "
+                "built-in collector running? use --catalog for the "
+                "static rule list)")
+        rows = payload.get("data", {}).get("alerts", [])
+    if as_json:
+        click.echo(json.dumps(rows, indent=1))
+        return
+    if not rows:
+        cli_logger.info("No alert rules.")
+        return
+    width = max(len(r["name"]) for r in rows)
+    for row in rows:
+        state = row.get("state", "-")
+        value = row.get("value")
+        value_s = f"{value:.4g}" if isinstance(value, (int, float)) \
+            else "-"
+        click.echo(f"{row['name']:<{width}}  {state:<7}  "
+                   f"{row.get('severity', '-'):<8}  value={value_s}  "
+                   f"{row.get('summary', '')}")
+
+
+@alerts_group.command(name="eval")
+@_telemetry_url_opt
+@_telemetry_config_opt
+@click.option("--file", "exposition_file", default=None,
+              type=click.Path(exists=True),
+              help="Evaluate against a saved Prometheus exposition "
+                   "instead of fetching /metrics.")
+@click.option("--cycles", default=3, show_default=True,
+              help="Evaluation cycles (rules fire after their "
+                   "for_cycles consecutive breaches).")
+@click.option("--interval", default=0.0, show_default=True,
+              help="Seconds between cycles (re-fetches with --url).")
+@click.option("--fail-on-firing", is_flag=True,
+              help="Exit 2 when any rule ends up firing (CI gate).")
+@click.option("--json", "as_json", is_flag=True)
+def alerts_eval(url, config_file, exposition_file, cycles, interval,
+                fail_on_firing, as_json):
+    """One-shot rule evaluation against a metrics exposition."""
+    import time as _time
+
+    from cloudtik_tpu.runtimes.prometheus.alerts import (
+        AlertEngine, samples_from_exposition)
+    engine = AlertEngine()
+
+    def _samples():
+        if exposition_file:
+            with open(exposition_file) as f:
+                return samples_from_exposition(f.read())
+        return samples_from_exposition(
+            _telemetry_fetch(url, config_file, "/metrics"))
+
+    state = []
+    for cycle in range(max(int(cycles), 1)):
+        if cycle and interval:
+            _time.sleep(interval)
+        state = engine.evaluate(_samples())
+    if as_json:
+        click.echo(json.dumps(state, indent=1))
+    else:
+        width = max(len(a["name"]) for a in state)
+        for alert in state:
+            value = alert.get("value")
+            value_s = f"{value:.4g}" \
+                if isinstance(value, (int, float)) else "-"
+            click.echo(f"{alert['name']:<{width}}  "
+                       f"{alert['state']:<7}  value={value_s}  "
+                       f"{alert['summary']}")
+    firing = [a for a in state if a["state"] == "firing"]
+    if not as_json:
+        if firing:
+            cli_logger.warning("{} rule(s) firing.", len(firing))
+        else:
+            cli_logger.success("No rules firing.")
+    if firing and fail_on_firing:
+        sys.exit(2)
+
+
+# ---------------------------------------------------------------- profile --
+
+@cli.group(name="profile")
+def profile_group():
+    """On-demand xprof capture windows inside a running trainer
+    (docs/observability.md)."""
+
+
+@profile_group.command(name="capture")
+@click.option("--steps", default=5, show_default=True,
+              help="Training steps to trace.")
+@click.option("--output", "-o", default="~/.tik/xprof",
+              show_default=True, help="Trace output directory.")
+@click.option("--request-path", default=None,
+              help="Request file path (default: <tik home>/"
+                   "profile-request.json; TIK_PROFILE_REQUEST "
+                   "overrides).")
+def profile_capture(steps, output, request_path):
+    """Ask the next training window to capture an xprof trace.
+
+    The trainer polls for the request at every log window and runs
+    `jax.profiler` for N steps — the same mechanism bench.py wires via
+    TIK_BENCH_PROFILE.  View the output with tensorboard/xprof."""
+    from cloudtik_tpu.telemetry import stepprof
+    path = stepprof.request_capture(steps, output, request_path)
+    cli_logger.success(
+        "Capture request written to {} ({} step(s) -> {}); the next "
+        "training log window picks it up.", path, steps, output)
 
 
 # ---------------------------------------------------------------- cluster --

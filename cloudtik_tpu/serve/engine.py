@@ -45,8 +45,9 @@ import numpy as np
 
 from cloudtik_tpu import telemetry
 from cloudtik_tpu.faults import seams
-from cloudtik_tpu.telemetry import events
+from cloudtik_tpu.telemetry import events, goodput
 from cloudtik_tpu.telemetry import instruments as ti
+from cloudtik_tpu.telemetry.core import STATE as _telemetry_state
 from cloudtik_tpu.models.generate import (
     _NEG, _rms_norm, forward_step, init_cache)
 from cloudtik_tpu.models.transformer import (
@@ -282,6 +283,9 @@ class DecodeEngine:
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # serve-side goodput: decode-step wall time split into busy
+        # lanes vs slot_idle, anchored when the engine starts serving
+        self._ledger = goodput.get_ledger("serve")
 
         self._decode = jax.jit(
             lambda p, tok, ks, vs, ln, act, tmp, rng: decode_step(
@@ -334,6 +338,7 @@ class DecodeEngine:
         return self.submit(Request(prompt, **kw)).wait(timeout=600)
 
     def start(self) -> None:
+        self._ledger.start_job()
         self._thread = threading.Thread(
             target=self._loop, name="tik-decode-engine", daemon=True)
         self._thread.start()
@@ -492,6 +497,7 @@ class DecodeEngine:
         n_active = sum(s is not None for s in self._slots)
         seams.fire("serve.decode_step", active=n_active)
         ti.SERVE_ACTIVE_SLOTS.set(n_active)
+        t_step = time.perf_counter()
         with telemetry.span("serve.decode_step", active=n_active):
             active_mask = np.array(
                 [s is not None for s in self._slots], np.bool_)
@@ -506,6 +512,19 @@ class DecodeEngine:
             self._tokens = nxt
             host_tokens = np.asarray(nxt)
         ti.SERVE_TOKENS.inc(n_active)
+        if _telemetry_state.enabled:
+            # slot-idle accounting: a decode step's wall time splits
+            # into productive lanes (occupied slots) and idle lanes —
+            # the serve-side goodput view
+            dt = time.perf_counter() - t_step
+            busy = dt * n_active / self.ec.slots
+            self._ledger.attribute(goodput.BUCKET_STEP_COMPUTE, busy)
+            self._ledger.attribute(goodput.BUCKET_SLOT_IDLE, dt - busy)
+            ti.SERVE_SLOT_IDLE_FRACTION.set(
+                1.0 - n_active / self.ec.slots)
+            # refresh wall/fraction while BUSY too — a saturated
+            # engine must not serve stale goodput gauges
+            self._ledger.tick()
         for slot_id, slot in enumerate(self._slots):
             if slot is None:
                 continue
@@ -532,6 +551,8 @@ class DecodeEngine:
                     elif self._queue.empty():
                         self._wake.wait(timeout=0.5)
                         self._wake.clear()
+                        # waiting with no work: fold the gap into idle
+                        self._ledger.tick()
                 except Exception:
                     logger.exception("decode engine loop error")
                     # fail everything in flight rather than hang callers

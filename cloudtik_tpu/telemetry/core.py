@@ -600,7 +600,19 @@ def spans() -> List[dict]:
     return SPAN_RING.snapshot()
 
 
+_RESET_HOOKS: List[Any] = []
+
+
+def on_reset(hook) -> None:
+    """Register a callable run by reset() — modules holding derived
+    telemetry state (the goodput ledgers) keep it consistent with the
+    zeroed registry."""
+    _RESET_HOOKS.append(hook)
+
+
 def reset() -> None:
     """Clear spans and zero every metric series (tests)."""
     SPAN_RING.clear()
     REGISTRY.reset()
+    for hook in _RESET_HOOKS:
+        hook()

@@ -63,6 +63,18 @@ SERVE_TOKENS: Counter = _build("tik_serve_tokens_generated_total")
 SERVE_ACTIVE_SLOTS: Gauge = _build("tik_serve_active_slots")
 SERVE_QUEUE_DEPTH: Gauge = _build("tik_serve_queue_depth")
 
+# goodput ledger / step profiler
+GOODPUT_SECONDS: Counter = _build("tik_goodput_seconds_total")
+GOODPUT_WALL: Gauge = _build("tik_goodput_wall_seconds")
+GOODPUT_FRACTION: Gauge = _build("tik_goodput_fraction")
+TRAIN_DATA_WAIT_SECONDS: Histogram = _build("tik_train_data_wait_seconds")
+TRAIN_HOST_TRANSFER_SECONDS: Histogram = _build(
+    "tik_train_host_transfer_seconds")
+TRAIN_DISPATCH_SECONDS: Histogram = _build("tik_train_dispatch_seconds")
+TRAIN_COMPILES: Counter = _build("tik_train_compiles_total")
+TRAIN_STRAGGLER_LAG: Gauge = _build("tik_train_straggler_lag_seconds")
+SERVE_SLOT_IDLE_FRACTION: Gauge = _build("tik_serve_slot_idle_fraction")
+
 # telemetry self-accounting
 SPANS_DROPPED: Counter = _build("tik_spans_dropped_total")
 

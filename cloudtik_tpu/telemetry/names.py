@@ -126,6 +126,37 @@ _ALL = [
        "Decode slots occupied this step.", "serve"),
     _m("tik_serve_queue_depth", "gauge",
        "Requests waiting for a slot.", "serve"),
+    # -- goodput ledger / step profiler ----------------------------------
+    _m("tik_goodput_seconds_total", "counter",
+       "Job wall time attributed to a goodput bucket "
+       "(telemetry/goodput.py taxonomy).", "telemetry",
+       ("bucket", "job")),
+    _m("tik_goodput_wall_seconds", "gauge",
+       "Total wall time the goodput ledger has accounted so far.",
+       "telemetry", ("job",)),
+    _m("tik_goodput_fraction", "gauge",
+       "Productive step-compute fraction of accounted wall time.",
+       "telemetry", ("job",)),
+    _m("tik_train_data_wait_seconds", "histogram",
+       "Per-step wait on the input pipeline (next(batch)).", "train",
+       (), FAST_BUCKETS),
+    _m("tik_train_host_transfer_seconds", "histogram",
+       "Per-step host->device batch transfer (device_put).", "train",
+       (), FAST_BUCKETS),
+    _m("tik_train_dispatch_seconds", "histogram",
+       "Per-step dispatch wall time of the jitted step (compile time "
+       "subtracted when the compile tracker saw one).", "train",
+       (), LATENCY_BUCKETS),
+    _m("tik_train_compiles_total", "counter",
+       "XLA backend compiles observed by the compile-tracking seam "
+       "(first-step and recompiles).", "train"),
+    _m("tik_train_straggler_lag_seconds", "gauge",
+       "Largest per-host step-publish lag behind the fastest host.",
+       "train"),
+    # -- serve goodput ----------------------------------------------------
+    _m("tik_serve_slot_idle_fraction", "gauge",
+       "Fraction of decode-step lanes idle this step (1 - active/slots).",
+       "serve"),
     # -- telemetry self-accounting ---------------------------------------
     _m("tik_spans_dropped_total", "counter",
        "Finished spans overwritten in the ring before export.",
@@ -151,6 +182,9 @@ _ALL = [
     _m("tik_collector_uptime_seconds", "gauge",
        "Built-in prometheus collector uptime.", "runtimes",
        source="external"),
+    _m("tik_alerts_firing", "gauge",
+       "1 per firing alert rule, 0 otherwise (collector's alert "
+       "engine).", "runtimes", ("rule",), source="external"),
 ]
 
 METRICS: Dict[str, MetricSpec] = {}
@@ -186,6 +220,13 @@ _EVENT_LIST = [
      "a serve request was cancelled."),
     ("tik_fault_fired",
      "an armed fault plan fired at a seam (chaos drills)."),
+    ("tik_train_resume",
+     "a trainer resumed from a checkpoint; replay_until marks the "
+     "last step already run before the restart (goodput replay)."),
+    ("tik_alert_fired",
+     "an alert rule crossed into firing (collector alert engine)."),
+    ("tik_alert_resolved",
+     "a firing alert rule returned to ok."),
 ]
 
 EVENTS: Dict[str, str] = {}

@@ -31,7 +31,7 @@ import jax
 from cloudtik_tpu import telemetry
 from cloudtik_tpu.faults import seams
 from cloudtik_tpu.faults.plan import DIRECTIVE_TORN_WRITE
-from cloudtik_tpu.telemetry import events
+from cloudtik_tpu.telemetry import events, goodput
 from cloudtik_tpu.telemetry import instruments as ti
 
 logger = logging.getLogger(__name__)
@@ -98,12 +98,16 @@ class Checkpointer:
             except Exception:
                 ti.CHECKPOINT_SAVES.inc(result="failed")
                 events.emit("tik_checkpoint_commit", step=step,
-                            result="failed")
+                            result="failed",
+                            directory=self.config.directory)
                 raise
         if saved:
-            ti.CHECKPOINT_SAVE_SECONDS.observe(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            ti.CHECKPOINT_SAVE_SECONDS.observe(dt)
             ti.CHECKPOINT_SAVES.inc(result="ok")
-            events.emit("tik_checkpoint_commit", step=step, result="ok")
+            goodput.attribute(goodput.BUCKET_CHECKPOINT_SAVE, dt)
+            events.emit("tik_checkpoint_commit", step=step, result="ok",
+                        directory=self.config.directory)
         if saved and directive == DIRECTIVE_TORN_WRITE:
             # drill point: let the write land, then tear it — the step
             # LOOKS committed (dir present, listed by latest_step) but
@@ -173,7 +177,9 @@ class Checkpointer:
                     args=self._ocp.args.Composite(
                         state=self._ocp.args.StandardRestore(abstract)),
                 )["state"]
-        ti.CHECKPOINT_RESTORE_SECONDS.observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        ti.CHECKPOINT_RESTORE_SECONDS.observe(dt)
+        goodput.attribute(goodput.BUCKET_CHECKPOINT_RESTORE, dt)
         return restored_state
 
     def _restore_partial(self, abstract: Any, step: int) -> Any:

@@ -22,6 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cloudtik_tpu import telemetry
 from cloudtik_tpu.parallel.mesh import MeshConfig, build_mesh
+from cloudtik_tpu.telemetry import events, goodput, stepprof
 from cloudtik_tpu.telemetry import instruments as ti
 from cloudtik_tpu.parallel.sharding import (
     AxisRules, DEFAULT_RULES, batch_sharding, tree_to_shardings_safe)
@@ -203,6 +204,10 @@ class Trainer:
         self.state = None
         self.step = 0
         self._jitted_step = None
+        # steps <= this were already run before a restart (resume from
+        # an older checkpoint): the goodput ledger books their time as
+        # restart_replay, not progress
+        self._replay_until = 0
         self.checkpointer: Optional[Checkpointer] = None
         if config.checkpoint_dir and config.checkpoint_every:
             self.checkpointer = Checkpointer(CheckpointConfig(
@@ -274,7 +279,21 @@ class Trainer:
         self.state = self.checkpointer.restore(
             self._abstract_state(), step=step)
         self.step = int(step)
+        self._note_resume()
         return self.step
+
+    def _note_resume(self) -> None:
+        """Reconstruct the restart-replay horizon from the flight
+        recorder: work the previous incarnation already ran (max
+        checkpoint_commit step OF THIS CHECKPOINT DIRECTORY) that this
+        one will re-run counts as restart_replay in the goodput
+        ledger, not progress."""
+        directory = self.checkpointer.config.directory \
+            if self.checkpointer is not None else None
+        horizon = goodput.replay_horizon(self.step, directory=directory)
+        self._replay_until = horizon if horizon > self.step else 0
+        events.emit("tik_train_resume", step=self.step,
+                    replay_until=self._replay_until)
 
     def _abstract_state(self):
         """ShapeDtypeStructs with shardings for {params, opt_state}."""
@@ -308,6 +327,7 @@ class Trainer:
             return None
         self.state, step = restored
         self.step = int(step)
+        self._note_resume()
         return self.step
 
     # -- step --------------------------------------------------------------
@@ -388,6 +408,8 @@ class Trainer:
         bench regressions lacked (SURVEY.md §5 tracing directive).  View
         with tensorboard or xprof.
         """
+        goodput.LEDGER.start_job()
+        stepprof.install_compile_tracking()
         if self.state is None:
             self.init_state(rng if rng is not None else jax.random.PRNGKey(0))
         jitted = self.compile_step()
@@ -401,6 +423,8 @@ class Trainer:
                 jax.block_until_ready(
                     jax.tree.leaves(self.state)[0])
                 jax.profiler.stop_trace()
+            goodput.LEDGER.tick()
+            goodput.maybe_write_snapshot()
 
     def _fit_loop(self, data_iter, num_steps, jitted,
                   callbacks) -> Dict[str, Any]:
@@ -408,22 +432,33 @@ class Trainer:
         peak = device_peak_flops()
         n_devices = self.mesh.devices.size
         history = []
+        profiler = stepprof.StepProfiler(
+            goodput.LEDGER, replay_until=self._replay_until)
+        capture = stepprof.ProfileCapture()
         t_window = time.perf_counter()
         window_steps = 0
         with jax.sharding.set_mesh(self.mesh):
             for _ in range(num_steps):
                 t_step = time.perf_counter()
                 batch = next(data_iter)
+                t_data = time.perf_counter()
                 batch = jax.device_put(batch, self.data_sharding)
+                t_put = time.perf_counter()
+                profiler.dispatch_begin()
                 self.state, metrics = jitted(self.state, batch)
+                t_dispatch = time.perf_counter()
                 self.step += 1
                 window_steps += 1
                 # dispatch wall time per step (async runtimes retire
                 # compute later; the log-window sync below is the
                 # honest throughput number)
-                ti.TRAIN_STEP_SECONDS.observe(
-                    time.perf_counter() - t_step)
+                ti.TRAIN_STEP_SECONDS.observe(t_dispatch - t_step)
                 ti.TRAIN_STEPS.inc()
+                profiler.record_step(
+                    self.step, t_data - t_step, t_put - t_data,
+                    t_dispatch - t_put)
+                if capture.active:
+                    capture.step_done(jax.tree.leaves(self.state)[0])
                 if (self.checkpointer is not None
                         and self.config.checkpoint_every
                         and self.step % self.config.checkpoint_every == 0):
@@ -434,7 +469,10 @@ class Trainer:
                     # block_until_ready before compute retires, so dt
                     # must be taken AFTER the transfer or tokens/sec
                     # and MFU inflate
+                    t_sync = time.perf_counter()
                     entry = {k: float(v) for k, v in metrics.items()}
+                    profiler.record_sync(
+                        self.step, time.perf_counter() - t_sync)
                     dt = time.perf_counter() - t_window
                     tokens_s = tokens_per_step * window_steps / dt
                     entry.update(step=self.step, tokens_per_sec=tokens_s)
@@ -451,8 +489,12 @@ class Trainer:
                     history.append(entry)
                     for cb in callbacks:
                         cb(self, entry)
+                    goodput.LEDGER.tick()
+                    capture.poll()
                     t_window = time.perf_counter()
                     window_steps = 0
+        capture.stop(jax.tree.leaves(self.state)[0]
+                     if self.state is not None else None)
         return {"history": history, "final_step": self.step}
 
 

@@ -1,0 +1,307 @@
+"""Alert-rules engine: threshold/absence/regression kinds, the
+collector's per-scrape evaluation, /api/v1/alerts, flight-recorder
+transitions, and the `tik alerts` CLI (fires on a degraded run, stays
+quiet on a healthy one)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from cloudtik_tpu import telemetry
+from cloudtik_tpu.runtimes.prometheus.alerts import (
+    AlertEngine, AlertRule, _histogram_quantile, default_alert_rules,
+    samples_from_exposition)
+from cloudtik_tpu.telemetry import events
+
+HEALTHY = """\
+tik_goodput_fraction{job="train"} 0.92
+tik_heartbeats_published_total 420
+"""
+
+DEGRADED = """\
+tik_goodput_fraction{job="train"} 0.21
+tik_heartbeats_published_total 420
+"""
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.enable()
+    telemetry.reset()
+    yield
+    telemetry.enable()
+    telemetry.reset()
+
+
+class TestRuleCatalog:
+    def test_names_unique_and_kinds_valid(self):
+        rules = default_alert_rules()
+        names = [r.name for r in rules]
+        assert len(names) == len(set(names))
+        assert {"GoodputLow", "StepTimeRegression", "HeartbeatAbsent",
+                "ServeTTFTHigh"} <= set(names)
+
+    def test_bad_kind_and_op_rejected(self):
+        with pytest.raises(ValueError, match="unknown kind"):
+            AlertRule(name="X", kind="nope", metric="tik_train_mfu",
+                      summary="s")
+        with pytest.raises(ValueError, match="unknown op"):
+            AlertRule(name="X", kind="threshold",
+                      metric="tik_train_mfu", summary="s", op="~")
+
+    def test_duplicate_rule_names_rejected(self):
+        rule = AlertRule(name="Dup", kind="threshold",
+                         metric="tik_train_mfu", summary="s")
+        with pytest.raises(ValueError, match="duplicate"):
+            AlertEngine([rule, rule])
+
+
+class TestThresholdAndAbsence:
+    def test_threshold_fires_after_for_cycles_and_resolves(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TIK_EVENTS_PATH",
+                           str(tmp_path / "events.jsonl"))
+        events.install()
+        try:
+            engine = AlertEngine()
+            degraded = samples_from_exposition(DEGRADED)
+            state = engine.evaluate(degraded)
+            by = {a["name"]: a for a in state}
+            assert by["GoodputLow"]["state"] == "pending"  # cycle 1 of 2
+            state = engine.evaluate(degraded)
+            by = {a["name"]: a for a in state}
+            assert by["GoodputLow"]["state"] == "firing"
+            assert by["GoodputLow"]["value"] == pytest.approx(0.21)
+            assert by["HeartbeatAbsent"]["state"] == "ok"
+            # recovery resolves and journals both transitions
+            state = engine.evaluate(samples_from_exposition(HEALTHY))
+            by = {a["name"]: a for a in state}
+            assert by["GoodputLow"]["state"] == "ok"
+            names = [e["name"] for e in events.read_events()]
+            assert "tik_alert_fired" in names
+            assert "tik_alert_resolved" in names
+            fired = [e for e in events.read_events()
+                     if e["name"] == "tik_alert_fired"]
+            assert fired[0]["rule"] == "GoodputLow"
+        finally:
+            events.uninstall()
+
+    def test_absence_fires_when_series_vanish(self):
+        engine = AlertEngine()
+        for _ in range(3):
+            state = engine.evaluate(
+                samples_from_exposition(
+                    'tik_goodput_fraction{job="train"} 0.9\n'))
+        by = {a["name"]: a["state"] for a in state}
+        assert by["HeartbeatAbsent"] == "firing"
+        assert by["GoodputLow"] == "ok"
+
+    def test_healthy_run_stays_quiet(self):
+        engine = AlertEngine()
+        for _ in range(4):
+            state = engine.evaluate(samples_from_exposition(HEALTHY))
+        assert all(a["state"] == "ok" for a in state)
+
+
+def _step_hist(counts_by_le):
+    lines = []
+    for le, count in counts_by_le.items():
+        lines.append(
+            f'tik_train_step_seconds_bucket{{le="{le}"}} {count}')
+    return "\n".join(lines) + "\n"
+
+
+class TestRegression:
+    def test_step_time_p95_regression_vs_rolling_baseline(self):
+        engine = AlertEngine()
+        # 5 baseline cycles: 100 fast observations (<=0.1s) per cycle
+        cumulative_fast = 0
+        state = []
+        for _cycle in range(5):
+            cumulative_fast += 100
+            text = HEALTHY + _step_hist({
+                "0.1": cumulative_fast, "1": cumulative_fast,
+                "2.5": cumulative_fast, "+Inf": cumulative_fast})
+            state = engine.evaluate(samples_from_exposition(text))
+        by = {a["name"]: a for a in state}
+        assert by["StepTimeRegression"]["state"] == "ok"
+        baseline_value = by["StepTimeRegression"]["value"]
+        assert baseline_value <= 0.1
+        # regression: two cycles whose NEW observations land in (1, 2.5]
+        slow = 0
+        for _cycle in range(2):
+            slow += 100
+            text = HEALTHY + _step_hist({
+                "0.1": cumulative_fast, "1": cumulative_fast,
+                "2.5": cumulative_fast + slow,
+                "+Inf": cumulative_fast + slow})
+            state = engine.evaluate(samples_from_exposition(text))
+        by = {a["name"]: a for a in state}
+        assert by["StepTimeRegression"]["state"] == "firing"
+        assert by["StepTimeRegression"]["value"] > 1.0
+
+    def test_regression_does_not_self_resolve(self):
+        """A sustained regression must keep firing: breaching values
+        never feed their own rolling baseline."""
+        engine = AlertEngine()
+        cumulative_fast = 0
+        for _cycle in range(5):
+            cumulative_fast += 100
+            text = HEALTHY + _step_hist({
+                "0.1": cumulative_fast, "1": cumulative_fast,
+                "2.5": cumulative_fast, "+Inf": cumulative_fast})
+            engine.evaluate(samples_from_exposition(text))
+        slow = 0
+        state = []
+        for _cycle in range(25):     # > the window=20 history size
+            slow += 100
+            text = HEALTHY + _step_hist({
+                "0.1": cumulative_fast, "1": cumulative_fast,
+                "2.5": cumulative_fast + slow,
+                "+Inf": cumulative_fast + slow})
+            state = engine.evaluate(samples_from_exposition(text))
+        by = {a["name"]: a for a in state}
+        assert by["StepTimeRegression"]["state"] == "firing"
+
+    def test_quantile_held_across_quiet_cycles(self):
+        """Zero bucket delta (a static exposition, a quiet window, a
+        flapped scrape) holds the last quantile instead of erasing the
+        streak — so `tik alerts eval` on one static file can fire
+        quantile rules."""
+        text = HEALTHY + (
+            'tik_serve_ttft_seconds_bucket{le="1"} 0\n'
+            'tik_serve_ttft_seconds_bucket{le="30"} 100\n'
+            'tik_serve_ttft_seconds_bucket{le="+Inf"} 100\n')
+        engine = AlertEngine()
+        state = []
+        for _cycle in range(3):      # same text: delta 0 after cycle 1
+            state = engine.evaluate(samples_from_exposition(text))
+        by = {a["name"]: a for a in state}
+        assert by["ServeTTFTHigh"]["state"] == "firing"
+        assert by["ServeTTFTHigh"]["value"] > 2.0
+
+    def test_no_data_cycle_holds_streak_and_firing_state(self):
+        engine = AlertEngine()
+        degraded = samples_from_exposition(DEGRADED)
+        for _ in range(2):
+            engine.evaluate(degraded)
+        # a cycle with NO goodput series (target flapped down) must
+        # not resolve the firing alert
+        state = engine.evaluate(
+            samples_from_exposition(
+                "tik_heartbeats_published_total 1\n"))
+        by = {a["name"]: a["state"] for a in state}
+        assert by["GoodputLow"] == "firing"
+
+    def test_quantile_interpolation(self):
+        buckets = [(0.1, 10.0), (1.0, 80.0), (10.0, 10.0),
+                   (float("inf"), 0.0)]
+        p50 = _histogram_quantile(0.5, buckets)
+        assert 0.1 < p50 < 1.0
+        p99 = _histogram_quantile(0.99, buckets)
+        assert 1.0 < p99 <= 10.0
+        assert _histogram_quantile(0.5, [(1.0, 0.0)]) is None
+
+
+class TestCollectorIntegration:
+    def _collector(self, tmp_path, text):
+        from cloudtik_tpu.runtimes.prometheus.collector import Collector
+        collector = Collector(str(tmp_path))
+        collector.state.update("10.0.0.3:9103", {"job": "telemetry"},
+                               text, None)
+        return collector
+
+    def test_evaluate_alerts_each_cycle_and_render_gauge(self,
+                                                         tmp_path):
+        collector = self._collector(tmp_path, DEGRADED)
+        for _ in range(2):
+            collector.evaluate_alerts()
+        firing = {a["name"] for a in collector.alerts.firing()}
+        assert "GoodputLow" in firing
+        text = collector.render_metrics()
+        assert 'tik_alerts_firing{rule="GoodputLow"} 1' in text
+        assert 'tik_alerts_firing{rule="ServeTTFTHigh"} 0' in text
+
+    def test_alert_samples_carry_target_labels(self, tmp_path):
+        collector = self._collector(tmp_path, HEALTHY)
+        samples = collector.alert_samples()
+        fraction = [s for s in samples
+                    if s["name"] == "tik_goodput_fraction"]
+        assert fraction[0]["labels"]["instance"] == "10.0.0.3:9103"
+        # the sample's own job label wins over the target's
+        assert fraction[0]["labels"]["job"] == "train"
+
+    def test_api_v1_alerts_endpoint(self, tmp_path):
+        from http.server import ThreadingHTTPServer
+
+        from cloudtik_tpu.runtimes.prometheus.collector import (
+            make_handler)
+        collector = self._collector(tmp_path, DEGRADED)
+        for _ in range(2):
+            collector.evaluate_alerts()
+        server = ThreadingHTTPServer(("127.0.0.1", 0),
+                                     make_handler(collector))
+        thread = threading.Thread(target=server.serve_forever,
+                                  daemon=True)
+        thread.start()
+        try:
+            port = server.server_address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/api/v1/alerts",
+                    timeout=5) as resp:
+                payload = json.loads(resp.read().decode())
+            alerts = {a["name"]: a
+                      for a in payload["data"]["alerts"]}
+            assert payload["status"] == "success"
+            assert alerts["GoodputLow"]["state"] == "firing"
+            assert alerts["GoodputLow"]["severity"] == "warning"
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
+class TestAlertsCLI:
+    def test_eval_fires_on_degraded_and_quiet_on_healthy(self,
+                                                         tmp_path):
+        from click.testing import CliRunner
+
+        from cloudtik_tpu.scripts.cli import cli
+        degraded = tmp_path / "degraded.txt"
+        degraded.write_text(DEGRADED)
+        healthy = tmp_path / "healthy.txt"
+        healthy.write_text(HEALTHY)
+        runner = CliRunner()
+
+        result = runner.invoke(cli, ["alerts", "eval", "--file",
+                                     str(degraded), "--json"])
+        assert result.exit_code == 0, result.output
+        by = {a["name"]: a["state"]
+              for a in json.loads(result.output)}
+        assert by["GoodputLow"] == "firing"
+
+        result = runner.invoke(
+            cli, ["alerts", "eval", "--file", str(degraded),
+                  "--fail-on-firing"])
+        assert result.exit_code == 2
+
+        result = runner.invoke(
+            cli, ["alerts", "eval", "--file", str(healthy),
+                  "--fail-on-firing"])
+        assert result.exit_code == 0, result.output
+        assert "firing" not in result.output.split("summary")[0] \
+            or "No rules firing" in result.output
+
+    def test_list_catalog(self):
+        from click.testing import CliRunner
+
+        from cloudtik_tpu.scripts.cli import cli
+        result = CliRunner().invoke(cli, ["alerts", "list",
+                                          "--catalog"])
+        assert result.exit_code == 0, result.output
+        for name in ("GoodputLow", "StepTimeRegression",
+                     "HeartbeatAbsent", "ServeTTFTHigh"):
+            assert name in result.output

@@ -1,0 +1,360 @@
+"""Goodput ledger + step profiler: where every TPU-second goes.
+
+The acceptance drill from the PR: train a few steps, checkpoint,
+inject a preemption (lose the newest checkpoint), resume — the ledger
+must show nonzero `compile`, `checkpoint_*`, and `restart_replay`
+buckets that sum to total wall time within 1%, and `tik goodput`
+prints the same breakdown from a snapshot or a live /metrics
+endpoint.  Plus: the disabled path stays a single attribute check
+(tripwire), replay-horizon reconstruction from the flight recorder,
+straggler detection, and the on-demand xprof capture window.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import pytest
+
+from cloudtik_tpu import telemetry
+from cloudtik_tpu.telemetry import core as tcore
+from cloudtik_tpu.telemetry import events, goodput, stepprof
+from cloudtik_tpu.telemetry import instruments as ti
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.enable()
+    telemetry.reset()
+    yield
+    telemetry.enable()
+    telemetry.reset()
+
+
+class TestLedger:
+    def test_buckets_sum_to_wall_and_fraction(self):
+        ledger = goodput.GoodputLedger(job="unit")
+        ledger.start_job(at=0.0)
+        ledger.attribute(goodput.BUCKET_STEP_COMPUTE, 6.0)
+        ledger.attribute(goodput.BUCKET_DATA_WAIT, 1.0)
+        ledger.attribute(goodput.BUCKET_COMPILE, 2.0)
+        snap = ledger.snapshot(now=10.0)
+        assert snap["wall_s"] == 10.0
+        assert snap["buckets"][goodput.BUCKET_IDLE] == pytest.approx(1.0)
+        assert snap["attributed_s"] == pytest.approx(snap["wall_s"])
+        assert snap["goodput_fraction"] == pytest.approx(0.6)
+
+    def test_counters_and_gauges_exported(self):
+        ledger = goodput.get_ledger("unit2")
+        ledger.start_job(at=0.0)
+        ledger.attribute(goodput.BUCKET_STEP_COMPUTE, 3.0)
+        ledger.tick(now=4.0)
+        assert ti.GOODPUT_SECONDS.value(
+            bucket="step_compute", job="unit2") == pytest.approx(3.0)
+        assert ti.GOODPUT_SECONDS.value(
+            bucket="idle", job="unit2") == pytest.approx(1.0)
+        assert ti.GOODPUT_WALL.value(job="unit2") == pytest.approx(4.0)
+        assert ti.GOODPUT_FRACTION.value(job="unit2") == \
+            pytest.approx(0.75)
+
+    def test_unknown_bucket_rejected(self):
+        ledger = goodput.GoodputLedger(job="unit3")
+        with pytest.raises(ValueError, match="unknown goodput bucket"):
+            ledger.attribute("nonsense", 1.0)
+
+    def test_fraction_clamped_when_overattributed(self):
+        ledger = goodput.GoodputLedger(job="unit4")
+        ledger.start_job(at=0.0)
+        ledger.attribute(goodput.BUCKET_STEP_COMPUTE, 100.0)
+        snap = ledger.snapshot(now=1.0)
+        assert 0.0 <= snap["goodput_fraction"] <= 1.0
+
+    def test_telemetry_reset_clears_ledgers(self):
+        ledger = goodput.get_ledger("unit5")
+        ledger.attribute(goodput.BUCKET_STEP_COMPUTE, 1.0)
+        telemetry.reset()
+        assert ledger.total(goodput.BUCKET_STEP_COMPUTE) == 0.0
+        assert ledger.wall_seconds() == 0.0
+
+    def test_disabled_path_is_free(self, monkeypatch):
+        def boom(*a, **k):
+            raise AssertionError("record path reached while disabled")
+
+        monkeypatch.setattr(tcore.Counter, "_record", boom)
+        monkeypatch.setattr(tcore.Gauge, "_record", boom)
+        monkeypatch.setattr(tcore.Histogram, "_record", boom)
+        telemetry.disable()
+        try:
+            ledger = goodput.GoodputLedger(job="off")
+            ledger.start_job()
+            ledger.attribute(goodput.BUCKET_STEP_COMPUTE, 1.0)
+            ledger.tick()
+            profiler = stepprof.StepProfiler(ledger)
+            profiler.dispatch_begin()
+            profiler.record_step(1, 0.1, 0.1, 0.1)
+            profiler.record_sync(1, 0.1)
+            assert ledger.wall_seconds() == 0.0
+            assert ledger.total(goodput.BUCKET_STEP_COMPUTE) == 0.0
+        finally:
+            telemetry.enable()
+
+
+class TestStepProfiler:
+    def test_segments_attribute_exactly(self):
+        ledger = goodput.GoodputLedger(job="prof")
+        ledger.start_job(at=0.0)
+        profiler = stepprof.StepProfiler(ledger, replay_until=2)
+        # steps 1-2 are replay, 3-4 are fresh; segments are synthetic
+        for step in (1, 2, 3, 4):
+            profiler.dispatch_begin()
+            profiler.record_step(step, 0.25, 0.05, 1.0)
+        profiler.record_sync(4, 0.5)
+        assert ledger.total(goodput.BUCKET_RESTART_REPLAY) == \
+            pytest.approx(2 * 1.30)
+        assert ledger.total(goodput.BUCKET_DATA_WAIT) == \
+            pytest.approx(2 * 0.25)
+        assert ledger.total(goodput.BUCKET_HOST_TRANSFER) == \
+            pytest.approx(2 * 0.05)
+        assert ledger.total(goodput.BUCKET_STEP_COMPUTE) == \
+            pytest.approx(2 * 1.0 + 0.5)
+        assert ti.TRAIN_DATA_WAIT_SECONDS.snapshot()["count"] == 4
+
+    def test_compile_seen_during_dispatch_is_subtracted(self):
+        ledger = goodput.GoodputLedger(job="prof2")
+        ledger.start_job(at=0.0)
+        profiler = stepprof.StepProfiler(ledger)
+        profiler.dispatch_begin()
+        # the compile listener fires mid-dispatch
+        ledger.attribute(goodput.BUCKET_COMPILE, 3.0)
+        profiler.record_step(1, 0.0, 0.0, 5.0)
+        assert ledger.total(goodput.BUCKET_COMPILE) == pytest.approx(3.0)
+        assert ledger.total(goodput.BUCKET_STEP_COMPUTE) == \
+            pytest.approx(2.0)   # 5.0 dispatch minus 3.0 compile
+
+    def test_compile_tracking_listener(self):
+        import jax
+        import jax.numpy as jnp
+        ledger = goodput.GoodputLedger(job="prof3")
+        assert stepprof.install_compile_tracking(ledger) is True
+        before = ledger.total(goodput.BUCKET_COMPILE)
+        compiles_before = ti.TRAIN_COMPILES.value()
+        jax.jit(lambda x: x * 3 + 1)(jnp.ones((7,)))
+        assert ledger.total(goodput.BUCKET_COMPILE) > before
+        assert ti.TRAIN_COMPILES.value() >= compiles_before + 1
+        # idempotent: a second install never double-registers
+        assert stepprof.install_compile_tracking(ledger) is True
+
+
+class TestReplayHorizon:
+    def test_reconstructed_from_checkpoint_commits(self, tmp_path,
+                                                   monkeypatch):
+        path = str(tmp_path / "events.jsonl")
+        monkeypatch.setenv("TIK_EVENTS_PATH", path)
+        events.install()
+        try:
+            events.emit("tik_checkpoint_commit", step=10, result="ok")
+            events.emit("tik_checkpoint_commit", step=20, result="ok")
+            events.emit("tik_checkpoint_commit", step=30,
+                        result="failed")
+            assert goodput.replay_horizon(10) == 30
+            assert goodput.replay_horizon(30) == 30
+            assert goodput.replay_horizon(99) == 99
+        finally:
+            events.uninstall()
+
+    def test_no_journal_means_no_replay(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TIK_EVENTS_PATH",
+                           str(tmp_path / "missing.jsonl"))
+        assert goodput.replay_horizon(7) == 7
+
+    def test_directory_filter_scopes_out_other_jobs(self, tmp_path,
+                                                    monkeypatch):
+        """The journal is shared per node and outlives runs: a commit
+        from an unrelated earlier job must not inflate THIS job's
+        replay horizon."""
+        monkeypatch.setenv("TIK_EVENTS_PATH",
+                           str(tmp_path / "events.jsonl"))
+        events.install()
+        try:
+            events.emit("tik_checkpoint_commit", step=5000,
+                        result="ok", directory="/ckpts/old-job")
+            events.emit("tik_checkpoint_commit", step=120,
+                        result="ok", directory="/ckpts/this-job")
+            events.emit("tik_checkpoint_commit", step=9000,
+                        result="ok")     # legacy record, no directory
+            assert goodput.replay_horizon(
+                100, directory="/ckpts/this-job") == 120
+            # unfiltered scan still sees everything (legacy behavior)
+            assert goodput.replay_horizon(100) == 9000
+        finally:
+            events.uninstall()
+
+
+class TestStragglers:
+    def test_detects_lagging_host(self):
+        progress = {
+            "w-1": {"step": 100, "time": 1000.0},
+            "w-2": {"step": 100, "time": 1001.5},
+            "w-3": {"step": 80, "time": 950.0},     # stale + behind
+        }
+        report = stepprof.detect_stragglers(progress, now=1002.0,
+                                            lag_threshold_s=10.0)
+        assert report["max_step"] == 100
+        assert report["lags"]["w-1"] == 0.0
+        assert report["lags"]["w-2"] == pytest.approx(1.5)
+        assert report["lags"]["w-3"] == pytest.approx(52.0)
+        assert report["stragglers"] == ["w-3"]
+        assert ti.TRAIN_STRAGGLER_LAG.value() == pytest.approx(52.0)
+
+    def test_empty_progress(self):
+        report = stepprof.detect_stragglers({})
+        assert report["stragglers"] == [] and report["max_step"] is None
+
+
+class TestProfileCaptureRequest:
+    def test_request_roundtrip(self, tmp_path):
+        path = str(tmp_path / "req.json")
+        out = str(tmp_path / "xprof")
+        written = stepprof.request_capture(3, out, path)
+        assert written == path and os.path.exists(path)
+        request = stepprof.take_request(path)
+        assert request["steps"] == 3
+        assert request["output_dir"] == out
+        assert not os.path.exists(path)       # consumed
+        assert stepprof.take_request(path) is None
+
+    def test_capture_cli_writes_request(self, tmp_path):
+        from click.testing import CliRunner
+
+        from cloudtik_tpu.scripts.cli import cli
+        path = str(tmp_path / "req.json")
+        result = CliRunner().invoke(cli, [
+            "profile", "capture", "--steps", "2",
+            "-o", str(tmp_path / "prof"), "--request-path", path])
+        assert result.exit_code == 0, result.output
+        assert json.load(open(path))["steps"] == 2
+
+
+def _make_trainer(ckpt_dir):
+    from cloudtik_tpu.models import transformer as T
+    from cloudtik_tpu.parallel.mesh import MeshConfig
+    from cloudtik_tpu.train.optim import OptimizerConfig
+    from cloudtik_tpu.train.trainer import (
+        Trainer, TrainerConfig, transformer_spec)
+    cfg = T.config("tiny", attention_impl="reference")
+    return cfg, Trainer(transformer_spec(cfg), TrainerConfig(
+        global_batch_size=8, seq_len=16,
+        mesh=MeshConfig(data=2, fsdp=4),
+        optimizer=OptimizerConfig(learning_rate=1e-3),
+        log_every=2, checkpoint_every=2,
+        checkpoint_dir=str(ckpt_dir)))
+
+
+@pytest.mark.chaos
+class TestRestartReplayDrill:
+    """Preemption + resume-from-older-checkpoint: the ledger books the
+    re-run steps as restart_replay and everything sums to wall."""
+
+    def test_replay_accounting_end_to_end(self, tmp_path, monkeypatch):
+        from cloudtik_tpu.train.data import synthetic_lm_batches
+        monkeypatch.setenv("TIK_EVENTS_PATH",
+                           str(tmp_path / "events.jsonl"))
+        events.install()
+        ckpt = tmp_path / "ckpt"
+        try:
+            cfg, trainer = _make_trainer(ckpt)
+            data = synthetic_lm_batches(8, 16, cfg.vocab_size, seed=0)
+            trainer.fit(data, num_steps=4)      # commits at 2 and 4
+            trainer.checkpointer.wait()
+            assert trainer.checkpointer.all_steps() == [2, 4]
+            trainer.checkpointer.close()
+            # the preemption: the newest checkpoint is lost (a torn
+            # write / dead host), but the journal remembers step 4 ran
+            shutil.rmtree(str(ckpt / "4"))
+
+            _cfg, resumed = _make_trainer(ckpt)
+            assert resumed.maybe_resume() == 2
+            assert resumed._replay_until == 4
+            resumed.fit(data, num_steps=4)      # 3,4 replay; 5,6 new
+            resumed.checkpointer.wait()
+            resumed.checkpointer.close()
+
+            snap = goodput.LEDGER.snapshot()
+            buckets = snap["buckets"]
+            assert buckets[goodput.BUCKET_RESTART_REPLAY] > 0
+            assert buckets[goodput.BUCKET_COMPILE] > 0
+            assert buckets[goodput.BUCKET_CHECKPOINT_SAVE] > 0
+            assert buckets[goodput.BUCKET_CHECKPOINT_RESTORE] > 0
+            assert buckets[goodput.BUCKET_DATA_WAIT] > 0
+            # the acceptance bar: buckets sum to wall within 1%
+            assert abs(snap["attributed_s"] - snap["wall_s"]) <= \
+                0.01 * snap["wall_s"]
+            # the resume decision is journaled with its horizon
+            resumes = [e for e in events.read_events()
+                       if e["name"] == "tik_train_resume"]
+            assert resumes and resumes[-1]["replay_until"] == 4
+        finally:
+            events.uninstall()
+
+    def test_goodput_cli_from_snapshot_and_metrics(self, tmp_path,
+                                                   monkeypatch):
+        """`tik goodput` prints the breakdown from a ledger snapshot
+        file AND from a live /metrics endpoint."""
+        from click.testing import CliRunner
+
+        import time
+
+        from cloudtik_tpu.scripts.cli import cli
+        from cloudtik_tpu.telemetry import http as telemetry_http
+        ledger = goodput.LEDGER
+        ledger.start_job()
+        time.sleep(0.12)   # real elapsed wall the attribution fits in
+        ledger.attribute(goodput.BUCKET_STEP_COMPUTE, 0.06)
+        ledger.attribute(goodput.BUCKET_COMPILE, 0.02)
+        snapshot_path = str(tmp_path / "run.json")
+        ledger.write_snapshot(snapshot_path)
+
+        runner = CliRunner()
+        result = runner.invoke(cli, ["goodput", "--file", snapshot_path,
+                                     "--json"])
+        assert result.exit_code == 0, result.output
+        record = json.loads(result.output)[0]
+        assert record["buckets"]["step_compute"] == pytest.approx(0.06)
+        assert abs(record["attributed_s"] - record["wall_s"]) <= \
+            0.01 * max(record["wall_s"], 1e-9)
+
+        server = telemetry_http.start_server(0, host="127.0.0.1")
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            result = runner.invoke(
+                cli, ["goodput", "--url", url, "--json",
+                      "--job", ledger.job])
+            assert result.exit_code == 0, result.output
+            live = json.loads(result.output)[0]
+            assert live["buckets"]["step_compute"] >= 0.06
+            result = runner.invoke(cli, ["goodput", "--url", url])
+            assert result.exit_code == 0, result.output
+            assert "step_compute" in result.output
+            assert "goodput:" in result.output
+        finally:
+            server.stop()
+
+    def test_snapshot_env_written_by_fit(self, tmp_path, monkeypatch):
+        from cloudtik_tpu.models import transformer as T
+        from cloudtik_tpu.parallel.mesh import MeshConfig
+        from cloudtik_tpu.train.data import synthetic_lm_batches
+        from cloudtik_tpu.train.trainer import (
+            Trainer, TrainerConfig, transformer_spec)
+        snap_path = str(tmp_path / "goodput.json")
+        monkeypatch.setenv(goodput.SNAPSHOT_ENV, snap_path)
+        cfg = T.config("tiny", attention_impl="reference")
+        trainer = Trainer(transformer_spec(cfg), TrainerConfig(
+            global_batch_size=8, seq_len=16,
+            mesh=MeshConfig(data=2, fsdp=4), log_every=2))
+        data = synthetic_lm_batches(8, 16, cfg.vocab_size, seed=1)
+        trainer.fit(data, num_steps=2)
+        snap = json.load(open(snap_path))
+        assert snap["buckets"]["step_compute"] > 0
+        assert snap["wall_s"] > 0

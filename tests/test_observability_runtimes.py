@@ -138,6 +138,42 @@ class TestCollector:
             'tik_node_memory_percent{foo="nope"}') == []
         assert collector.instant_query("not a query {") == []
 
+    def test_instant_query_negative_and_regex_matchers(self, collector):
+        """PR 4: `!=`, `=~` (and `!~`) matchers for the alert engine."""
+        labels = {"job": "nodex", "cluster": "c"}
+        collector.state.update("10.0.0.3:9100", labels, NODEX_TEXT, None)
+        collector.state.update("10.0.0.4:9100", labels,
+                               NODEX_TEXT.replace('foo="bar"',
+                                                  'foo="baz"'), None)
+        # != narrows away one series
+        result = collector.instant_query(
+            'tik_node_memory_percent{foo!="bar"}')
+        assert len(result) == 1
+        assert result[0]["metric"]["foo"] == "baz"
+        # != on an ABSENT label matches (absent reads as "")
+        result = collector.instant_query(
+            'tik_node_cpu_percent{nope!="x"}')
+        assert len(result) == 2
+        # =~ is fully anchored, character classes work
+        result = collector.instant_query(
+            'tik_node_memory_percent{foo=~"ba[rz]"}')
+        assert len(result) == 2
+        assert collector.instant_query(
+            'tik_node_memory_percent{foo=~"ba"}') == []
+        # !~ inverts
+        result = collector.instant_query(
+            'tik_node_memory_percent{foo!~"bar"}')
+        assert len(result) == 1
+        assert result[0]["metric"]["foo"] == "baz"
+        # matchers compose against target labels + instance too
+        result = collector.instant_query(
+            'tik_node_cpu_percent{instance=~"10\\.0\\.0\\.[34]:9100",'
+            'job!="other"}')
+        assert len(result) == 2
+        # an invalid regex is empty, not an error
+        assert collector.instant_query(
+            'tik_node_cpu_percent{foo=~"["}') == []
+
     def test_scrape_duration_per_target(self, collector, tmp_path):
         """scrape_once records wall time per target — up or down —
         and render_metrics exposes it as scrape_duration_seconds."""
@@ -196,7 +232,7 @@ class TestCollector:
 class TestDashboards:
     def _metric_tokens(self, dashboard):
         exprs = [t["expr"] for p in dashboard["panels"]
-                 for t in p["targets"]]
+                 for t in p.get("targets", [])]   # rows have none
         return set(re.findall(r"\btik_[a-z0-9_]+\b", " ".join(exprs)))
 
     def test_dashboards_reference_only_cataloged_metrics(self):

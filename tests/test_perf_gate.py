@@ -1,0 +1,175 @@
+"""tools/perf_gate.py (the bench regression gate) and bench.py's
+device-probe diagnostics (the BENCH_r05 fix): synthetic trajectories
+for the gate, fake probe children for the diagnostics + process-group
+kill."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _load(path, name):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def perf_gate():
+    return _load(REPO / "tools" / "perf_gate.py", "perf_gate")
+
+
+def _trajectory(tmp_path, values, metric="m"):
+    paths = []
+    for i, value in enumerate(values):
+        path = tmp_path / f"BENCH_r{i:02d}.json"
+        if value is None:     # a failed run
+            record = {"n": i, "rc": 0, "parsed": {
+                "metric": metric, "value": 0.0,
+                "error": "bench failed"}}
+        else:
+            record = {"n": i, "rc": 0, "parsed": {
+                "metric": metric, "value": value, "unit": "% MFU"}}
+        path.write_text(json.dumps(record))
+        paths.append(str(path))
+    return paths
+
+
+class TestGate:
+    def test_within_threshold_passes(self, perf_gate, tmp_path):
+        history = perf_gate.load_history(
+            _trajectory(tmp_path, [48.4, 47.9, 48.1]))
+        code, report = perf_gate.gate(
+            {"metric": "m", "value": 46.0}, history, 10.0)
+        assert code == 0 and report["status"] == "ok"
+        assert report["baseline"] == pytest.approx(48.1)
+
+    def test_regression_fails(self, perf_gate, tmp_path):
+        history = perf_gate.load_history(
+            _trajectory(tmp_path, [48.4, 47.9, 48.1]))
+        code, report = perf_gate.gate(
+            {"metric": "m", "value": 40.0}, history, 10.0)
+        assert code == 1 and report["status"] == "fail"
+        assert "regression" in report["reason"]
+
+    def test_all_failed_history_skips_cleanly(self, perf_gate,
+                                              tmp_path):
+        history = perf_gate.load_history(
+            _trajectory(tmp_path, [None, None]))
+        code, report = perf_gate.gate(
+            {"metric": "m", "value": 1.0}, history, 10.0)
+        assert code == 0 and report["status"] == "skip"
+
+    def test_empty_history_skips_cleanly(self, perf_gate):
+        code, report = perf_gate.gate({"metric": "m", "value": 1.0},
+                                      [], 10.0)
+        assert code == 0 and report["status"] == "skip"
+
+    def test_failed_fresh_run_fails_when_history_exists(self,
+                                                        perf_gate,
+                                                        tmp_path):
+        history = perf_gate.load_history(_trajectory(tmp_path, [48.0]))
+        code, report = perf_gate.gate(
+            {"metric": "m", "value": 0.0, "error": "boom"},
+            history, 10.0)
+        assert code == 1 and report["status"] == "fail"
+
+    def test_history_filters_by_metric(self, perf_gate, tmp_path):
+        _trajectory(tmp_path, [10.0], metric="other")
+        paths = [str(p) for p in tmp_path.glob("BENCH_*.json")]
+        assert perf_gate.load_history(paths, metric="mine") == []
+
+    def test_main_reads_fresh_file_with_comment_lines(self, perf_gate,
+                                                      tmp_path):
+        _trajectory(tmp_path, [48.0, 48.2])
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text("# tokens/sec=15749 batch=8\n"
+                         + json.dumps({"metric": "m", "value": 47.5}))
+        code = perf_gate.main([
+            "--fresh", str(fresh),
+            "--history", str(tmp_path / "BENCH_*.json")])
+        assert code == 0
+        fresh.write_text(json.dumps({"metric": "m", "value": 10.0}))
+        code = perf_gate.main([
+            "--fresh", str(fresh),
+            "--history", str(tmp_path / "BENCH_*.json"), "--json"])
+        assert code == 1
+
+    def test_main_rejects_unreadable_fresh(self, perf_gate, tmp_path):
+        missing = tmp_path / "nope.json"
+        assert perf_gate.main(["--fresh", str(missing)]) == 2
+
+
+@pytest.fixture(scope="module")
+def bench():
+    return _load(REPO / "bench.py", "bench_mod")
+
+
+class TestBenchProbeDiagnostics:
+    def test_wedged_probe_is_killed_with_its_group(self, bench):
+        """A hung child (wedged libtpu) must die with its process
+        group inside the timeout, and the diagnostics must say so."""
+        t0 = time.monotonic()
+        ok, diagnostics = bench.probe_devices_once(
+            probe_s=0.5,
+            probe_cmd=[sys.executable, "-c",
+                       "import time; time.sleep(60)"])
+        assert time.monotonic() - t0 < 10
+        assert ok is False
+        assert diagnostics["timed_out"] is True
+        assert "timed out" in diagnostics["error"]
+        assert "process group killed" in diagnostics["error"]
+
+    def test_failure_diagnostics_carry_phase_and_env(self, bench):
+        """An init failure reports the phase reached, JAX_PLATFORMS,
+        and the exception — actionable, not 'probe timed out'."""
+        child = (
+            "import json\n"
+            "print('PROBE:' + json.dumps({'phase': 'import',"
+            " 'jax_platforms': 'tpu', 'libtpu_present': False}))\n"
+            "print('PROBE:' + json.dumps({'phase': 'device_init',"
+            " 'error': 'RuntimeError: no TPU found'}))\n"
+            "raise SystemExit(3)\n")
+        ok, diagnostics = bench.probe_devices_once(
+            probe_s=10, probe_cmd=[sys.executable, "-c", child])
+        assert ok is False
+        assert diagnostics["phase"] == "device_init"
+        assert diagnostics["error"] == "RuntimeError: no TPU found"
+        assert diagnostics["libtpu_present"] is False
+        assert diagnostics["returncode"] == 3
+
+    def test_successful_probe_reports_devices(self, bench):
+        child = (
+            "import json\n"
+            "print('PROBE:' + json.dumps({'phase': 'done',"
+            " 'devices': ['FakeDevice(id=0)']}))\n")
+        ok, diagnostics = bench.probe_devices_once(
+            probe_s=10, probe_cmd=[sys.executable, "-c", child])
+        assert ok is True
+        assert diagnostics["devices"] == ["FakeDevice(id=0)"]
+
+    def test_run_device_probe_raises_with_diagnostics(self, bench):
+        with pytest.raises(bench.DeviceProbeError) as excinfo:
+            bench.run_device_probe(
+                probe_s=0.3, budget_s=0.5, retry_wait_s=0.1,
+                probe_cmd=[sys.executable, "-c", "raise SystemExit(9)"])
+        diagnostics = excinfo.value.diagnostics
+        assert diagnostics["returncode"] == 9
+        assert diagnostics["attempts"] >= 1
+
+    def test_real_probe_script_succeeds_on_cpu(self, bench):
+        """The actual _PROBE_SRC child on this container's CPU jax."""
+        ok, diagnostics = bench.probe_devices_once(probe_s=120)
+        assert ok is True, diagnostics
+        assert diagnostics["phase"] == "done"
+        assert diagnostics["devices"]
+        assert diagnostics["jax_platforms"] == "cpu"

@@ -221,6 +221,11 @@ class TestServeDrill:
         assert ti.SERVE_TPOT.snapshot()["count"] >= 1
         assert ti.SERVE_QUEUE_WAIT.snapshot()["count"] >= 1
         assert ti.SERVE_REQUESTS.value(result="ok") >= 1
+        # serve-side goodput: decode-step wall split into busy vs idle
+        from cloudtik_tpu.telemetry import goodput
+        serve_ledger = goodput.get_ledger("serve")
+        assert serve_ledger.total(goodput.BUCKET_STEP_COMPUTE) > 0
+        assert ti.SERVE_SLOT_IDLE_FRACTION.value() is not None
 
     def test_cancel_frees_slot(self, engine):
         from cloudtik_tpu.serve.engine import Request, RequestCancelled

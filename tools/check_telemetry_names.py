@@ -22,7 +22,11 @@ cloudtik_tpu/telemetry/names.py:
      every name matches ``tik_[a-z0-9_]+`` and collides with no metric,
      is declared exactly once, every ``events.emit("...")`` literal in
      the source is cataloged, every cataloged event is emitted
-     somewhere, and docs/observability.md documents all of them.
+     somewhere, and docs/observability.md documents all of them;
+  8. the alert-rule catalog (runtimes/prometheus/alerts.py
+     default_alert_rules): rule names are unique, every referenced
+     metric resolves against the catalog, and docs/observability.md
+     documents every rule by name.
 
 Run: ``python tools/check_telemetry_names.py`` (exit 1 on failure).
 """
@@ -183,20 +187,37 @@ def run_checks() -> List[str]:
     # 5. grafana dashboards + prometheus alert rules resolve — against
     # METRICS only: an event is a journal record, never a Prometheus
     # series, so a panel/alert naming one would render "no data"
+    import dataclasses
+
     from cloudtik_tpu.runtimes.grafana.dashboards import (
         ai_workload_dashboard, cluster_overview_dashboard)
-    from cloudtik_tpu.runtimes.prometheus.alerts import default_rules
+    from cloudtik_tpu.runtimes.prometheus.alerts import (
+        default_alert_rules, default_rules)
     known = set(METRICS)
+    alert_rules = default_alert_rules()
     for label, blob in (
             ("dashboard tik-cluster-overview",
              json.dumps(cluster_overview_dashboard())),
             ("dashboard tik-ai-workloads",
              json.dumps(ai_workload_dashboard())),
-            ("prometheus alert rules", json.dumps(default_rules()))):
+            ("prometheus alert rules", json.dumps(default_rules())),
+            ("alert engine catalog",
+             json.dumps([dataclasses.asdict(r) for r in alert_rules]))):
         for token in set(METRIC_TOKEN_RE.findall(blob)):
             if not _resolves(token, known):
                 errors.append(f"{label}: expression references unknown "
                               f"metric {token!r}")
+
+    # 8. alert-rule catalog: unique names, resolvable metrics, docs
+    rule_names = [r.name for r in alert_rules]
+    for name in sorted({n for n in rule_names
+                        if rule_names.count(n) > 1}):
+        errors.append(f"alert rule {name!r} declared more than once in "
+                      "default_alert_rules()")
+    for rule in alert_rules:
+        if not _resolves(rule.metric, known):
+            errors.append(f"alert rule {rule.name!r} references "
+                          f"unknown metric {rule.metric!r}")
 
     # 6. docs catalog coverage
     doc_path = os.path.join(REPO_ROOT, "docs", "observability.md")
@@ -222,6 +243,10 @@ def run_checks() -> List[str]:
             if not _resolves(token, known | set(EVENTS)):
                 errors.append("docs/observability.md references unknown "
                               f"metric {token!r}")
+        for rule in alert_rules:
+            if rule.name not in doc:
+                errors.append("docs/observability.md does not document "
+                              f"alert rule {rule.name}")
     return errors
 
 
@@ -232,10 +257,13 @@ def main() -> int:
             print(f"FAIL: {error}")
         print(f"{len(errors)} telemetry-name problem(s).")
         return 1
+    from cloudtik_tpu.runtimes.prometheus.alerts import (
+        default_alert_rules)
     from cloudtik_tpu.telemetry.names import EVENTS, METRICS, SPANS
     print(f"OK: {len(METRICS)} metrics, {len(SPANS)} spans, "
-          f"{len(EVENTS)} events — catalog, registry, source, "
-          "dashboards, and docs all agree.")
+          f"{len(EVENTS)} events, {len(default_alert_rules())} alert "
+          "rules — catalog, registry, source, dashboards, and docs "
+          "all agree.")
     return 0
 
 

@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Perf regression gate over the committed bench trajectory.
+
+Compares a fresh bench/benchmark JSON line against the committed
+``BENCH_*.json`` history and exits non-zero on a regression larger
+than ``--threshold-pct``.  The other half of the goodput story: the
+ledger says where the seconds went; this gate refuses to merge a
+change that makes there be more of them.
+
+Accepted fresh-line shapes (bench.py's output, or a committed
+trajectory entry wrapping it):
+
+  {"metric": "...", "value": 48.4, "unit": "% MFU", ...}
+  {"n": 6, "rc": 0, "parsed": {"metric": "...", "value": 48.4, ...}}
+
+History entries whose run failed (no ``parsed``, an ``error`` field,
+or a non-positive value) are skipped; when the WHOLE history is
+failed/empty the gate **skips cleanly** (exit 0) — a gate with no
+usable baseline must not block the first good run.  The baseline is
+the median of the surviving history values (robust to one lucky or
+unlucky run); regression means the fresh value is more than X% below
+it.  Higher is assumed better (MFU, tokens/sec).
+
+Run:  python tools/perf_gate.py --fresh fresh.json
+      python tools/perf_gate.py --fresh - < bench_output.json
+Exit: 0 ok/skip, 1 regression (or failed fresh run), 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import statistics
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_HISTORY_GLOB = "BENCH_*.json"
+DEFAULT_THRESHOLD_PCT = 10.0
+
+
+def extract_result(record: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Normalize a bench line / trajectory entry to its parsed result
+    ({metric, value, ...}); None when the run failed or is malformed."""
+    if not isinstance(record, dict):
+        return None
+    parsed = record.get("parsed", record)
+    if not isinstance(parsed, dict):
+        return None
+    if parsed.get("error"):
+        return None
+    value = parsed.get("value")
+    if not isinstance(value, (int, float)) or value <= 0:
+        return None
+    return parsed
+
+
+def load_history(paths: List[str],
+                 metric: Optional[str] = None
+                 ) -> List[Tuple[str, float]]:
+    """(path, value) for every usable history entry, sorted by path."""
+    out: List[Tuple[str, float]] = []
+    for path in sorted(paths):
+        try:
+            with open(path) as f:
+                record = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = extract_result(record)
+        if parsed is None:
+            continue
+        if metric is not None and parsed.get("metric") not in (None,
+                                                               metric):
+            continue
+        out.append((path, float(parsed["value"])))
+    return out
+
+
+def gate(fresh: Dict[str, Any], history: List[Tuple[str, float]],
+         threshold_pct: float = DEFAULT_THRESHOLD_PCT
+         ) -> Tuple[int, Dict[str, Any]]:
+    """(exit_code, report).  0 ok/skip, 1 regression/failed fresh."""
+    report: Dict[str, Any] = {"threshold_pct": threshold_pct,
+                              "history_points": len(history)}
+    if not history:
+        report.update(status="skip",
+                      reason="no usable history (empty or all-failed "
+                             "trajectory)")
+        return 0, report
+    parsed = extract_result(fresh)
+    baseline = statistics.median(v for _p, v in history)
+    report["baseline"] = baseline
+    if parsed is None:
+        report.update(status="fail",
+                      reason="fresh run failed or carries no positive "
+                             "value — cannot pass a perf gate with no "
+                             "measurement")
+        return 1, report
+    value = float(parsed["value"])
+    floor = baseline * (1.0 - threshold_pct / 100.0)
+    report.update(metric=parsed.get("metric"), value=value, floor=floor)
+    if value < floor:
+        drop = (baseline - value) / baseline * 100.0
+        report.update(status="fail",
+                      reason=f"regression: {value:.4g} is "
+                             f"{drop:.1f}% below the {baseline:.4g} "
+                             f"baseline (allowed {threshold_pct}%)")
+        return 1, report
+    report.update(status="ok",
+                  reason=f"{value:.4g} within {threshold_pct}% of "
+                         f"baseline {baseline:.4g}")
+    return 0, report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="fail on bench regressions vs the committed "
+                    "trajectory")
+    parser.add_argument("--fresh", required=True,
+                        help="fresh bench JSON line: a file path, or "
+                             "'-' for stdin")
+    parser.add_argument("--history", default=None,
+                        help="glob of trajectory files (default: "
+                             f"{DEFAULT_HISTORY_GLOB} in the repo "
+                             "root)")
+    parser.add_argument("--threshold-pct", type=float,
+                        default=DEFAULT_THRESHOLD_PCT,
+                        help="allowed drop below the baseline median "
+                             "(default %(default)s)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the report as JSON")
+    args = parser.parse_args(argv)
+
+    try:
+        raw = sys.stdin.read() if args.fresh == "-" else \
+            open(args.fresh).read()
+        # bench.py writes stderr commentary lines starting with '#'
+        # alongside the one JSON line; take the first parseable line
+        fresh = None
+        for line in raw.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                fresh = json.loads(line)
+                break
+            except ValueError:
+                continue
+        if fresh is None:
+            raise ValueError("no JSON line found")
+    except (OSError, ValueError) as e:
+        print(f"perf_gate: cannot read fresh result: {e}",
+              file=sys.stderr)
+        return 2
+
+    pattern = args.history or os.path.join(REPO_ROOT,
+                                           DEFAULT_HISTORY_GLOB)
+    parsed_fresh = extract_result(fresh) or {}
+    history = load_history(glob.glob(pattern),
+                           metric=parsed_fresh.get("metric"))
+    code, report = gate(fresh, history, args.threshold_pct)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(f"perf_gate: {report['status']} — {report['reason']} "
+              f"({report['history_points']} history point(s))")
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())
