@@ -162,6 +162,11 @@ def run_bench(model: str = "tpu_1b", seq_len: int = 2048,
     from cloudtik_tpu.train.optim import OptimizerConfig
     from cloudtik_tpu.train.trainer import (
         Trainer, TrainerConfig, device_peak_flops, transformer_spec)
+    from cloudtik_tpu.utils.compile_cache import ensure_compile_cache
+
+    # reruns on the same host deserialize the flagship step instead of
+    # recompiling it (TIK_COMPILE_CACHE_DIR; the warmup window shrinks)
+    ensure_compile_cache()
 
     cfg = T.config(model, max_seq_len=seq_len, param_dtype=jnp.bfloat16)
     spec = transformer_spec(cfg)
@@ -216,7 +221,35 @@ def run_bench(model: str = "tpu_1b", seq_len: int = 2048,
     raise RuntimeError(f"all batch sizes failed: {last_err}")
 
 
-def main():
+# Satellite benchmarks runnable through this entry point.  Each prints
+# its own perf_gate-compatible JSON line (distinct "metric" name, so the
+# gate medians each trajectory separately):
+#   python bench.py --suite input_pipeline | python tools/perf_gate.py --fresh -
+SUITES = {
+    "input_pipeline": "input_pipeline_bench.py",
+    "telemetry_overhead": "telemetry_overhead.py",
+}
+
+
+def run_suite(name: str) -> int:
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarks", SUITES[name])
+    return subprocess.call([sys.executable, script])
+
+
+def main(argv=None):
+    import argparse
+    parser = argparse.ArgumentParser(
+        description="tik benchmark suite (default: flagship MFU)")
+    parser.add_argument(
+        "--suite", choices=["flagship", *sorted(SUITES)],
+        default="flagship",
+        help="which benchmark to run; non-flagship suites need no "
+             "device probe (they run on CPU and TPU alike)")
+    args = parser.parse_args(argv)
+    if args.suite != "flagship":
+        return run_suite(args.suite)
+
     # Watchdog: a wedged device grant (the axon tunnel can stick for a
     # while after a killed TPU process) would otherwise hang forever with
     # no JSON line at all; better to emit the failure record.

@@ -59,6 +59,13 @@ def main() -> int:
     _prof = stepprof.StepProfiler(goodput.LEDGER)
     disabled_step_record_ns = _ns(
         lambda: _prof.record_step(1, 0.001, 0.001, 0.01), n)
+    # the async input pipeline's per-batch instrumentation (queue-depth
+    # gauge + stall/wait histograms) must be attribute-check cheap too
+    from cloudtik_tpu.train import prefetch as _prefetch
+    disabled_prefetch_note_ns = _ns(
+        lambda: _prefetch._note_get(0.001, 2), n)
+    disabled_prefetch_put_note_ns = _ns(
+        lambda: _prefetch._note_put(0.001, 2), n)
 
     telemetry.enable()
     telemetry.reset()
@@ -99,6 +106,10 @@ def main() -> int:
                 round(disabled_goodput_attr_ns, 1),
             "disabled_step_record_ns":
                 round(disabled_step_record_ns, 1),
+            "disabled_prefetch_consumer_note_ns":
+                round(disabled_prefetch_note_ns, 1),
+            "disabled_prefetch_producer_note_ns":
+                round(disabled_prefetch_put_note_ns, 1),
             "enabled_span_ns": round(enabled_span_ns, 1),
             "enabled_counter_inc_ns": round(enabled_counter_ns, 1),
             "enabled_histogram_observe_ns":

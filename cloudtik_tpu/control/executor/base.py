@@ -8,11 +8,13 @@ LocalCommandExecutor :23).
 
 from __future__ import annotations
 
+import os
 import subprocess
 from typing import Any, Dict, List, Optional
 
 from cloudtik_tpu import telemetry
 from cloudtik_tpu.telemetry import instruments as ti
+from cloudtik_tpu.utils import compile_cache
 
 
 class CommandError(RuntimeError):
@@ -96,11 +98,20 @@ def _propagation_env(span, env: Optional[Dict[str, str]]
     span's traceparent into the command environment, so the child
     process adopts it (telemetry.adopt_traceparent_from_env) and its
     spans join the head-side trace that issued the command.  With
-    telemetry disabled `span` is the noop span and this returns `env`
-    untouched."""
+    telemetry disabled `span` is the noop span and the traceparent is
+    not exported.
+
+    TIK_COMPILE_CACHE_DIR rides along the same way when the operator
+    set it (including an explicit "off"): every worker then shares the
+    head's persistent-XLA-cache setting without per-node config."""
+    merged = None
     traceparent = getattr(span, "traceparent", None)
-    if traceparent is None:
-        return env
-    merged = dict(env or {})
-    merged.setdefault(telemetry.TRACEPARENT_ENV, traceparent)
-    return merged
+    if traceparent is not None:
+        merged = dict(env or {})
+        merged.setdefault(telemetry.TRACEPARENT_ENV, traceparent)
+    cache_dir = os.environ.get(compile_cache.CACHE_DIR_ENV)
+    if cache_dir is not None:
+        if merged is None:
+            merged = dict(env or {})
+        merged.setdefault(compile_cache.CACHE_DIR_ENV, cache_dir)
+    return env if merged is None else merged

@@ -266,6 +266,10 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=8200)
     args = p.parse_args(argv)
 
+    # warm restarts skip prefill/decode recompiles (TIK_COMPILE_CACHE_DIR)
+    from cloudtik_tpu.utils.compile_cache import ensure_compile_cache
+    ensure_compile_cache()
+
     backends = []
     if args.gbdt:
         backends.append(gbdt_backend(args.gbdt))

@@ -73,6 +73,12 @@ TRAIN_HOST_TRANSFER_SECONDS: Histogram = _build(
 TRAIN_DISPATCH_SECONDS: Histogram = _build("tik_train_dispatch_seconds")
 TRAIN_COMPILES: Counter = _build("tik_train_compiles_total")
 TRAIN_STRAGGLER_LAG: Gauge = _build("tik_train_straggler_lag_seconds")
+TRAIN_PREFETCH_QUEUE_DEPTH: Gauge = _build("tik_train_prefetch_queue_depth")
+TRAIN_PREFETCH_CONSUMER_WAIT: Histogram = _build(
+    "tik_train_prefetch_consumer_wait_seconds")
+TRAIN_PREFETCH_PRODUCER_STALL: Histogram = _build(
+    "tik_train_prefetch_producer_stall_seconds")
+TRAIN_PREFETCH_BATCHES: Counter = _build("tik_train_prefetch_batches_total")
 SERVE_SLOT_IDLE_FRACTION: Gauge = _build("tik_serve_slot_idle_fraction")
 
 # telemetry self-accounting

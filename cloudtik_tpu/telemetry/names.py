@@ -153,6 +153,20 @@ _ALL = [
     _m("tik_train_straggler_lag_seconds", "gauge",
        "Largest per-host step-publish lag behind the fastest host.",
        "train"),
+    # -- async input pipeline (train/prefetch.py) ------------------------
+    _m("tik_train_prefetch_queue_depth", "gauge",
+       "Device-resident batches ready in the prefetch queue.", "train"),
+    _m("tik_train_prefetch_consumer_wait_seconds", "histogram",
+       "Step-loop wait for the next prefetched batch (the residual "
+       "data wait once transfers overlap compute).", "train",
+       (), FAST_BUCKETS),
+    _m("tik_train_prefetch_producer_stall_seconds", "histogram",
+       "Producer blocked on a full prefetch queue (the accelerator is "
+       "the bottleneck — the healthy state).", "train",
+       (), FAST_BUCKETS),
+    _m("tik_train_prefetch_batches_total", "counter",
+       "Batches the prefetcher transferred and handed to the step "
+       "loop.", "train"),
     # -- serve goodput ----------------------------------------------------
     _m("tik_serve_slot_idle_fraction", "gauge",
        "Fraction of decode-step lanes idle this step (1 - active/slots).",

@@ -123,9 +123,20 @@ class StepProfiler:
         self._compile_marker = self.ledger.total(goodput.BUCKET_COMPILE)
 
     def record_step(self, step: int, data_wait_s: float,
-                    transfer_s: float, dispatch_s: float) -> None:
+                    transfer_s: float, dispatch_s: float,
+                    prefetch_wait_s: float = 0.0) -> None:
         """Account one step's segments.  Single attribute check when
-        telemetry is off."""
+        telemetry is off.
+
+        `prefetch_wait_s`: with the async input pipeline enabled the
+        loop's only input-side wait is the queue hand-off; it still
+        attributes to the ledger's ``data_wait`` bucket (an honest
+        residual wait, and where a `train.prefetch.next` latency fault
+        must land) but stays out of the per-step data-wait histogram —
+        that one collapses toward zero instead of silently absorbing
+        the queue wait (`tik_train_prefetch_consumer_wait_seconds`
+        carries it, observed by the prefetcher itself).
+        """
         if not core.STATE.enabled:
             return
         ti.TRAIN_DATA_WAIT_SECONDS.observe(data_wait_s)
@@ -138,12 +149,13 @@ class StepProfiler:
             - self._compile_marker, 0.0)
         dispatch_attr = max(dispatch_s - compiled, 0.0)
         ti.TRAIN_DISPATCH_SECONDS.observe(dispatch_attr)
+        wait_s = data_wait_s + prefetch_wait_s
         if step <= self.replay_until:
             self.ledger.attribute(
                 goodput.BUCKET_RESTART_REPLAY,
-                data_wait_s + transfer_s + dispatch_attr)
+                wait_s + transfer_s + dispatch_attr)
             return
-        self.ledger.attribute(goodput.BUCKET_DATA_WAIT, data_wait_s)
+        self.ledger.attribute(goodput.BUCKET_DATA_WAIT, wait_s)
         self.ledger.attribute(goodput.BUCKET_HOST_TRANSFER, transfer_s)
         self.ledger.attribute(goodput.BUCKET_STEP_COMPUTE, dispatch_attr)
 

@@ -84,6 +84,7 @@ class Checkpointer:
             directive = seams.fire("checkpoint.save", step=step,
                                    directory=self.config.directory)
         t0 = time.perf_counter()
+        compile_marker = goodput.LEDGER.total(goodput.BUCKET_COMPILE)
         # async saves: the span/histogram cover the dispatch (device ->
         # host copy), not background durability — attr async says which
         with telemetry.span("checkpoint.save", step=step,
@@ -105,7 +106,16 @@ class Checkpointer:
             dt = time.perf_counter() - t0
             ti.CHECKPOINT_SAVE_SECONDS.observe(dt)
             ti.CHECKPOINT_SAVES.inc(result="ok")
-            goodput.attribute(goodput.BUCKET_CHECKPOINT_SAVE, dt)
+            # any jax compile fired inside this window was already
+            # booked to the compile bucket by the stepprof listener;
+            # booking the full wall here too would double count and
+            # push attributed past wall (the ledger's sum-to-wall
+            # invariant) — same subtraction the dispatch segment does
+            compiled = max(
+                goodput.LEDGER.total(goodput.BUCKET_COMPILE)
+                - compile_marker, 0.0)
+            goodput.attribute(goodput.BUCKET_CHECKPOINT_SAVE,
+                              max(dt - compiled, 0.0))
             events.emit("tik_checkpoint_commit", step=step, result="ok",
                         directory=self.config.directory)
         if saved and directive == DIRECTIVE_TORN_WRITE:

@@ -1,0 +1,331 @@
+"""Async input pipeline: bounded background prefetch + overlapped
+host→device transfer.
+
+The goodput ledger showed every training step paying ``data_wait``
+(``next(data_iter)``) and ``host_transfer`` (``jax.device_put``)
+synchronously on the critical path.  :class:`Prefetcher` moves both off
+the step loop, tf.data-style (Murray et al., VLDB'21): producer threads
+pull host batches from any iterator — synthetic generators, the
+streaming Spark shard reader, multi-host ``global_batches`` — perform
+the ``device_put`` to the trainer's data sharding in the background,
+and hand the step loop *already device-resident* batches through a
+bounded depth-k queue (double-buffered by default).  Only dispatch
+blocks the loop; residual waits surface honestly as the
+``tik_train_prefetch_*`` metrics and the ledger's ``data_wait`` bucket
+via the step profiler's ``prefetch_wait`` segmentation.
+
+Ordering and lifecycle contracts (tested in tests/test_prefetch.py):
+
+  * batches reach the consumer in exactly iterator order, even with
+    multiple producer threads (sequence-numbered turn-taking);
+  * a producer exception re-raises at the consumer's ``next()`` — at
+    the step boundary, never a hang;
+  * iterator exhaustion drains the queue, then raises StopIteration;
+  * :meth:`Prefetcher.close` stops producers and joins them with a
+    timeout (a producer stuck inside the source's ``next()`` cannot be
+    interrupted; it is daemonic and reported, not waited on forever).
+
+Fault seam: every consumer ``next()`` fires ``train.prefetch.next``
+(faults/seams.py registry), so a chaos plan can inject latency into the
+hand-off and the goodput ledger must book it as ``data_wait``.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Any, Iterator, Optional
+
+import jax
+
+from cloudtik_tpu.faults import seams
+from cloudtik_tpu.telemetry import core as tcore
+from cloudtik_tpu.telemetry import instruments as ti
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_DEPTH = 2          # double-buffered
+_POLL_S = 0.1              # stop-flag poll cadence for blocking waits
+
+_END = object()            # source exhausted; emitted after the last batch
+
+
+class _Raised:
+    """A producer-side exception, queued for re-raise at next()."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+# ---------------------------------------------------------------- helpers --
+
+def is_device_resident(batch: Any, sharding) -> bool:
+    """True when every leaf is a committed jax.Array whose sharding is
+    equivalent to `sharding` — i.e. a second device_put would be a
+    wasted host→device round."""
+    leaves = jax.tree.leaves(batch)
+    if not leaves:
+        return False
+    for leaf in leaves:
+        if not isinstance(leaf, jax.Array):
+            return False
+        if not getattr(leaf, "committed", False):
+            return False
+        leaf_sharding = getattr(leaf, "sharding", None)
+        if leaf_sharding is None:
+            return False
+        try:
+            if not leaf_sharding.is_equivalent_to(sharding, leaf.ndim):
+                return False
+        except (AttributeError, TypeError):
+            if leaf_sharding != sharding:
+                return False
+    return True
+
+
+def put_device_batch(batch: Any, sharding) -> Any:
+    """``jax.device_put(batch, sharding)`` — unless the batch is already
+    device-resident with an equivalent sharding (``global_batches`` and
+    the prefetcher hand the loop committed global arrays; transferring
+    them again was the double-put bug)."""
+    if sharding is None or is_device_resident(batch, sharding):
+        return batch
+    return jax.device_put(batch, sharding)
+
+
+def _note_put(stall_s: float, qsize: int, is_batch: bool = True) -> None:
+    """Producer-side instrumentation (single attribute check when
+    telemetry is off).  `is_batch` is False for the exhaustion/error
+    sentinels, which stall like batches but must not count as one."""
+    if not tcore.STATE.enabled:
+        return
+    ti.TRAIN_PREFETCH_PRODUCER_STALL.observe(stall_s)
+    ti.TRAIN_PREFETCH_QUEUE_DEPTH.set(qsize)
+    if is_batch:
+        ti.TRAIN_PREFETCH_BATCHES.inc()
+
+
+def _note_get(wait_s: float, qsize: int) -> None:
+    """Consumer-side instrumentation (single attribute check when
+    telemetry is off)."""
+    if not tcore.STATE.enabled:
+        return
+    ti.TRAIN_PREFETCH_CONSUMER_WAIT.observe(wait_s)
+    ti.TRAIN_PREFETCH_QUEUE_DEPTH.set(qsize)
+
+
+# ------------------------------------------------------------- prefetcher --
+
+class Prefetcher(Iterator[Any]):
+    """Bounded multi-threaded background prefetcher.
+
+    source:   any iterator of host batches (pytrees of np.ndarray, or
+              already-global jax.Arrays from ``global_batches``).
+    sharding: the trainer's data sharding; ``device_put`` runs on the
+              producer threads so the consumer receives device-resident
+              batches.  None = pass-through (pure read-ahead).
+    depth:    queue capacity in batches (default 2, double-buffered).
+    threads:  producer thread count.  The *source* iterator is pulled
+              under a lock (iterators are not thread-safe), so extra
+              threads overlap only the transfer/transform stage — use
+              >1 when device_put dominates the producer cost.
+    max_items: pull at most this many batches from the source, then
+              behave as exhausted.  The trainer passes `num_steps` so
+              a fit consumes EXACTLY as many batches as the sync loop
+              would — read-ahead never silently eats batches a caller
+              meant for the next fit on the same iterator.
+    """
+
+    def __init__(self, source: Iterator[Any], sharding=None,
+                 depth: int = DEFAULT_DEPTH, threads: int = 1,
+                 max_items: Optional[int] = None,
+                 join_timeout_s: float = 5.0, name: str = "tik-prefetch"):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        if threads < 1:
+            raise ValueError(f"prefetch threads must be >= 1, "
+                             f"got {threads}")
+        self._source = source
+        self._sharding = sharding
+        self._max_items = None if max_items is None else int(max_items)
+        self._join_timeout_s = float(join_timeout_s)
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=depth)
+        self._source_lock = threading.Lock()
+        self._order = threading.Condition()
+        self._pull_turn = 0        # next sequence number to pull
+        self._emit_turn = 0        # next sequence number to enqueue
+        self._stop = threading.Event()
+        self._done = threading.Event()   # source exhausted or errored
+        self._finished = False           # consumer saw END/error
+        self._closed = False
+        self._threads = [
+            threading.Thread(target=self._produce, daemon=True,
+                             name=f"{name}-{i}")
+            for i in range(threads)]
+        for t in self._threads:
+            t.start()
+
+    # -- producer side ---------------------------------------------------
+    def _produce(self) -> None:
+        try:
+            while not self._stop.is_set():
+                sentinel = None
+                with self._source_lock:
+                    if self._done.is_set():
+                        return
+                    turn = self._pull_turn
+                    if (self._max_items is not None
+                            and turn >= self._max_items):
+                        self._done.set()
+                        sentinel = _END
+                    else:
+                        try:
+                            item = next(self._source)
+                        except StopIteration:
+                            self._done.set()
+                            sentinel = _END
+                        except BaseException as e:
+                            self._done.set()
+                            sentinel = _Raised(e)
+                        else:
+                            self._pull_turn = turn + 1
+                if sentinel is not None:
+                    self._emit(turn, sentinel)
+                    return
+                try:
+                    item = put_device_batch(item, self._sharding)
+                except BaseException as e:
+                    self._done.set()
+                    self._emit(turn, _Raised(e))
+                    return
+                if not self._emit(turn, item):
+                    return
+        except BaseException:      # pragma: no cover - backstop only
+            logger.exception("prefetch producer died unexpectedly")
+            self._done.set()
+            # a producer that dies without queuing its sentinel (e.g.
+            # the emit path itself raised) must still unwind peers
+            # parked on its turn and the consumer's queue.get poll —
+            # stop is the one flag every blocking wait checks, so the
+            # "never a hang" contract survives even this path
+            self._stop.set()
+            with self._order:
+                self._order.notify_all()
+
+    def _emit(self, turn: int, item: Any) -> bool:
+        """Enqueue `item` at its sequence position.  Blocks (polling the
+        stop flag) until it is this turn's time AND the bounded queue
+        has room; the time blocked on the FULL QUEUE is the
+        producer-stall histogram — waiting for a peer thread's earlier
+        turn is peer latency, not a stall, and counting it would invert
+        the runbook's "fat stall = accelerator-bound = healthy"
+        reading whenever threads > 1."""
+        enabled = tcore.STATE.enabled
+        with self._order:
+            while self._emit_turn != turn:
+                if self._stop.is_set():
+                    return False
+                self._order.wait(_POLL_S)
+            t0 = time.perf_counter() if enabled else 0.0
+            while True:
+                if self._stop.is_set():
+                    return False
+                try:
+                    self._q.put(item, timeout=_POLL_S)
+                    break
+                except queue.Full:
+                    continue
+            self._emit_turn = turn + 1
+            self._order.notify_all()
+        if enabled:
+            _note_put(time.perf_counter() - t0, self._q.qsize(),
+                      is_batch=item is not _END
+                      and not isinstance(item, _Raised))
+        return True
+
+    # -- consumer side ---------------------------------------------------
+    def __iter__(self) -> "Prefetcher":
+        return self
+
+    def __next__(self) -> Any:
+        if self._finished:
+            raise StopIteration
+        if self._closed:
+            raise RuntimeError("prefetcher is closed")
+        seams.fire("train.prefetch.next", qsize=self._q.qsize())
+        enabled = tcore.STATE.enabled
+        t0 = time.perf_counter() if enabled else 0.0
+        while True:
+            try:
+                item = self._q.get(timeout=_POLL_S)
+                break
+            except queue.Empty:
+                if self._stop.is_set():
+                    raise RuntimeError(
+                        "prefetcher closed while waiting for a batch")
+                if (self._done.is_set() and self._q.empty()
+                        and not any(t.is_alive()
+                                    for t in self._threads)):
+                    # producers gone without their sentinel reaching the
+                    # queue (closed mid-emit): treat as exhaustion
+                    self._finished = True
+                    raise StopIteration
+        if item is _END:
+            self._finished = True
+            self.close()
+            raise StopIteration
+        if isinstance(item, _Raised):
+            self._finished = True
+            self.close()
+            raise item.exc
+        # after the sentinel checks: the wait for the exhaustion/error
+        # marker is not a batch wait, and one spurious sample per epoch
+        # would skew the very histogram the runbook reads
+        if enabled:
+            _note_get(time.perf_counter() - t0, self._q.qsize())
+        return item
+
+    @property
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self, timeout_s: Optional[float] = None) -> bool:
+        """Stop producers and join them.  Returns True when every
+        producer thread exited within the timeout; a thread stuck in
+        the source's ``next()`` is daemonic and left behind with a
+        warning (it cannot be interrupted from Python)."""
+        timeout_s = self._join_timeout_s if timeout_s is None \
+            else float(timeout_s)
+        self._closed = True
+        self._stop.set()
+        with self._order:
+            self._order.notify_all()
+        # unblock producers parked on a full queue
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        deadline = time.monotonic() + timeout_s
+        joined = True
+        for t in self._threads:
+            t.join(max(deadline - time.monotonic(), 0.0))
+            if t.is_alive():
+                joined = False
+        if not joined:
+            logger.warning(
+                "prefetch producer did not exit within %.1fs (source "
+                "blocked in next()?); leaving daemon thread behind",
+                timeout_s)
+        return joined
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -28,6 +28,8 @@ from cloudtik_tpu.parallel.sharding import (
     AxisRules, DEFAULT_RULES, batch_sharding, tree_to_shardings_safe)
 from cloudtik_tpu.train.checkpoint import CheckpointConfig, Checkpointer
 from cloudtik_tpu.train.optim import OptimizerConfig, make_optimizer
+from cloudtik_tpu.train.prefetch import Prefetcher, put_device_batch
+from cloudtik_tpu.utils.compile_cache import ensure_compile_cache
 
 # Peak bf16 FLOPs/s per chip by TPU generation (public spec sheet numbers),
 # used for MFU.  Unknown platforms fall back to measured-only reporting.
@@ -185,6 +187,12 @@ class TrainerConfig:
     # many sequential micro-steps (the batch splits on its leading dim).
     # Scales effective batch beyond what one step's activations fit.
     grad_accum_steps: int = 1
+    # Async input pipeline (train/prefetch.py): batches are pulled and
+    # device_put on background threads and handed to the step loop
+    # already device-resident, behind a bounded depth-k queue.
+    # 0 = fully synchronous input path (the pre-prefetch behavior).
+    prefetch_depth: int = 2
+    prefetch_threads: int = 1
 
 
 class Trainer:
@@ -194,6 +202,9 @@ class Trainer:
                  mesh: Optional[Mesh] = None):
         self.spec = spec
         self.config = config
+        # warm restarts after preemption deserialize XLA executables
+        # instead of recompiling (TIK_COMPILE_CACHE_DIR; fail-soft)
+        ensure_compile_cache()
         self.mesh = mesh if mesh is not None else build_mesh(config.mesh)
         self.optimizer = make_optimizer(config.optimizer)
         params_shape = jax.eval_shape(spec.init, jax.random.PRNGKey(0))
@@ -413,12 +424,29 @@ class Trainer:
         if self.state is None:
             self.init_state(rng if rng is not None else jax.random.PRNGKey(0))
         jitted = self.compile_step()
+        prefetcher = None
         if profile_dir:
             jax.profiler.start_trace(profile_dir)
         try:
+            if (self.config.prefetch_depth > 0
+                    and not isinstance(data_iter, Prefetcher)):
+                # async input pipeline: producer threads pull +
+                # device_put off the step loop; only dispatch blocks
+                # the loop.  max_items pins consumption to exactly
+                # num_steps batches, so an iterator shared across fits
+                # sees the same stream the synchronous loop would have
+                # left it with
+                prefetcher = Prefetcher(
+                    data_iter, sharding=self.data_sharding,
+                    depth=self.config.prefetch_depth,
+                    threads=self.config.prefetch_threads,
+                    max_items=num_steps)
+                data_iter = prefetcher
             return self._fit_loop(data_iter, num_steps, jitted,
                                   callbacks or [])
         finally:
+            if prefetcher is not None:
+                prefetcher.close()
             if profile_dir:
                 jax.block_until_ready(
                     jax.tree.leaves(self.state)[0])
@@ -435,28 +463,69 @@ class Trainer:
         profiler = stepprof.StepProfiler(
             goodput.LEDGER, replay_until=self._replay_until)
         capture = stepprof.ProfileCapture()
+        prefetching = isinstance(data_iter, Prefetcher)
         t_window = time.perf_counter()
         window_steps = 0
+        last_metrics = None
+
+        def flush_window(metrics):
+            # the float() host transfers are the sync point:
+            # remote backends (axon tunnel) resolve
+            # block_until_ready before compute retires, so dt
+            # must be taken AFTER the transfer or tokens/sec
+            # and MFU inflate
+            nonlocal t_window, window_steps
+            t_sync = time.perf_counter()
+            entry = {k: float(v) for k, v in metrics.items()}
+            profiler.record_sync(
+                self.step, time.perf_counter() - t_sync)
+            dt = time.perf_counter() - t_window
+            tokens_s = tokens_per_step * window_steps / dt
+            entry.update(step=self.step, tokens_per_sec=tokens_s)
+            ti.TRAIN_TOKENS_PER_SEC.set(tokens_s)
+            if self.spec.flops_per_token and peak:
+                mfu = (self.spec.flops_per_token * tokens_s
+                       / (peak * n_devices))
+                entry["mfu"] = mfu
+                ti.TRAIN_MFU.set(mfu)
+            telemetry.add_span(
+                "train.window", time.time() - dt, dt,
+                step=self.step, steps=window_steps,
+                tokens_per_sec=round(tokens_s, 1))
+            history.append(entry)
+            for cb in callbacks:
+                cb(self, entry)
+            goodput.LEDGER.tick()
+            capture.poll()
+            t_window = time.perf_counter()
+            window_steps = 0
+
         with jax.sharding.set_mesh(self.mesh):
             for _ in range(num_steps):
                 t_step = time.perf_counter()
                 batch = next(data_iter)
                 t_data = time.perf_counter()
-                batch = jax.device_put(batch, self.data_sharding)
+                # no-op when the iterator already yields committed
+                # global arrays (the prefetcher, global_batches)
+                batch = put_device_batch(batch, self.data_sharding)
                 t_put = time.perf_counter()
                 profiler.dispatch_begin()
                 self.state, metrics = jitted(self.state, batch)
                 t_dispatch = time.perf_counter()
                 self.step += 1
                 window_steps += 1
+                last_metrics = metrics
                 # dispatch wall time per step (async runtimes retire
                 # compute later; the log-window sync below is the
                 # honest throughput number)
                 ti.TRAIN_STEP_SECONDS.observe(t_dispatch - t_step)
                 ti.TRAIN_STEPS.inc()
+                wait_s = t_data - t_step
                 profiler.record_step(
-                    self.step, t_data - t_step, t_put - t_data,
-                    t_dispatch - t_put)
+                    self.step,
+                    0.0 if prefetching else wait_s,
+                    t_put - t_data, t_dispatch - t_put,
+                    prefetch_wait_s=wait_s if prefetching else 0.0)
                 if capture.active:
                     capture.step_done(jax.tree.leaves(self.state)[0])
                 if (self.checkpointer is not None
@@ -464,35 +533,12 @@ class Trainer:
                         and self.step % self.config.checkpoint_every == 0):
                     self.checkpointer.save(self.step, self.state)
                 if self.step % self.config.log_every == 0:
-                    # the float() host transfers are the sync point:
-                    # remote backends (axon tunnel) resolve
-                    # block_until_ready before compute retires, so dt
-                    # must be taken AFTER the transfer or tokens/sec
-                    # and MFU inflate
-                    t_sync = time.perf_counter()
-                    entry = {k: float(v) for k, v in metrics.items()}
-                    profiler.record_sync(
-                        self.step, time.perf_counter() - t_sync)
-                    dt = time.perf_counter() - t_window
-                    tokens_s = tokens_per_step * window_steps / dt
-                    entry.update(step=self.step, tokens_per_sec=tokens_s)
-                    ti.TRAIN_TOKENS_PER_SEC.set(tokens_s)
-                    if self.spec.flops_per_token and peak:
-                        mfu = (self.spec.flops_per_token * tokens_s
-                               / (peak * n_devices))
-                        entry["mfu"] = mfu
-                        ti.TRAIN_MFU.set(mfu)
-                    telemetry.add_span(
-                        "train.window", time.time() - dt, dt,
-                        step=self.step, steps=window_steps,
-                        tokens_per_sec=round(tokens_s, 1))
-                    history.append(entry)
-                    for cb in callbacks:
-                        cb(self, entry)
-                    goodput.LEDGER.tick()
-                    capture.poll()
-                    t_window = time.perf_counter()
-                    window_steps = 0
+                    flush_window(metrics)
+            if window_steps and last_metrics is not None:
+                # final partial window: a short fit (< log_every steps)
+                # still reports tokens/sec and ticks the ledger instead
+                # of dropping its tail on the floor
+                flush_window(last_metrics)
         capture.stop(jax.tree.leaves(self.state)[0]
                      if self.state is not None else None)
         return {"history": history, "final_step": self.step}

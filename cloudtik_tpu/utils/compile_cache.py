@@ -1,0 +1,132 @@
+"""Persistent XLA compilation cache wiring: warm restarts skip compiles.
+
+A preempted trainer pays the full ``compile`` goodput bucket again on
+every process restart unless JAX's persistent compilation cache is
+enabled — the goodput ledger (telemetry/goodput.py) showed it as one of
+the two big non-goodput buckets next to ``data_wait``.  This module is
+the ONE place the knob lives: :func:`ensure_compile_cache` points
+``jax_compilation_cache_dir`` at a shared directory and every surface
+that jits — ``Trainer``, ``bench.py``, ``tik-serve`` — calls it at
+boot, so the second incarnation of a job on a host deserializes its XLA
+executables instead of recompiling them.
+
+The cache is **opt-in by environment**: ``TIK_COMPILE_CACHE_DIR``
+unset (or an "off"/"0"/"none" value) leaves the process uncached; a
+path enables it there; the sentinel values "1"/"on"/"default" enable
+it at the default location ``<TIK_HOME>/cache/xla``
+(``~/.tik/cache/xla``).  Opt-in rather than always-on is deliberate:
+the pinned jax 0.4.37 CPU runtime corrupts its heap when executable
+*deserialization* races a concurrent orbax checkpoint restore in the
+same process (reproduced by the goodput resume drill) — a trainer that
+resumes from checkpoints on that runtime should enable the cache only
+when the warm-restart win matters more.  Newer runtimes can flip the
+default here.
+
+The ssh/local executors export ``TIK_COMPILE_CACHE_DIR`` into every
+remote command environment the same way ``TIK_TRACEPARENT`` rides
+along (``executor/base._propagation_env``), so a whole slice shares the
+operator's setting without per-node configuration.
+
+Enabling is fail-soft: an unwritable directory or a jax runtime without
+the config knobs logs a warning and leaves the process uncached — the
+cache must never take a trainer down.  Cache *write* errors at run time
+are already non-fatal in jax (``jax_raise_persistent_cache_errors``
+defaults to False).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+CACHE_DIR_ENV = "TIK_COMPILE_CACHE_DIR"
+# jax's default skips compiles faster than 1s; a warm restart of a tiny
+# model (or a CPU test) would then never hit.  Cache everything unless
+# the operator raises the floor.
+MIN_COMPILE_ENV = "TIK_COMPILE_CACHE_MIN_COMPILE_S"
+
+_DISABLE_VALUES = frozenset(("", "0", "off", "false", "none", "disabled"))
+_DEFAULT_VALUES = frozenset(("1", "on", "true", "default"))
+
+_lock = threading.Lock()
+_applied: Optional[str] = None
+
+
+def default_cache_dir() -> str:
+    from cloudtik_tpu.utils.constants import tik_home
+    return os.path.join(tik_home(), "cache", "xla")
+
+
+def cache_dir() -> Optional[str]:
+    """The directory the cache would use, or None when disabled
+    (opt-in: unset means disabled — see the module docstring)."""
+    raw = os.environ.get(CACHE_DIR_ENV)
+    if raw is None:
+        return None
+    value = raw.strip()
+    if value.lower() in _DISABLE_VALUES:
+        return None
+    if value.lower() in _DEFAULT_VALUES:
+        return default_cache_dir()
+    return os.path.expanduser(value)
+
+
+def _unapply() -> None:
+    """Point jax away from any previously applied cache directory.
+    Caller holds ``_lock``.  The one invariant both callers rely on:
+    after this, jax must not keep deserializing while we report the
+    cache disabled (the half-enabled state the jax-0.4.37 warning in
+    the module docstring cannot tolerate)."""
+    global _applied
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:   # pragma: no cover - config gone
+        pass
+    _applied = None
+
+
+def ensure_compile_cache(directory: Optional[str] = None) -> Optional[str]:
+    """Idempotently enable the persistent compilation cache.
+
+    Returns the directory in use, or None when disabled/unavailable.
+    Re-applies when the resolved directory changed since the last call
+    (tests and multi-job processes repoint it via the env var).
+    """
+    global _applied
+    directory = directory if directory is not None else cache_dir()
+    if directory is None:
+        with _lock:
+            if _applied is not None:
+                # repointed to off after being enabled
+                _unapply()
+        return None
+    with _lock:
+        if _applied == directory:
+            return directory
+        try:
+            min_compile_s = float(os.environ.get(MIN_COMPILE_ENV, "0"))
+            os.makedirs(directory, exist_ok=True)
+            import jax
+            jax.config.update("jax_compilation_cache_dir", directory)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs",
+                min_compile_s)
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception as e:
+            logger.warning(
+                "persistent compile cache disabled (%s: %s) — "
+                "restarts will recompile", type(e).__name__, e)
+            # never leave the process half-enabled: a failure anywhere
+            # in the sequence (or with a previous directory applied)
+            # must not keep jax deserializing while we report the
+            # cache off
+            _unapply()
+            return None
+        _applied = directory
+        return directory

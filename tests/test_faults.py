@@ -183,6 +183,14 @@ def test_seams_are_noops_without_a_plan(monkeypatch, tmp_path):
                       total_resources={"CPU": 1})
     agent.heartbeat_once()
 
+    # prefetcher consumer hand-off (train.prefetch.next)
+    from cloudtik_tpu.train.prefetch import Prefetcher
+    pf = Prefetcher(iter([{"x": 1}]), sharding=None)
+    try:
+        assert next(pf) == {"x": 1}
+    finally:
+        pf.close()
+
     # local executor
     from cloudtik_tpu.control.executor.local import LocalCommandExecutor
 

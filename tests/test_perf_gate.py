@@ -173,3 +173,22 @@ class TestBenchProbeDiagnostics:
         assert diagnostics["phase"] == "done"
         assert diagnostics["devices"]
         assert diagnostics["jax_platforms"] == "cpu"
+
+
+class TestBenchSuiteDispatch:
+    def test_suite_scripts_exist(self, bench):
+        for script in bench.SUITES.values():
+            assert (REPO / "benchmarks" / script).is_file()
+
+    def test_suite_flag_dispatches_to_satellite_bench(self, bench,
+                                                      monkeypatch):
+        """`bench.py --suite input_pipeline` runs the satellite script
+        (whose own JSON line feeds perf_gate) instead of the flagship
+        probe+MFU path."""
+        calls = []
+        monkeypatch.setattr(bench.subprocess, "call",
+                            lambda cmd: calls.append(cmd) or 0)
+        assert bench.main(["--suite", "input_pipeline"]) == 0
+        assert len(calls) == 1
+        assert calls[0][0] == sys.executable
+        assert calls[0][1].endswith("input_pipeline_bench.py")

@@ -1,0 +1,462 @@
+"""Async input pipeline + persistent compile cache.
+
+The PR's acceptance drills: producer/consumer lifecycle contracts of
+the bounded prefetcher (order, error surfacing, drain-then-stop, close
+joins), trainer integration (device-resident hand-off skips the second
+device_put, deterministic vs the sync path, short fits still report),
+the chaos drill (latency injected at the ``train.prefetch.next`` seam
+is booked as ``data_wait`` in the goodput ledger), the overlap proof
+(prefetch=2 step-loop wall time strictly below the sync baseline with
+an artificial producer delay, input-wait goodput fraction drops), and
+the warm-restart drill (a second trainer process with
+``TIK_COMPILE_CACHE_DIR`` set pays a smaller ``compile`` bucket).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import itertools
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from cloudtik_tpu import telemetry
+from cloudtik_tpu.faults import seams
+from cloudtik_tpu.faults.plan import FaultPlan, FaultPoint
+from cloudtik_tpu.telemetry import goodput
+from cloudtik_tpu.telemetry import instruments as ti
+from cloudtik_tpu.train.prefetch import (
+    Prefetcher, is_device_resident, put_device_batch)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.enable()
+    telemetry.reset()
+    yield
+    telemetry.enable()
+    telemetry.reset()
+
+
+def _batches(n):
+    for i in range(n):
+        yield {"i": np.full((2,), i, np.int32)}
+
+
+# ------------------------------------------------------------- lifecycle --
+
+class TestPrefetcherLifecycle:
+    @pytest.mark.parametrize("threads", [1, 3])
+    def test_preserves_iterator_order(self, threads):
+        with Prefetcher(_batches(40), depth=2, threads=threads) as pf:
+            seen = [int(b["i"][0]) for b in pf]
+        assert seen == list(range(40))
+
+    def test_exhaustion_drains_queue_then_stops(self):
+        pf = Prefetcher(_batches(5), depth=4)
+        time.sleep(0.3)            # let producers fill the queue fully
+        seen = [int(b["i"][0]) for b in pf]
+        assert seen == [0, 1, 2, 3, 4]
+        with pytest.raises(StopIteration):
+            next(pf)
+        assert not any(t.is_alive() for t in pf._threads)
+
+    def test_producer_exception_surfaces_at_next(self):
+        def broken():
+            yield {"i": np.zeros((2,), np.int32)}
+            yield {"i": np.ones((2,), np.int32)}
+            raise ValueError("loader died")
+
+        pf = Prefetcher(broken(), depth=2)
+        assert int(next(pf)["i"][0]) == 0
+        assert int(next(pf)["i"][0]) == 1
+        t0 = time.monotonic()
+        with pytest.raises(ValueError, match="loader died"):
+            next(pf)
+        assert time.monotonic() - t0 < 5.0, "error must not hang"
+        with pytest.raises(StopIteration):
+            next(pf)               # errored stream stays finished
+
+    def test_transfer_exception_surfaces_at_next(self):
+        class Unputtable:
+            pass
+
+        bad = iter([{"x": np.zeros((8, 4), np.float32)},
+                    {"x": Unputtable()}])
+        mesh, sharding = _mesh_sharding()
+        pf = Prefetcher(bad, sharding=sharding, depth=2)
+        next(pf)
+        with pytest.raises(Exception):
+            next(pf)
+
+    def test_close_joins_threads(self):
+        def slow():
+            for i in itertools.count():
+                time.sleep(0.15)
+                yield {"i": np.full((2,), i, np.int32)}
+
+        pf = Prefetcher(slow(), depth=1, threads=2)
+        next(pf)
+        t0 = time.monotonic()
+        assert pf.close() is True
+        assert time.monotonic() - t0 < 5.0
+        assert not any(t.is_alive() for t in pf._threads)
+        with pytest.raises(RuntimeError):
+            next(pf)
+
+    def test_close_is_idempotent_and_reentrant(self):
+        pf = Prefetcher(_batches(3))
+        assert pf.close() is True
+        assert pf.close() is True
+
+    def test_max_items_caps_source_consumption(self):
+        pulled = []
+
+        def counting():
+            for i in itertools.count():
+                pulled.append(i)
+                yield {"i": np.full((2,), i, np.int32)}
+
+        with Prefetcher(counting(), depth=4, max_items=3) as pf:
+            out = [int(b["i"][0]) for b in pf]
+        assert out == [0, 1, 2]
+        assert len(pulled) == 3, "read-ahead must not eat extra batches"
+
+    def test_bad_args_rejected(self):
+        with pytest.raises(ValueError):
+            Prefetcher(_batches(1), depth=0)
+        with pytest.raises(ValueError):
+            Prefetcher(_batches(1), threads=0)
+
+    def test_telemetry_instruments_fed(self):
+        before = ti.TRAIN_PREFETCH_BATCHES.value()
+        with Prefetcher(_batches(6), depth=2) as pf:
+            list(pf)
+        # exactly 6: the exhaustion sentinel must not count as a batch,
+        # nor pad the consumer-wait histogram with a non-batch sample
+        assert ti.TRAIN_PREFETCH_BATCHES.value() == before + 6
+        assert ti.TRAIN_PREFETCH_CONSUMER_WAIT.snapshot()["count"] == 6
+        assert ti.TRAIN_PREFETCH_PRODUCER_STALL.snapshot()["count"] >= 6
+
+
+# -------------------------------------------------------- device residency --
+
+def _mesh_sharding():
+    from cloudtik_tpu.parallel.mesh import MeshConfig, build_mesh
+    from cloudtik_tpu.parallel.sharding import (
+        DEFAULT_RULES, batch_sharding)
+    mesh = build_mesh(MeshConfig(data=2, fsdp=4))
+    return mesh, batch_sharding(mesh, DEFAULT_RULES)
+
+
+def _host_batches(n):
+    for i in range(n):
+        yield {"x": np.full((8, 4), i, np.float32)}
+
+
+class TestDeviceResidency:
+    def test_prefetcher_hands_off_device_resident_batches(self):
+        _mesh, sharding = _mesh_sharding()
+        with Prefetcher(_host_batches(4), sharding=sharding,
+                        depth=2) as pf:
+            out = list(pf)
+        assert len(out) == 4
+        for batch in out:
+            assert is_device_resident(batch, sharding)
+
+    def test_put_device_batch_skips_resident_batches(self):
+        _mesh, sharding = _mesh_sharding()
+        host = {"x": np.zeros((8, 4), np.float32)}
+        resident = put_device_batch(host, sharding)
+        assert is_device_resident(resident, sharding)
+        again = put_device_batch(resident, sharding)
+        assert again["x"] is resident["x"], "second put must be a no-op"
+        assert not is_device_resident(host, sharding)
+
+    def test_global_batches_single_process_skips_second_put(self):
+        from cloudtik_tpu.train.data import global_batches
+        _mesh, sharding = _mesh_sharding()
+        it = global_batches(_host_batches(2), sharding)
+        batch = next(it)
+        assert is_device_resident(batch, sharding)
+
+
+# ------------------------------------------------------ trainer integration --
+
+def _tiny_trainer(prefetch_depth, log_every=1, **cfg_over):
+    from cloudtik_tpu.models import transformer as T
+    from cloudtik_tpu.parallel.mesh import MeshConfig
+    from cloudtik_tpu.train.optim import OptimizerConfig
+    from cloudtik_tpu.train.trainer import (
+        Trainer, TrainerConfig, transformer_spec)
+    cfg = T.config("tiny", attention_impl="reference")
+    trainer = Trainer(transformer_spec(cfg), TrainerConfig(
+        global_batch_size=8, seq_len=32, mesh=MeshConfig(data=2, fsdp=4),
+        optimizer=OptimizerConfig(learning_rate=1e-2, warmup_steps=2,
+                                  total_steps=50),
+        log_every=log_every, prefetch_depth=prefetch_depth, **cfg_over))
+    return cfg, trainer
+
+
+class TestTrainerIntegration:
+    """One compiled trainer per prefetch mode (XLA compiles dominate
+    CPU test cost); each test runs several checks on it."""
+
+    def test_prefetch_matches_sync_and_exact_consumption(self):
+        """(a) same losses with and without the async pipeline;
+        (b) two fits sharing ONE iterator see the same stream the sync
+        loop would — read-ahead never eats the next fit's batches."""
+        from cloudtik_tpu.train.data import synthetic_lm_batches
+        losses = {}
+        for depth in (0, 2):
+            cfg, trainer = _tiny_trainer(depth)
+            data = synthetic_lm_batches(8, 32, cfg.vocab_size, seed=3)
+            out1 = trainer.fit(data, num_steps=3,
+                               rng=jax.random.PRNGKey(7))
+            out2 = trainer.fit(data, num_steps=2)   # same iterator
+            losses[depth] = ([h["loss"] for h in out1["history"]]
+                             + [h["loss"] for h in out2["history"]])
+        np.testing.assert_allclose(losses[0], losses[2], rtol=1e-6)
+
+    def test_windows_residency_and_exhaustion(self, monkeypatch):
+        from cloudtik_tpu.train.data import synthetic_lm_batches
+        cfg, trainer = _tiny_trainer(2, log_every=50)
+        gen = synthetic_lm_batches(8, 32, cfg.vocab_size, seed=4)
+
+        # (a) num_steps < log_every: the final partial window must
+        # still land in history with a throughput number
+        out = trainer.fit(gen, num_steps=3)
+        assert len(out["history"]) == 1
+        entry = out["history"][0]
+        assert entry["step"] == 3
+        assert entry["tokens_per_sec"] > 0
+        assert np.isfinite(entry["loss"])
+
+        # (b) exact log_every boundary (trainer is at step 3; 5 more
+        # steps end on a boundary): no duplicate final entry
+        trainer.config.log_every = 2
+        out = trainer.fit(gen, num_steps=5)
+        assert [h["step"] for h in out["history"]] == [4, 6, 8]
+
+        # (c) the double-transfer fix: already-committed global arrays
+        # must not pay a second host→device round
+        resident = [put_device_batch(b, trainer.data_sharding)
+                    for b in itertools.islice(gen, 3)]
+        calls = []
+        orig = jax.device_put
+
+        def spy(x, *a, **kw):
+            calls.append(1)
+            return orig(x, *a, **kw)
+
+        monkeypatch.setattr(jax, "device_put", spy)
+        trainer.fit(iter(resident), num_steps=3)
+        assert calls == [], "resident batches were re-transferred"
+        monkeypatch.undo()
+
+        # (d) a too-short iterator surfaces as StopIteration, not a hang
+        with pytest.raises(StopIteration):
+            trainer.fit(iter(itertools.islice(gen, 2)), num_steps=5)
+
+
+# ------------------------------------------------------------ chaos drill --
+
+@pytest.mark.chaos
+class TestPrefetchChaosDrill:
+    def test_latency_at_prefetch_seam_books_data_wait(self):
+        """A fault plan stretches the prefetch hand-off; the goodput
+        ledger must book the injected latency as data_wait — residual
+        input waits never hide behind the async pipeline."""
+        from cloudtik_tpu.train.data import synthetic_lm_batches
+        cfg, trainer = _tiny_trainer(2)
+        data = synthetic_lm_batches(8, 32, cfg.vocab_size, seed=8)
+        plan = FaultPlan([FaultPoint(
+            "train.prefetch.next", "latency", times=3,
+            args={"seconds": 0.06})], seed=1)
+        wait_before = goodput.LEDGER.total(goodput.BUCKET_DATA_WAIT)
+        with seams.armed(plan):
+            trainer.fit(data, num_steps=4)
+        fired = [e for e in plan.summary()["trace"]
+                 if e["seam"] == "train.prefetch.next"]
+        assert len(fired) == 3
+        booked = goodput.LEDGER.total(goodput.BUCKET_DATA_WAIT) \
+            - wait_before
+        assert booked >= 3 * 0.06 * 0.9, (
+            f"injected prefetch latency not booked as data_wait "
+            f"({booked:.3f}s)")
+
+
+# ---------------------------------------------------------- overlap drill --
+
+def _load_bench():
+    path = REPO_ROOT / "benchmarks" / "input_pipeline_bench.py"
+    spec = importlib.util.spec_from_file_location(
+        "input_pipeline_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.chaos
+class TestOverlapDrill:
+    def test_prefetch_overlaps_producer_delay(self):
+        """With a producer delay that dominates step compute,
+        prefetch=2 step-loop wall time must be strictly below the sync
+        baseline and the ledger's input-wait (data_wait +
+        host_transfer) fraction must drop — the overlap demonstrated
+        on CPU.  Medians over interleaved trials: this box shares its
+        2 CPUs with the world and jitters step compute by more than
+        small per-step delays."""
+        bench = _load_bench()
+        modes = bench.run(steps=12, delay_ms=50.0, batch=8, seq=64,
+                          depths=(0, 2), trials=3)
+        sync, pf2 = modes[0], modes[2]
+        assert pf2["wall_s"] < sync["wall_s"], modes
+        assert pf2["input_wait_fraction"] < sync["input_wait_fraction"], \
+            modes
+
+    def test_bench_main_emits_perf_gate_shape(self, capsys):
+        bench = _load_bench()
+        record = {"metric": bench.METRIC, "value": 1.2, "unit": "x"}
+        sys.path.insert(0, str(REPO_ROOT / "tools"))
+        try:
+            import perf_gate
+        finally:
+            sys.path.pop(0)
+        parsed = perf_gate.extract_result(record)
+        assert parsed is not None and parsed["value"] == 1.2
+
+
+# ------------------------------------------------------- compile cache --
+
+class TestCompileCacheConfig:
+    def test_opt_in_semantics(self, monkeypatch, tmp_path):
+        from cloudtik_tpu.utils import compile_cache as cc
+        monkeypatch.delenv(cc.CACHE_DIR_ENV, raising=False)
+        assert cc.cache_dir() is None          # unset = disabled
+        assert cc.ensure_compile_cache() is None
+        monkeypatch.setenv(cc.CACHE_DIR_ENV, "off")
+        assert cc.cache_dir() is None
+        monkeypatch.setenv(cc.CACHE_DIR_ENV, str(tmp_path / "xla"))
+        assert cc.cache_dir() == str(tmp_path / "xla")
+        monkeypatch.setenv(cc.CACHE_DIR_ENV, "on")
+        monkeypatch.setenv("TIK_HOME", str(tmp_path / "home"))
+        assert cc.cache_dir() == str(
+            tmp_path / "home" / "cache" / "xla")
+
+    def test_ensure_creates_dir_and_configures_jax(self, monkeypatch,
+                                                   tmp_path):
+        from cloudtik_tpu.utils import compile_cache as cc
+        target = str(tmp_path / "cache")
+        assert cc.ensure_compile_cache(target) == target
+        assert os.path.isdir(target)
+        assert jax.config.jax_compilation_cache_dir == target
+        # idempotent
+        assert cc.ensure_compile_cache(target) == target
+
+    def test_never_half_enabled(self, monkeypatch, tmp_path):
+        """A failed apply (malformed floor) or a repoint to 'off' must
+        fully un-apply — jax silently deserializing from a directory we
+        report as disabled is the one state the jax-0.4.37 orbax-race
+        warning cannot tolerate."""
+        from cloudtik_tpu.utils import compile_cache as cc
+        target = str(tmp_path / "cache")
+        assert cc.ensure_compile_cache(target) == target
+        # enabled -> repointed off: un-applied, not left dangling
+        monkeypatch.delenv(cc.CACHE_DIR_ENV, raising=False)
+        assert cc.ensure_compile_cache() is None
+        assert jax.config.jax_compilation_cache_dir is None
+        # failure mid-apply: rolled back, not half-enabled
+        monkeypatch.setenv(cc.MIN_COMPILE_ENV, "not-a-float")
+        assert cc.ensure_compile_cache(target) is None
+        assert jax.config.jax_compilation_cache_dir is None
+
+    def test_executors_propagate_cache_env(self, monkeypatch, tmp_path):
+        """TIK_COMPILE_CACHE_DIR rides into remote command envs the way
+        TIK_TRACEPARENT does."""
+        from cloudtik_tpu.control.executor.base import _propagation_env
+        from cloudtik_tpu.utils import compile_cache as cc
+        monkeypatch.setenv(cc.CACHE_DIR_ENV, "/shared/xla")
+        merged = _propagation_env(object(), {"A": "1"})
+        assert merged[cc.CACHE_DIR_ENV] == "/shared/xla"
+        assert merged["A"] == "1"
+        # caller's explicit value wins
+        merged = _propagation_env(
+            object(), {cc.CACHE_DIR_ENV: "/mine"})
+        assert merged[cc.CACHE_DIR_ENV] == "/mine"
+        # nothing set -> env passes through untouched
+        monkeypatch.delenv(cc.CACHE_DIR_ENV)
+        env = {"A": "1"}
+        assert _propagation_env(object(), env) is env
+
+
+# ------------------------------------------------------ warm-restart drill --
+
+_DRILL_SRC = r"""
+import json, os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from cloudtik_tpu.models import transformer as T
+from cloudtik_tpu.telemetry import goodput
+from cloudtik_tpu.telemetry import instruments as ti
+from cloudtik_tpu.train.data import synthetic_lm_batches
+from cloudtik_tpu.train.trainer import (
+    Trainer, TrainerConfig, transformer_spec)
+
+cfg = T.config("tiny", attention_impl="reference")
+trainer = Trainer(transformer_spec(cfg), TrainerConfig(
+    global_batch_size=8, seq_len=16, log_every=1))
+data = synthetic_lm_batches(8, 16, cfg.vocab_size, seed=0)
+trainer.fit(data, num_steps=1)
+print("RESULT:" + json.dumps({
+    "compile_s": goodput.LEDGER.total(goodput.BUCKET_COMPILE),
+    "compiles": ti.TRAIN_COMPILES.value(),
+}))
+"""
+
+
+@pytest.mark.chaos
+class TestWarmRestartDrill:
+    def test_second_process_pays_smaller_compile_bucket(self, tmp_path):
+        """Two trainer *processes* with the same TIK_COMPILE_CACHE_DIR:
+        the warm one deserializes XLA executables, so its `compile`
+        goodput bucket shrinks vs the cold run."""
+        cache = tmp_path / "xla-cache"
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=8",
+            TIK_HOME=str(tmp_path / "tik"),
+            TIK_COMPILE_CACHE_DIR=str(cache),
+        )
+        env.pop("TIK_TELEMETRY", None)
+
+        def run_once():
+            proc = subprocess.run(
+                [sys.executable, "-c", _DRILL_SRC], env=env,
+                cwd=str(REPO_ROOT), capture_output=True, text=True,
+                timeout=300)
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            line = [ln for ln in proc.stdout.splitlines()
+                    if ln.startswith("RESULT:")][-1]
+            return json.loads(line[len("RESULT:"):])
+
+        cold = run_once()
+        assert cache.is_dir() and any(cache.iterdir()), \
+            "cold run wrote no cache entries"
+        warm = run_once()
+        assert cold["compile_s"] > 0 and warm["compile_s"] > 0
+        # trace + lowering still run warm; the backend compile — the
+        # dominant cost — is deserialized from the persistent cache
+        assert warm["compile_s"] < cold["compile_s"] * 0.8, (cold, warm)

@@ -26,13 +26,19 @@ cloudtik_tpu/telemetry/names.py:
   8. the alert-rule catalog (runtimes/prometheus/alerts.py
      default_alert_rules): rule names are unique, every referenced
      metric resolves against the catalog, and docs/observability.md
-     documents every rule by name.
+     documents every rule by name;
+  9. the fault-seam registry: every ``seams.fire("...")`` literal in
+     the source resolves against the registry in the
+     cloudtik_tpu/faults/seams.py docstring AND the seam table in
+     docs/fault-injection.md (a seam nobody documented cannot be
+     drilled).
 
 Run: ``python tools/check_telemetry_names.py`` (exit 1 on failure).
 """
 
 from __future__ import annotations
 
+import ast
 import json
 import os
 import re
@@ -183,6 +189,54 @@ def run_checks() -> List[str]:
         if name not in used_events:
             errors.append(f"declared event {name!r} is never emitted "
                           "in cloudtik_tpu source")
+
+    # 9. fault seams: every fire site resolves against the registry
+    # (faults/seams.py docstring) and the docs seam table.  EXACT name
+    # matching: both tables are parsed into name sets — substring
+    # containment would let a new seams.fire("retry") hide inside the
+    # registered "utils.retry" row.
+    seam_re = re.compile(r"seams\.fire\(\s*\n?\s*\"([a-z0-9_.]+)\"")
+    seams_path = os.path.join("faults", "seams.py")
+    seams_source = next(
+        (text for path, text in sources.items()
+         if path.endswith(seams_path)), "")
+    # registry rows live in the MODULE DOCSTRING only — scanning the
+    # whole file would let an aligned dotted token in a code comment
+    # register a seam nobody put in the table
+    try:
+        seams_doc = ast.get_docstring(ast.parse(seams_source)) or ""
+    except SyntaxError:
+        seams_doc = ""
+    # registry rows: "  <dotted.name>[ / <dotted.name>]  <columns...>"
+    _name = r"[a-z0-9_]+(?:\.[a-z0-9_]+)+"
+    registered_seams = {
+        name
+        for row in re.findall(
+            rf"^\s*({_name}(?:\s*/\s*{_name})*)\s{{2,}}\S",
+            seams_doc, re.MULTILINE)
+        for name in re.split(r"\s*/\s*", row)}
+    fault_doc_path = os.path.join(REPO_ROOT, "docs",
+                                  "fault-injection.md")
+    fault_doc = open(fault_doc_path, encoding="utf-8").read() \
+        if os.path.exists(fault_doc_path) else ""
+    # docs table rows: "| `<dotted.name>` [/ `<dotted.name>`] | ..."
+    documented_seams = {
+        name
+        for cell in re.findall(r"^\|([^|]*)\|", fault_doc,
+                               re.MULTILINE)
+        for name in re.findall(rf"`({_name})`", cell)}
+    for path, text in sources.items():
+        if path.endswith(seams_path):
+            continue
+        for m in seam_re.finditer(text):
+            seam = m.group(1)
+            rel = os.path.relpath(path, REPO_ROOT)
+            if seam not in registered_seams:
+                errors.append(f"{rel}: seam {seam!r} is not registered "
+                              "in the faults/seams.py docstring")
+            if seam not in documented_seams:
+                errors.append(f"{rel}: seam {seam!r} is not documented "
+                              "in docs/fault-injection.md")
 
     # 5. grafana dashboards + prometheus alert rules resolve — against
     # METRICS only: an event is a journal record, never a Prometheus
