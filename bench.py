@@ -228,6 +228,7 @@ def run_bench(model: str = "tpu_1b", seq_len: int = 2048,
 SUITES = {
     "input_pipeline": "input_pipeline_bench.py",
     "telemetry_overhead": "telemetry_overhead.py",
+    "serving": "serving_bench.py",
 }
 
 
@@ -235,6 +236,73 @@ def run_suite(name: str) -> int:
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "benchmarks", SUITES[name])
     return subprocess.call([sys.executable, script])
+
+
+# --------------------------------------------------------- CPU dryrun --
+# When the device probe exhausts its budget (a wedged TPU runtime — the
+# BENCH_r04/r05 failure), the trajectory must not record another 0.0:
+# a RESTARTABLE subprocess pinned to JAX_PLATFORMS=cpu measures a tiny
+# training workload instead.  The record is clearly labeled
+# (mode=cpu_dryrun, its own metric name) so tools/perf_gate.py medians
+# it as its own trajectory and never mixes it into the flagship MFU.
+
+DRYRUN_METRIC = "train_cpu_dryrun_tokens_per_sec"
+
+
+def run_cpu_dryrun_child() -> int:
+    """The --cpu-dryrun entry point (runs inside the fallback child)."""
+    import jax
+
+    from cloudtik_tpu.models import transformer as T
+    from cloudtik_tpu.train.data import synthetic_lm_batches
+    from cloudtik_tpu.train.trainer import (
+        Trainer, TrainerConfig, transformer_spec)
+
+    # batch must shard across however many host devices this process
+    # sees (XLA_FLAGS can force several CPU devices)
+    batch, seq, steps = max(4, jax.device_count()), 64, 8
+    cfg = T.config("tiny", attention_impl="reference")
+    trainer = Trainer(transformer_spec(cfg), TrainerConfig(
+        global_batch_size=batch, seq_len=seq, log_every=steps))
+    data = synthetic_lm_batches(batch, seq, cfg.vocab_size, seed=0)
+    trainer.fit(data, num_steps=2)          # compile outside the window
+    t0 = time.perf_counter()
+    trainer.fit(data, num_steps=steps)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": DRYRUN_METRIC,
+        "value": round(batch * seq * steps / dt, 1),
+        "unit": "tokens/s",
+        "mode": "cpu_dryrun",
+        "detail": {"model": "tiny", "batch": batch, "seq_len": seq,
+                   "steps": steps},
+    }))
+    return 0
+
+
+def run_cpu_dryrun(timeout_s: float = 900.0):
+    """Run the dryrun in a fresh subprocess (the parent's jax runtime
+    may be wedged mid-TPU-init); returns the parsed record or None."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--cpu-dryrun"],
+            env=env, capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print("# cpu dryrun timed out", file=sys.stderr)
+        return None
+    sys.stderr.write(proc.stderr or "")
+    for line in reversed((proc.stdout or "").splitlines()):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            record = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(record, dict) and record.get("metric"):
+            return record
+    return None
 
 
 def main(argv=None):
@@ -246,7 +314,12 @@ def main(argv=None):
         default="flagship",
         help="which benchmark to run; non-flagship suites need no "
              "device probe (they run on CPU and TPU alike)")
+    parser.add_argument(
+        "--cpu-dryrun", action="store_true",
+        help=argparse.SUPPRESS)   # internal: the probe-failure child
     args = parser.parse_args(argv)
+    if args.cpu_dryrun:
+        return run_cpu_dryrun_child()
     if args.suite != "flagship":
         return run_suite(args.suite)
 
@@ -286,6 +359,15 @@ def main(argv=None):
         if isinstance(e, DeviceProbeError):
             record["error"] = str(e)
             record["diagnostics"] = e.diagnostics
+            # the trajectory never goes dark: fall back to a CPU-dryrun
+            # measurement in a fresh subprocess (clearly labeled, its
+            # own metric — perf_gate keeps it out of the MFU median)
+            dryrun = run_cpu_dryrun()
+            if dryrun is not None:
+                dryrun["probe_error"] = str(e)
+                dryrun["diagnostics"] = e.diagnostics
+                print(json.dumps(dryrun))
+                return 0
         print(json.dumps(record))
         return 0
     mfu_pct = result["mfu"] * 100

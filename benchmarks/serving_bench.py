@@ -1,0 +1,253 @@
+"""Serving benchmark: max sustained request rate at a TTFT SLO.
+
+The serving column of the BENCH trajectory.  A deterministic OPEN-LOOP
+load generator (seeded Poisson arrivals, seeded mixed prompt/output
+lengths — requests arrive on schedule whether or not the engine keeps
+up, so queueing delay is measured instead of hidden) drives an
+in-process continuous-batching `DecodeEngine` on the tiny CPU model,
+then binary-searches the highest request rate whose TTFT p95 still
+meets the SLO.  Latency percentiles come from the request-lifecycle
+ledger (serve/reqlog.py): each trial installs a fresh journal, so the
+stats cover exactly that trial's population.
+
+Prints ONE JSON line in the perf_gate-compatible shape (higher is
+better):
+
+  {"metric": "serving_rps_at_slo", "value": <req/s>, "unit": "req/s",
+   "detail": {ttft/tpot/queue-wait p50/p95/p99, availability, ...}}
+
+Runs on CPU (JAX_PLATFORMS defaults to cpu here) and TPU alike; always
+exits 0 (failures become an ``error`` record perf_gate skips).
+
+Run:  python bench.py --suite serving
+Gate: python bench.py --suite serving | \
+          python tools/perf_gate.py --fresh -
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+# the serving column is a CPU-reachable trajectory: the tiny model on
+# whatever platform is attached, CPU by default so a wedged TPU runtime
+# cannot take this suite dark too
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+METRIC = "serving_rps_at_slo"
+
+PROMPT_LENGTHS = (4, 6, 8, 12)
+OUTPUT_LENGTHS = (4, 8, 12)
+
+
+def build_engine(slots: int = 4, max_len: int = 64):
+    """Tiny-model engine, started; caller owns stop()."""
+    import jax
+
+    from cloudtik_tpu.models import transformer as T
+    from cloudtik_tpu.serve.engine import DecodeEngine, EngineConfig
+
+    cfg = T.config("tiny", dtype=jax.numpy.float32,
+                   attention_impl="reference", remat=False)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    engine = DecodeEngine(
+        params, cfg,
+        EngineConfig(slots=slots, max_len=max_len,
+                     prefill_buckets=(8, 16)))
+    engine.start()
+    return engine
+
+
+def warm_engine(engine) -> None:
+    """Compile prefill (both buckets) + decode outside any measured
+    trial — the SLO judges steady-state serving, not XLA."""
+    engine.generate([1, 2, 3, 4], max_new_tokens=2)
+    engine.generate(list(range(1, 11)), max_new_tokens=2)
+
+
+def run_trial(engine, rate: float, n_requests: int, seed: int,
+              ledger_dir: str, trial: int = 0,
+              timeout_s: float = 300.0):
+    """One open-loop trial at `rate` req/s; returns the ledger stats.
+
+    Deterministic: arrivals are seeded exponential inter-arrival draws
+    (an open-loop Poisson process), prompt/output lengths seeded
+    choices — same seed, same workload shape at every rate.
+    """
+    from cloudtik_tpu.serve import reqlog
+    from cloudtik_tpu.serve.engine import Request
+
+    rng = random.Random(seed)
+    arrivals = []
+    t = 0.0
+    for _ in range(n_requests):
+        t += rng.expovariate(rate)
+        arrivals.append(t)
+    shapes = [(rng.choice(PROMPT_LENGTHS), rng.choice(OUTPUT_LENGTHS))
+              for _ in range(n_requests)]
+
+    # the trial index keeps every file unique even when two phases of
+    # the search probe the same (rate, seed) — the journal appends, so
+    # a reused path would mix two populations into one stats read
+    path = os.path.join(ledger_dir,
+                        f"requests-{trial:03d}-{rate:.3f}.jsonl")
+    reqlog.install(path)
+    try:
+        requests = []
+        t0 = time.monotonic()
+        for due, (prompt_len, max_new) in zip(arrivals, shapes):
+            delay = t0 + due - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            req = Request([rng.randrange(1, 100)
+                           for _ in range(prompt_len)],
+                          max_new_tokens=max_new)
+            engine.submit(req)
+            requests.append(req)
+        for req in requests:
+            try:
+                req.wait(timeout=timeout_s)
+            except Exception:
+                # a stalled request must not outlive this trial's
+                # journal — finishing later would append to the NEXT
+                # trial's ledger and corrupt its stats; cancel, then
+                # wait for the loop thread to actually finish it (the
+                # ledger record lands at completion) before moving on
+                try:
+                    req.cancel()
+                    req.wait(timeout=5.0)
+                except Exception:
+                    pass
+    finally:
+        reqlog.uninstall()
+    return reqlog.compute_stats(reqlog.read_requests(path))
+
+
+def meets_slo(stats, slo_ttft_p95_s: float) -> bool:
+    p95 = stats["ttft_s"]["p95"]
+    served = stats["finish"].get("done", 0)
+    return p95 is not None and p95 <= slo_ttft_p95_s \
+        and served == stats["count"]
+
+
+def find_max_rate(engine, slo_ttft_p95_s: float, n_requests: int,
+                  seed: int, ledger_dir: str, lo: float = 4.0,
+                  max_rate: float = 64.0, iters: int = 4,
+                  min_rate: float = 0.5):
+    """(best_rate, best_stats): the highest rate meeting the SLO.
+
+    Phase 1 doubles from `lo` until the SLO breaks (or `max_rate`);
+    phase 2 bisects the bracket for `iters` rounds.  Returns (0.0,
+    last_stats) when even `min_rate` misses the SLO.
+    """
+    import itertools
+    trials = itertools.count()
+
+    def trial(rate):
+        stats = run_trial(engine, rate, n_requests, seed, ledger_dir,
+                          trial=next(trials))
+        print(f"# rate={rate:.2f} ttft_p95={stats['ttft_s']['p95']} "
+              f"ok={meets_slo(stats, slo_ttft_p95_s)}", file=sys.stderr)
+        return stats
+
+    best, best_stats = 0.0, None
+    rate = max(lo, min_rate)
+    hi = None
+    while rate <= max_rate:
+        stats = trial(rate)
+        if meets_slo(stats, slo_ttft_p95_s):
+            best, best_stats = rate, stats
+            rate *= 2
+        else:
+            hi = rate
+            break
+    if hi is None:
+        return best, best_stats     # never broke up to max_rate
+    if best == 0.0:
+        # even the opening rate failed: probe the floor before bisecting
+        stats = trial(min_rate)
+        if meets_slo(stats, slo_ttft_p95_s):
+            best, best_stats = min_rate, stats
+        else:
+            return 0.0, stats
+    lo_rate, hi_rate = best, hi
+    for _ in range(max(iters, 0)):
+        mid = (lo_rate + hi_rate) / 2.0
+        stats = trial(mid)
+        if meets_slo(stats, slo_ttft_p95_s):
+            lo_rate, best, best_stats = mid, mid, stats
+        else:
+            hi_rate = mid
+    return best, best_stats
+
+
+def run(slo_ttft_p95_s: float = 0.75, n_requests: int = 24,
+        seed: int = 0, slots: int = 4, lo: float = 4.0,
+        max_rate: float = 64.0, iters: int = 4):
+    engine = build_engine(slots=slots)
+    try:
+        warm_engine(engine)
+        with tempfile.TemporaryDirectory() as ledger_dir:
+            best, stats = find_max_rate(
+                engine, slo_ttft_p95_s, n_requests, seed, ledger_dir,
+                lo=lo, max_rate=max_rate, iters=iters)
+    finally:
+        engine.stop()
+    detail = {
+        "slo_ttft_p95_s": slo_ttft_p95_s,
+        "requests_per_trial": n_requests,
+        "slots": slots,
+        "seed": seed,
+    }
+    if stats is not None:
+        detail.update({
+            "ttft_s": stats["ttft_s"],
+            "tpot_s": stats["tpot_s"],
+            "queue_wait_s": stats["queue_wait_s"],
+            "availability": stats["availability"],
+            "finish": stats["finish"],
+        })
+    return best, detail
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="requests/sec at a TTFT SLO (perf_gate line)")
+    parser.add_argument("--slo-ttft-p95", type=float, default=0.75,
+                        help="TTFT p95 the searched rate must meet "
+                             "(seconds; default %(default)s)")
+    parser.add_argument("--requests", type=int, default=24,
+                        help="requests per trial")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--slots", type=int, default=4)
+    parser.add_argument("--lo", type=float, default=4.0,
+                        help="opening request rate")
+    parser.add_argument("--max-rate", type=float, default=64.0)
+    parser.add_argument("--iters", type=int, default=4,
+                        help="bisection rounds after the bracket")
+    args = parser.parse_args(argv)
+    try:
+        best, detail = run(
+            slo_ttft_p95_s=args.slo_ttft_p95, n_requests=args.requests,
+            seed=args.seed, slots=args.slots, lo=args.lo,
+            max_rate=args.max_rate, iters=args.iters)
+        result = {"metric": METRIC, "value": round(best, 3),
+                  "unit": "req/s", "detail": detail}
+        if best <= 0.0:
+            result["error"] = "no request rate met the TTFT SLO"
+    except Exception as e:
+        import traceback
+        traceback.print_exc()
+        result = {"metric": METRIC, "value": 0.0, "unit": "req/s",
+                  "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

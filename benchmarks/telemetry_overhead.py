@@ -66,6 +66,18 @@ def main() -> int:
         lambda: _prefetch._note_get(0.001, 2), n)
     disabled_prefetch_put_note_ns = _ns(
         lambda: _prefetch._note_put(0.001, 2), n)
+    # the request ledger's per-request append must be attribute checks
+    # when off (even with a journal installed)
+    import types as _types
+
+    from cloudtik_tpu.serve import reqlog as _reqlog
+    _req = _types.SimpleNamespace(
+        request_id=1, prompt=[1], tokens=[2], traceparent=None,
+        bucket=8, created=0.0, admitted=None, first_token_time=None,
+        done_time=0.0, created_mono=0.0, admitted_mono=None,
+        first_token_mono=None, done_mono=0.0)
+    disabled_reqlog_record_ns = _ns(
+        lambda: _reqlog.record(_req, "done"), n)
 
     telemetry.enable()
     telemetry.reset()
@@ -110,6 +122,8 @@ def main() -> int:
                 round(disabled_prefetch_note_ns, 1),
             "disabled_prefetch_producer_note_ns":
                 round(disabled_prefetch_put_note_ns, 1),
+            "disabled_reqlog_record_ns":
+                round(disabled_reqlog_record_ns, 1),
             "enabled_span_ns": round(enabled_span_ns, 1),
             "enabled_counter_inc_ns": round(enabled_counter_ns, 1),
             "enabled_histogram_observe_ns":

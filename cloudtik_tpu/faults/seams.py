@@ -22,6 +22,7 @@ Seam registry (keep docs/fault-injection.md in sync):
   node_agent.heartbeat            heartbeat publish     {ip, node_id}   supports drop
   checkpoint.save                 Checkpointer.save     {step, directory} supports torn_write
   events.append                   flight recorder append {name, path}    supports torn_write
+  serve.reqlog.append             request ledger append {name, path}     supports torn_write
   train.prefetch.next             prefetcher hand-off   {qsize}         latency -> data_wait
   serve.decode_step               DecodeEngine._step    {active}
   utils.retry                     every retry sleep     {fn, attempt}

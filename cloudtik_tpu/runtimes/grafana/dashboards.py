@@ -126,6 +126,14 @@ def ai_workload_dashboard() -> Dict[str, Any]:
                "tik_alerts_firing", "short", 0, 57),
         _panel(17, "XLA compiles",
                "rate(tik_train_compiles_total[5m])", "ops", 12, 57),
+        # -- Serving SLO row: burn rates the collector evaluates ----------
+        {"id": 18, "type": "row", "title": "Serving SLOs",
+         "collapsed": False,
+         "gridPos": {"h": 1, "w": 24, "x": 0, "y": 65}, "panels": []},
+        _panel(19, "SLO burn rate (fast/slow windows)",
+               "tik_slo_burn_rate", "short", 0, 66),
+        _panel(20, "SLO error budget remaining",
+               "tik_slo_error_budget_remaining", "percentunit", 12, 66),
     ]
     return {
         "uid": "tik-ai-workloads",

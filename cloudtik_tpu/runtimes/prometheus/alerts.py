@@ -9,8 +9,10 @@ Two layers:
     evaluated by the *built-in* collector every scrape cycle, so
     zero-egress TPU images get alerting without a prometheus binary.
     Rule kinds: ``threshold`` (value vs a bound, optionally a
-    histogram quantile computed from ``_bucket`` deltas between
-    cycles), ``absence`` (no series for a metric — a vanished
+    histogram quantile computed from ``_bucket`` deltas over the last
+    ``quantile_window`` scrape cycles of the shared
+    :class:`~cloudtik_tpu.runtimes.prometheus.windows.WindowStore`),
+    ``absence`` (no series for a metric — a vanished
     heartbeat source), and ``regression`` (current value vs a rolling
     baseline of its own history — step-time p95 creep).  Rules fire
     after `for_cycles` consecutive breaches, journal
@@ -37,6 +39,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import yaml
 
+from cloudtik_tpu.runtimes.prometheus.windows import WindowStore
 from cloudtik_tpu.telemetry import events
 
 
@@ -137,6 +140,7 @@ class AlertRule:
     op: str = ">"                   # threshold comparison
     threshold: float = 0.0
     quantile: Optional[float] = None  # compute from _bucket deltas
+    quantile_window: int = 1        # scrape cycles the quantile spans
     aggregate: str = "max"          # across matching series
     for_cycles: int = 1             # consecutive breaches to fire
     window: int = 20                # regression: baseline history size
@@ -188,35 +192,9 @@ def _match(labels: Dict[str, str],
     return all(labels.get(k, "") == v for k, v in matchers)
 
 
-def _histogram_quantile(q: float,
-                        buckets: List[Tuple[float, float]]) -> \
-        Optional[float]:
-    """Prometheus-style quantile over (upper_bound, count) per-bucket
-    (non-cumulative) counts with linear interpolation."""
-    buckets = sorted(buckets)
-    total = sum(c for _b, c in buckets)
-    if total <= 0:
-        return None
-    rank = q * total
-    seen = 0.0
-    lower = 0.0
-    for bound, count in buckets:
-        if seen + count >= rank:
-            if bound == float("inf"):
-                return lower   # best effort: the last finite bound
-            if count <= 0:
-                return bound
-            frac = (rank - seen) / count
-            return lower + (bound - lower) * frac
-        seen += count
-        if bound != float("inf"):
-            lower = bound
-    return lower
-
-
 class _RuleState:
     __slots__ = ("state", "streak", "since", "value", "last_eval",
-                 "history", "prev_buckets", "last_quantile")
+                 "history", "last_quantile")
 
     def __init__(self, window: int):
         self.state = STATE_OK
@@ -225,8 +203,6 @@ class _RuleState:
         self.value: Optional[float] = None
         self.last_eval: Optional[float] = None
         self.history: deque = deque(maxlen=max(window, 1))
-        self.prev_buckets: Optional[Dict[Tuple[Tuple[str, str], ...],
-                                         Dict[float, float]]] = None
         # last computed quantile, held across cycles that bring no new
         # observations (zero bucket delta / a flapped scrape) so a
         # quiet cycle cannot erase a breach streak
@@ -235,14 +211,29 @@ class _RuleState:
 
 class AlertEngine:
     """Evaluates the rule catalog against parsed Prometheus samples
-    ({name, labels, value} dicts) once per scrape cycle."""
+    ({name, labels, value} dicts) once per scrape cycle.
 
-    def __init__(self, rules: Optional[List[AlertRule]] = None):
+    Quantile rules query the shared :class:`WindowStore` instead of
+    keeping per-rule bucket snapshots; pass the collector's store via
+    `windows` (and ingest cycles there), or let the engine own a
+    private store that it feeds from each evaluate() call (the
+    standalone `tik alerts eval` path)."""
+
+    def __init__(self, rules: Optional[List[AlertRule]] = None,
+                 windows: Optional[WindowStore] = None):
         self.rules = list(rules) if rules is not None \
             else default_alert_rules()
         names = [r.name for r in self.rules]
         if len(names) != len(set(names)):
             raise ValueError(f"duplicate alert rule names in {names}")
+        self._owns_windows = windows is None
+        # an engine that owns its store is the one-shot `tik alerts
+        # eval --file/--url` path, where a single static exposition
+        # must show quantile rules the whole since-boot population; the
+        # collector's long-lived shared store baselines instead
+        # (windows.py module docstring)
+        self.windows = windows if windows is not None \
+            else WindowStore(since_boot=True)
         self._lock = threading.Lock()
         self._states = {r.name: _RuleState(r.window) for r in self.rules}
 
@@ -263,53 +254,18 @@ class AlertEngine:
             return sum(values) / len(values)
         return max(values)
 
-    def _quantile_value(self, rule: AlertRule, state: _RuleState,
-                        samples: List[Dict[str, Any]]) -> \
-            Optional[float]:
-        """Quantile of the metric's `_bucket` distribution, over the
-        DELTA since the previous cycle — recent latency, not
-        since-boot latency.  The first cycle uses the cumulative
+    def _quantile_value(self, rule: AlertRule,
+                        state: _RuleState) -> Optional[float]:
+        """Quantile of the metric's `_bucket` distribution over the
+        window store's last `quantile_window` cycles — recent latency,
+        not since-boot latency.  The first cycle uses the cumulative
         counts (delta from zero); a cycle with no new observations (or
         no scraped buckets at all) HOLDS the last computed quantile —
         the latency estimate is unchanged, so a quiet cycle must not
         read as recovery."""
-        bucket_name = rule.metric + "_bucket"
-        current: Dict[Tuple[Tuple[str, str], ...],
-                      Dict[float, float]] = {}
-        for sample in samples:
-            if sample.get("name") != bucket_name:
-                continue
-            labels = dict(sample.get("labels", {}))
-            le = labels.pop("le", None)
-            if le is None or not _match(labels, rule.labels):
-                continue
-            try:
-                bound = float("inf") if le == "+Inf" else float(le)
-                value = float(sample["value"])
-            except (TypeError, ValueError):
-                continue
-            key = tuple(sorted(labels.items()))
-            current.setdefault(key, {})[bound] = \
-                current.get(key, {}).get(bound, 0.0) + value
-        if not current:
-            return state.last_quantile
-        prev = state.prev_buckets or {}
-        state.prev_buckets = current
-        # merge series, convert cumulative counts to per-bucket deltas
-        merged: Dict[float, float] = {}
-        for key, bounds in current.items():
-            prev_bounds = prev.get(key, {})
-            cumulative = 0.0
-            prev_cumulative = 0.0
-            for bound in sorted(bounds):
-                delta_cum = bounds[bound] - prev_bounds.get(bound, 0.0)
-                per_bucket = max(
-                    delta_cum - (cumulative - prev_cumulative), 0.0)
-                cumulative = bounds[bound]
-                prev_cumulative = prev_bounds.get(bound, 0.0)
-                merged[bound] = merged.get(bound, 0.0) + per_bucket
-        value = _histogram_quantile(rule.quantile,
-                                    list(merged.items()))
+        value = self.windows.quantile_over_window(
+            rule.quantile, rule.metric, rule.labels,
+            window=rule.quantile_window)
         if value is None:
             return state.last_quantile
         state.last_quantile = value
@@ -326,7 +282,7 @@ class AlertEngine:
                 and _match(s.get("labels", {}), rule.labels))
             return matched == 0, float(matched)
         if rule.quantile is not None:
-            value = self._quantile_value(rule, state, samples)
+            value = self._quantile_value(rule, state)
         else:
             value = self._series_value(rule, samples)
         if value is None:
@@ -351,6 +307,11 @@ class AlertEngine:
                  now: Optional[float] = None) -> List[Dict[str, Any]]:
         """One evaluation cycle; returns the post-cycle state list."""
         now = time.time() if now is None else now
+        if self._owns_windows:
+            # standalone engine: each evaluate() IS one scrape cycle of
+            # its private store.  A shared (collector-owned) store is
+            # ingested once per cycle by the collector instead.
+            self.windows.ingest(samples, now)
         with self._lock:
             for rule in self.rules:
                 state = self._states[rule.name]

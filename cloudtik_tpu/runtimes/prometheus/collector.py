@@ -29,6 +29,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
 
+from cloudtik_tpu.utils.constants import env_integer
+
 _SAMPLE_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+([^\s]+)")
 _QUERY_RE = re.compile(
@@ -103,13 +105,26 @@ class ScrapeState:
 
 class Collector:
     def __init__(self, conf_dir: str, scrape_interval_s: float = 5.0,
-                 alert_rules=None):
+                 alert_rules=None, slos=None,
+                 window_cycles: Optional[int] = None):
         from cloudtik_tpu.runtimes.prometheus.alerts import AlertEngine
+        from cloudtik_tpu.runtimes.prometheus.windows import WindowStore
+        from cloudtik_tpu.telemetry.slo import SloEngine
         self.conf_dir = os.path.expanduser(conf_dir)
         self.scrape_interval_s = scrape_interval_s
         self.state = ScrapeState()
         self.started_at = time.time()
-        self.alerts = AlertEngine(alert_rules)
+        # ONE window store shared by the alert engine's quantile rules,
+        # the SLO burn-rate engine, and /api/v1/query_range — ingested
+        # exactly once per scrape cycle (evaluate_alerts)
+        if window_cycles is None:
+            # malformed env falls back to the default — a bad knob must
+            # never take the collector (and with it alerting + SLOs) down
+            window_cycles = env_integer("TIK_COLLECTOR_WINDOW_CYCLES", 60)
+        self.windows = WindowStore(cycles=window_cycles)
+        self.alerts = AlertEngine(alert_rules, windows=self.windows)
+        self.slos = SloEngine(slos)
+        self._slo_state: List[Dict[str, Any]] = self.slos.state()
         self._stop = threading.Event()
 
     # -- target discovery (file-SD) ---------------------------------------
@@ -153,9 +168,18 @@ class Collector:
         return samples
 
     def evaluate_alerts(self) -> List[Dict[str, Any]]:
-        """One alert-engine cycle over the latest scrapes (called after
-        every scrape pass)."""
-        return self.alerts.evaluate(self.alert_samples())
+        """One alert + SLO engine cycle over the latest scrapes (called
+        after every scrape pass): ingest the cycle into the shared
+        window store, then evaluate both engines against it."""
+        samples = self.alert_samples()
+        now = time.time()
+        self.windows.ingest(samples, now)
+        state = self.alerts.evaluate(samples, now)
+        self._slo_state = self.slos.evaluate(self.windows, now)
+        return state
+
+    def slo_state(self) -> List[Dict[str, Any]]:
+        return list(self._slo_state)
 
     # -- query -------------------------------------------------------------
     def instant_query(self, query: str) -> List[Dict[str, Any]]:
@@ -193,6 +217,31 @@ class Collector:
                 })
         return results
 
+    def range_query(self, query: str,
+                    window: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Windowed lookup over the retained scrape cycles: an exact
+        metric name with the same matcher set as /api/v1/query
+        (`=`, `!=`, `=~`, `!~`; regexes fully anchored), returned
+        prometheus-matrix-style ([{metric, values}])."""
+        q = _QUERY_RE.match(query.strip())
+        if not q:
+            return []
+        metric = q.group(1)
+        matchers = _MATCHER_RE.findall(q.group(2) or "")
+        out = []
+        for series in self.windows.query_range(metric, (),
+                                               window=window):
+            labels = series["labels"]
+            if any(not _matcher_ok(labels.get(k, ""), op, v)
+                   for k, op, v in matchers):
+                continue
+            out.append({
+                "metric": {"__name__": metric, **labels},
+                "values": [[ts, str(value)]
+                           for ts, value in series["points"]],
+            })
+        return out
+
     def render_metrics(self) -> str:
         """Aggregate scrapes into one valid exposition: every sample gets an
         instance="<address>" label so identical metric names from multiple
@@ -213,6 +262,28 @@ class Collector:
             lines.append(
                 f'tik_alerts_firing{{rule="{alert["name"]}"}} '
                 f'{1 if alert["state"] == "firing" else 0}')
+        slo_rows = self.slo_state()
+        if any(s["budget_remaining"] is not None for s in slo_rows):
+            lines.append("# HELP tik_slo_error_budget_remaining "
+                         "Fraction of the SLO error budget left.")
+            lines.append("# TYPE tik_slo_error_budget_remaining gauge")
+        if any(s["burn_fast"] is not None or s["burn_slow"] is not None
+               for s in slo_rows):
+            lines.append("# HELP tik_slo_burn_rate Error-budget burn "
+                         "rate over the fast/slow window.")
+            lines.append("# TYPE tik_slo_burn_rate gauge")
+        for slo in slo_rows:
+            if slo["budget_remaining"] is not None:
+                lines.append(
+                    f'tik_slo_error_budget_remaining'
+                    f'{{slo="{slo["name"]}"}} '
+                    f'{slo["budget_remaining"]:.6f}')
+            for window_name, value in (("fast", slo["burn_fast"]),
+                                       ("slow", slo["burn_slow"])):
+                if value is not None:
+                    lines.append(
+                        f'tik_slo_burn_rate{{slo="{slo["name"]}",'
+                        f'window="{window_name}"}} {value:.6f}')
         seen_headers: set = set()
         for target in self.state.snapshot().values():
             labels = "".join(
@@ -296,6 +367,25 @@ def make_handler(collector: Collector):
                     "status": "success",
                     "data": {"resultType": "vector",
                              "result": collector.instant_query(query)}}),
+                    "application/json")
+            elif parsed.path == "/api/v1/query_range":
+                params = parse_qs(parsed.query)
+                query = params.get("query", [""])[0]
+                try:
+                    window = int(params.get("window", ["0"])[0]) or None
+                except ValueError:
+                    window = None
+                self._send(200, json.dumps({
+                    "status": "success",
+                    "data": {
+                        "resultType": "matrix",
+                        "result": collector.range_query(query,
+                                                        window)}}),
+                    "application/json")
+            elif parsed.path == "/api/v1/slos":
+                self._send(200, json.dumps({
+                    "status": "success",
+                    "data": {"slos": collector.slo_state()}}),
                     "application/json")
             else:
                 self._send(404, "not found")

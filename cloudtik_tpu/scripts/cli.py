@@ -1043,6 +1043,85 @@ def alerts_eval(url, config_file, exposition_file, cycles, interval,
         sys.exit(2)
 
 
+# -------------------------------------------------------------------- slo --
+
+@cli.group(name="slo")
+def slo_group():
+    """Serving SLOs and error-budget burn rates, evaluated by the head
+    collector every scrape cycle (docs/observability.md
+    "SLOs & burn rates")."""
+
+
+@slo_group.command(name="status")
+@click.option("--url", default=None,
+              help="Collector base URL (default "
+                   "http://127.0.0.1:9090); fetches /api/v1/slos.")
+@click.option("--file", "exposition_file", default=None,
+              type=click.Path(exists=True),
+              help="Evaluate the catalog against a saved Prometheus "
+                   "exposition instead (single cycle: windows see the "
+                   "since-boot population).")
+@click.option("--catalog", is_flag=True,
+              help="Print the built-in SLO catalog (no collector "
+                   "needed).")
+@click.option("--json", "as_json", is_flag=True)
+def slo_status(url, exposition_file, catalog, as_json):
+    """Per-SLO state, burn rates, and error budget remaining."""
+    from cloudtik_tpu.telemetry.slo import (
+        default_slos, evaluate_exposition)
+    if catalog:
+        rows = [{"name": s.name, "kind": s.kind, "metric": s.metric,
+                 "objective": s.objective,
+                 "threshold_s": s.threshold_s or None,
+                 "burn_threshold": s.burn_threshold,
+                 "summary": s.summary}
+                for s in default_slos()]
+    elif exposition_file:
+        with open(exposition_file) as f:
+            rows = evaluate_exposition(f.read())
+    else:
+        import urllib.error
+        import urllib.request
+        base = (url or "http://127.0.0.1:9090").rstrip("/")
+        try:
+            with urllib.request.urlopen(
+                    base + "/api/v1/slos", timeout=10) as resp:
+                payload = json.loads(resp.read().decode())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            raise click.ClickException(
+                f"cannot fetch {base}/api/v1/slos: {e} (is the "
+                "built-in collector running? use --catalog for the "
+                "static SLO list, or --file against a saved "
+                "exposition)")
+        rows = payload.get("data", {}).get("slos", [])
+    if as_json:
+        click.echo(json.dumps(rows, indent=1))
+        return
+    if not rows:
+        cli_logger.info("No SLOs.")
+        return
+    width = max(len(r["name"]) for r in rows)
+
+    def _num(value, fmt="{:.2f}"):
+        return fmt.format(value) if isinstance(value, (int, float)) \
+            else "-"
+
+    for row in rows:
+        state = row.get("state", "-")
+        budget = row.get("budget_remaining")
+        budget_s = f"{budget * 100:.1f}%" \
+            if isinstance(budget, (int, float)) else "-"
+        click.echo(
+            f"{row['name']:<{width}}  {state:<7}  "
+            f"budget={budget_s:<8}  "
+            f"burn fast={_num(row.get('burn_fast'))} "
+            f"slow={_num(row.get('burn_slow'))}  "
+            f"{row.get('summary', '')}")
+    firing = [r for r in rows if r.get("state") == "firing"]
+    if firing:
+        cli_logger.warning("{} SLO(s) burning.", len(firing))
+
+
 # ---------------------------------------------------------------- profile --
 
 @cli.group(name="profile")
@@ -1248,6 +1327,104 @@ def events_tail(path, lines, follow):
                     continue
     except KeyboardInterrupt:
         pass
+
+
+# ------------------------------------------------------------------ serve --
+
+@cli.group(name="serve")
+def serve_group():
+    """Serving observability: the request-lifecycle ledger
+    (docs/observability.md "Request ledger").  The decode engine
+    appends one durable JSONL record per finished request; these verbs
+    replay it and compute offline percentiles/availability."""
+
+
+@serve_group.command(name="requests")
+@click.option("--path", default=None,
+              help="Ledger path (default: <tik home>/logs/"
+                   "serve-requests.jsonl; TIK_REQLOG_PATH overrides).")
+@click.option("--tail", "tail_n", type=int, default=None,
+              help="Only the newest N records.")
+@click.option("--since", "since_s", type=float, default=None,
+              help="Only records finished in the last N seconds.")
+@click.option("--finish", "finish_filter", default=None,
+              type=click.Choice(["done", "cancelled", "rejected",
+                                 "error", "drained"]),
+              help="Only records with this finish reason.")
+@click.option("--stats", "as_stats", is_flag=True,
+              help="Offline p50/p95/p99 (TTFT/TPOT/queue wait) and "
+                   "availability over the selected records.")
+@click.option("--json", "as_json", is_flag=True,
+              help="Emit raw records (or the stats dict) as JSON.")
+def serve_requests(path, tail_n, since_s, finish_filter, as_stats,
+                   as_json):
+    """Replay the request ledger (torn final line skipped)."""
+    import time as _time
+
+    from cloudtik_tpu.serve import reqlog
+    records = reqlog.read_requests(path)
+    if finish_filter:
+        records = [r for r in records
+                   if r.get("finish") == finish_filter]
+    if since_s is not None:
+        cutoff = _time.time() - since_s
+        records = [r for r in records
+                   if (r.get("done_ts") or r.get("ts") or 0) >= cutoff]
+    records.sort(key=lambda r: r.get("done_ts") or r.get("ts") or 0)
+    if tail_n is not None:
+        records = records[-tail_n:]
+    if as_stats:
+        stats = reqlog.compute_stats(records)
+        if as_json:
+            click.echo(json.dumps(stats, indent=1))
+            return
+        availability = stats["availability"]
+        avail_s = f"{availability * 100:.2f}%" \
+            if availability is not None else "-"
+        click.echo(f"requests: {stats['count']}   "
+                   f"availability: {avail_s}")
+        for reason, count in stats["finish"].items():
+            click.echo(f"  {reason:<12} {count}")
+        click.echo(f"{'latency':<12} {'count':>7} {'p50':>10} "
+                   f"{'p95':>10} {'p99':>10}")
+        for field, label in (("ttft_s", "ttft"),
+                             ("queue_wait_s", "queue_wait"),
+                             ("tpot_s", "tpot")):
+            entry = stats[field]
+
+            def _ms(v):
+                return f"{v * 1e3:>8.2f}ms" if v is not None else \
+                    f"{'-':>10}"
+
+            click.echo(f"{label:<12} {entry['count']:>7} "
+                       f"{_ms(entry['p50'])} {_ms(entry['p95'])} "
+                       f"{_ms(entry['p99'])}")
+        return
+    if as_json:
+        click.echo(json.dumps(records, indent=1, default=str))
+        return
+    if not records:
+        cli_logger.info("No request records (is a serving daemon "
+                        "running with the ledger installed?).")
+        return
+    import datetime as _dt
+    for record in records:
+        ts = _dt.datetime.fromtimestamp(
+            record.get("done_ts") or record.get("ts") or 0).strftime(
+            "%Y-%m-%d %H:%M:%S.%f")[:-3]
+
+        def _fmt_ms(key):
+            value = record.get(key)
+            return f"{value * 1e3:.1f}ms" \
+                if isinstance(value, (int, float)) else "-"
+
+        click.echo(
+            f"{ts}  #{record.get('request_id', '?'):<6} "
+            f"{record.get('finish', '?'):<10} "
+            f"prompt={record.get('prompt_tokens', '?'):<4} "
+            f"out={record.get('output_tokens', '?'):<4} "
+            f"queue={_fmt_ms('queue_wait_s')} "
+            f"ttft={_fmt_ms('ttft_s')} tpot={_fmt_ms('tpot_s')}")
 
 
 # ------------------------------------------------------------------ chaos --

@@ -45,6 +45,7 @@ import numpy as np
 
 from cloudtik_tpu import telemetry
 from cloudtik_tpu.faults import seams
+from cloudtik_tpu.serve import reqlog
 from cloudtik_tpu.telemetry import events, goodput
 from cloudtik_tpu.telemetry import instruments as ti
 from cloudtik_tpu.telemetry.core import STATE as _telemetry_state
@@ -108,6 +109,13 @@ class Request:
         self.admitted: Optional[float] = None
         self.first_token_time: Optional[float] = None
         self.done_time: Optional[float] = None
+        # monotonic twins of the wall stamps: the request ledger derives
+        # queue_wait/TTFT/TPOT from these (immune to wall-clock steps)
+        self.created_mono: float = time.monotonic()
+        self.admitted_mono: Optional[float] = None
+        self.first_token_mono: Optional[float] = None
+        self.done_mono: Optional[float] = None
+        self.bucket: Optional[int] = None     # prefill bucket at admit
         self._done = threading.Event()
         self._cancel = False
         # serializes completion: cancel() (caller thread) can race the
@@ -148,9 +156,11 @@ class Request:
                 if not self._done.is_set():
                     self.error = RequestCancelled("request cancelled")
                     self.done_time = time.time()
+                    self.done_mono = time.monotonic()
                     ti.SERVE_REQUESTS.inc(result="cancelled")
                     events.emit("tik_serve_cancel",
                                 request=self.request_id)
+                    reqlog.record(self, reqlog.FINISH_CANCELLED)
                     self._done.set()
         return True
 
@@ -368,19 +378,25 @@ class DecodeEngine:
         self._teardown()
 
     def _finish_request(self, req: Request, result: str,
-                        error: Optional[Exception] = None) -> None:
+                        error: Optional[Exception] = None,
+                        finish: Optional[str] = None) -> None:
         """Single completion point: stamp done_time, emit lifecycle
-        metrics + the per-request decode-window span, wake the waiter.
-        Atomic per request — safe from both the loop thread and a
-        caller thread cancelling."""
+        metrics + the per-request decode-window span, append the
+        request-ledger record, wake the waiter.  Atomic per request —
+        safe from both the loop thread and a caller thread cancelling.
+
+        `finish` is the ledger's finish reason (done|cancelled|error|
+        drained); by default it is derived from `result`."""
         with req._finish_lock:
             if req._done.is_set():
                 return
-            self._finish_request_locked(req, result, error)
+            self._finish_request_locked(req, result, error, finish)
 
     def _finish_request_locked(self, req: Request, result: str,
-                               error: Optional[Exception]) -> None:
+                               error: Optional[Exception],
+                               finish: Optional[str] = None) -> None:
         req.done_time = time.time()
+        req.done_mono = time.monotonic()
         if error is not None:
             req.error = error
         first = req.first_token_time
@@ -400,6 +416,15 @@ class DecodeEngine:
             with telemetry.trace_context(req.traceparent):
                 events.emit("tik_serve_cancel", request=req.request_id)
         ti.SERVE_REQUESTS.inc(result=result)
+        if finish is None:
+            # "rejected" stays distinct from "error": submit-time
+            # refusals are client-caused and spend no availability
+            # budget, matching the serve-availability SLO's exclusions
+            finish = {"ok": reqlog.FINISH_DONE,
+                      "cancelled": reqlog.FINISH_CANCELLED,
+                      "rejected": reqlog.FINISH_REJECTED}.get(
+                          result, reqlog.FINISH_ERROR)
+        reqlog.record(req, finish)
         req._done.set()
 
     def _drain_queue(self, reason: str) -> None:
@@ -408,17 +433,21 @@ class DecodeEngine:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
-            self._finish_request(req, "error", RuntimeError(reason))
+            self._finish_request(req, "error", RuntimeError(reason),
+                                 finish=reqlog.FINISH_DRAINED)
         ti.SERVE_QUEUE_DEPTH.set(0)
 
     def _teardown(self, reason: str = "engine stopped") -> None:
         """Fail everything still queued or mid-decode — callers must not
-        sit in wait() until their timeout after a shutdown."""
+        sit in wait() until their timeout after a shutdown.  The ledger
+        books these as `drained` so shutdown churn is distinguishable
+        from per-request errors when reading availability."""
         self._drain_queue(reason)
         for slot_id, slot in enumerate(self._slots):
             if slot is not None:
                 self._finish_request(slot.request, "error",
-                                     RuntimeError(reason))
+                                     RuntimeError(reason),
+                                     finish=reqlog.FINISH_DRAINED)
                 self._slots[slot_id] = None
 
     # -- engine loop ------------------------------------------------------
@@ -447,8 +476,10 @@ class DecodeEngine:
                 break
             try:
                 req.admitted = time.time()
+                req.admitted_mono = time.monotonic()
                 ti.SERVE_QUEUE_WAIT.observe(req.admitted - req.created)
                 true_len = len(req.prompt)
+                req.bucket = self._bucket(true_len)
                 # re-enter the request's trace: this is the loop thread,
                 # so the submit-side context does not carry over
                 with telemetry.trace_context(req.traceparent):
@@ -459,8 +490,7 @@ class DecodeEngine:
                                         request=req.request_id,
                                         prompt_len=true_len,
                                         slot=slot_id):
-                        padded = np.zeros((1, self._bucket(true_len)),
-                                          np.int32)
+                        padded = np.zeros((1, req.bucket), np.int32)
                         padded[0, :true_len] = req.prompt
                         pk, pv, first = self._prefill(
                             self.params, jnp.asarray(padded),
@@ -470,6 +500,7 @@ class DecodeEngine:
                         first_tok = int(first)
                 req.tokens.append(first_tok)
                 req.first_token_time = time.time()
+                req.first_token_mono = time.monotonic()
                 ti.SERVE_TTFT.observe(req.first_token_time - req.created)
                 ti.SERVE_TOKENS.inc()
                 self._tokens = self._tokens.at[slot_id].set(first_tok)

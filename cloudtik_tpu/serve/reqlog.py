@@ -1,0 +1,213 @@
+"""Request-lifecycle ledger: one durable JSONL record per serve request.
+
+Histograms answer "how slow is serving right now"; the request ledger
+answers "what happened to request 714" and "what were the REAL p99s over
+the last hour" — per-request records survive the process, so offline
+percentiles and availability are computed from the actual population
+instead of fixed histogram buckets.  `DecodeEngine._finish_request`
+appends one record per completed request:
+
+    {ts, seq, name: "request", traceparent?, request_id, finish,
+     bucket, prompt_tokens, output_tokens,
+     arrival_ts/admitted_ts/first_token_ts/done_ts           (epoch),
+     arrival_mono/admitted_mono/first_token_mono/done_mono   (monotonic),
+     queue_wait_s, ttft_s, tpot_s}
+
+``finish`` is one of ``done | cancelled | rejected | error | drained``
+(drained = the engine shut down with the request still in flight;
+rejected = refused at submit — empty or over-length prompt).
+Durability is
+the flight recorder's (telemetry/events.py): explicit flush per append,
+size-capped rotation to ``<path>.1`` keeping the newest records, a torn
+final line skipped on read — drilled through the ``serve.reqlog.append``
+fault seam.  ``tik serve requests [--tail|--stats|--since|--finish]``
+replays the ledger and computes offline p50/p95/p99 + availability.
+
+Emit discipline: ``reqlog.record(...)`` with ``TIK_TELEMETRY=off`` or no
+journal installed is attribute checks only.  The serving daemon installs
+the journal at boot (serve/server.py main); libraries never install.
+``TIK_REQLOG_PATH`` / ``TIK_REQLOG_MAX_BYTES`` override the defaults.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Any, Dict, List, Optional
+
+from cloudtik_tpu.faults import seams
+from cloudtik_tpu.telemetry import core, events
+from cloudtik_tpu.telemetry.events import EventJournal, read_file
+
+RECORD_NAME = "request"
+
+FINISH_DONE = "done"
+FINISH_CANCELLED = "cancelled"
+FINISH_REJECTED = "rejected"
+FINISH_ERROR = "error"
+FINISH_DRAINED = "drained"
+FINISH_REASONS = (FINISH_DONE, FINISH_CANCELLED, FINISH_REJECTED,
+                  FINISH_ERROR, FINISH_DRAINED)
+
+
+def default_path() -> str:
+    """`~/.tik/logs/serve-requests.jsonl` (inside the shipped log dirs
+    so the log agent and cluster dumps pick it up); TIK_REQLOG_PATH
+    overrides."""
+    override = os.environ.get("TIK_REQLOG_PATH")
+    if override:
+        return os.path.expanduser(override)
+    from cloudtik_tpu.utils.constants import tik_home
+    return os.path.join(tik_home(), "logs", "serve-requests.jsonl")
+
+
+class RequestJournal(EventJournal):
+    """The flight recorder's rotation/torn-line discipline, under the
+    request ledger's own fault seam."""
+
+    def _fire_seam(self, name: str) -> Optional[str]:
+        return seams.fire("serve.reqlog.append", name=name,
+                          path=self.path)
+
+
+# ------------------------------------------------------------- module api --
+
+# the install/uninstall/file-listing/warn-once discipline lives once, in
+# events.JournalSlot — this module only owns its journal class, env
+# knobs, and the per-request record shape
+_SLOT = events.JournalSlot(RequestJournal, default_path,
+                           "TIK_REQLOG_MAX_BYTES", "request ledger")
+
+
+def install(path: Optional[str] = None,
+            max_bytes: Optional[int] = None) -> RequestJournal:
+    """Install the process request journal (serving daemons, benches)."""
+    return _SLOT.install(path, max_bytes)
+
+
+def installed() -> Optional[RequestJournal]:
+    return _SLOT.journal
+
+
+def uninstall() -> None:
+    _SLOT.uninstall()
+
+
+def record(req, finish: str) -> None:
+    """Append one request-lifecycle record for a finished Request.
+
+    Fast path (telemetry off, or no journal installed) is attribute
+    checks only — no field derivation, no serialization, no I/O.
+    """
+    if not core.STATE.enabled:
+        return
+    journal = _SLOT.journal
+    if journal is None:
+        return
+    fields: Dict[str, Any] = {
+        "request_id": req.request_id,
+        "finish": finish,
+        "bucket": getattr(req, "bucket", None),
+        "prompt_tokens": len(req.prompt),
+        "output_tokens": len(req.tokens),
+        "arrival_ts": req.created,
+        "admitted_ts": req.admitted,
+        "first_token_ts": req.first_token_time,
+        "done_ts": req.done_time,
+        "arrival_mono": getattr(req, "created_mono", None),
+        "admitted_mono": getattr(req, "admitted_mono", None),
+        "first_token_mono": getattr(req, "first_token_mono", None),
+        "done_mono": getattr(req, "done_mono", None),
+    }
+    fields.update(derive_latencies(fields))
+    # the record carries the REQUEST's trace (the submit-side span),
+    # not whatever ambient context the finishing thread happens to
+    # hold — `tik serve requests` joins `tik cluster trace export`
+    # through it
+    with core.trace_context(getattr(req, "traceparent", None)):
+        _SLOT.guarded_append(journal, RECORD_NAME, fields)
+
+
+def derive_latencies(fields: Dict[str, Any]) -> Dict[str, Any]:
+    """queue_wait/TTFT/TPOT from the monotonic lifecycle stamps."""
+    arrival = fields.get("arrival_mono")
+    admitted = fields.get("admitted_mono")
+    first = fields.get("first_token_mono")
+    done = fields.get("done_mono")
+    out_tokens = fields.get("output_tokens") or 0
+    out: Dict[str, Any] = {
+        "queue_wait_s": None, "ttft_s": None, "tpot_s": None}
+    if arrival is not None and admitted is not None:
+        out["queue_wait_s"] = max(admitted - arrival, 0.0)
+    if arrival is not None and first is not None:
+        out["ttft_s"] = max(first - arrival, 0.0)
+    if first is not None and done is not None and out_tokens > 1:
+        out["tpot_s"] = max(done - first, 0.0) / (out_tokens - 1)
+    return out
+
+
+# --------------------------------------------------------------- readers --
+
+def journal_files(path: Optional[str] = None) -> List[str]:
+    """Existing ledger files for `path` (default: the installed
+    journal's path, else default_path()), oldest first."""
+    return _SLOT.files(path)
+
+
+def read_requests(path: Optional[str] = None) -> List[Dict[str, Any]]:
+    """All ledger records (rotated generation first — append order for a
+    single writer), torn lines skipped."""
+    out: List[Dict[str, Any]] = []
+    for p in journal_files(path):
+        records, _skipped = read_file(p)
+        out.extend(r for r in records if r.get("name") == RECORD_NAME)
+    return out
+
+
+# ------------------------------------------------------- offline stats --
+
+def percentile(values: List[float], q: float) -> Optional[float]:
+    """Linear-interpolated percentile of the actual population (not
+    bucket bounds — the ledger holds every request)."""
+    if not values:
+        return None
+    vs = sorted(values)
+    rank = (len(vs) - 1) * q
+    lo = math.floor(rank)
+    hi = math.ceil(rank)
+    if lo == hi:
+        return vs[lo]
+    frac = rank - lo
+    return vs[lo] * (1.0 - frac) + vs[hi] * frac
+
+
+def compute_stats(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Offline p50/p95/p99 and availability over ledger records.
+
+    Availability = done / (done + error + drained): cancellations and
+    submit-time rejections are client-caused, so they consume no error
+    budget — the same exclusion the `serve-availability` SLO applies
+    to the `result` counter labels (telemetry/slo.py).
+    """
+    finish: Dict[str, int] = {}
+    for rec in records:
+        reason = rec.get("finish", "unknown")
+        finish[reason] = finish.get(reason, 0) + 1
+    served = finish.get(FINISH_DONE, 0)
+    denominator = served + finish.get(FINISH_ERROR, 0) \
+        + finish.get(FINISH_DRAINED, 0)
+    stats: Dict[str, Any] = {
+        "count": len(records),
+        "finish": dict(sorted(finish.items())),
+        "availability": served / denominator if denominator else None,
+    }
+    for field in ("ttft_s", "queue_wait_s", "tpot_s"):
+        values = [float(rec[field]) for rec in records
+                  if isinstance(rec.get(field), (int, float))]
+        stats[field] = {
+            "count": len(values),
+            "p50": percentile(values, 0.50),
+            "p95": percentile(values, 0.95),
+            "p99": percentile(values, 0.99),
+        }
+    return stats

@@ -30,6 +30,20 @@ from cloudtik_tpu import telemetry
 logger = logging.getLogger(__name__)
 
 
+class BackendError(Exception):
+    """A request that failed AFTER acquiring an identity: carries the
+    response headers (request_id / traceparent) so the error response
+    still lets the client join `tik serve requests --finish error` and
+    `tik cluster trace export` — the exact cases the join matters for."""
+
+    def __init__(self, message: str,
+                 headers: Optional[Dict[str, str]] = None,
+                 status: int = 400):
+        super().__init__(message)
+        self.headers = dict(headers or {})
+        self.status = status
+
+
 class ModelBackend:
     """name + callable endpoints: {route_suffix: fn(payload) -> dict}."""
 
@@ -120,7 +134,7 @@ def engine_backend(model: str = "tiny",
         params, cfg, EngineConfig(slots=slots, max_len=max_len))
     engine.start()
 
-    def generate(payload: Dict[str, Any]) -> Dict[str, Any]:
+    def generate(payload: Dict[str, Any]):
         tokens = payload["tokens"]
         prompt = tokens[0] if tokens and isinstance(tokens[0], list) \
             else tokens
@@ -130,7 +144,20 @@ def engine_backend(model: str = "tiny",
             temperature=float(payload.get("temperature", 0.0)),
             eos_id=(int(payload["eos_id"])
                     if "eos_id" in payload else None)))
-        return {"tokens": [req.wait(timeout=600)]}
+        # hand the request's identity back in response headers: the
+        # client can join its call to `tik serve requests` (by
+        # request_id) and `tik cluster trace export --trace-id` (by the
+        # traceparent's trace id) without server-side log spelunking —
+        # on the error path too, where the join matters most
+        headers = {"x-tik-request-id": str(req.request_id)}
+        if req.traceparent:
+            headers["x-tik-traceparent"] = req.traceparent
+        try:
+            tokens = req.wait(timeout=600)
+        except Exception as e:
+            raise BackendError(str(e), headers) from e
+        return ({"tokens": [tokens],
+                 "request_id": req.request_id}, headers)
 
     backend = ModelBackend(f"transformer-engine:{model}",
                            {"generate": generate})
@@ -198,11 +225,15 @@ class ServeServer:
             def log_message(self, *args):
                 pass
 
-            def _send(self, code: int, obj: Dict[str, Any]) -> None:
+            def _send(self, code: int, obj: Dict[str, Any],
+                      extra_headers: Optional[Dict[str, str]] = None
+                      ) -> None:
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for key, value in (extra_headers or {}).items():
+                    self.send_header(key, value)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -229,7 +260,17 @@ class ServeServer:
                     # trace; without one each request is its own trace
                     with telemetry.trace_context(
                             self.headers.get("traceparent")):
-                        self._send(200, fn(payload))
+                        result = fn(payload)
+                    # backends may return (payload, headers) to expose
+                    # per-request identity (request_id / traceparent)
+                    if isinstance(result, tuple):
+                        obj, extra_headers = result
+                        self._send(200, obj, extra_headers)
+                    else:
+                        self._send(200, result)
+                except BackendError as e:
+                    logger.exception("serve request failed")
+                    self._send(e.status, {"error": str(e)}, e.headers)
                 except Exception as e:
                     logger.exception("serve request failed")
                     self._send(400, {"error": str(e)})
@@ -269,6 +310,16 @@ def main(argv=None) -> int:
     # warm restarts skip prefill/decode recompiles (TIK_COMPILE_CACHE_DIR)
     from cloudtik_tpu.utils.compile_cache import ensure_compile_cache
     ensure_compile_cache()
+
+    # daemon boot installs the request ledger (libraries never do);
+    # the engine appends one durable record per finished request
+    from cloudtik_tpu.serve import reqlog
+    try:
+        reqlog.install()
+    except OSError:
+        # serve without a ledger rather than refuse to boot — but say
+        # so, or `tik serve requests` coming back empty is a mystery
+        logger.warning("request ledger not installed", exc_info=True)
 
     backends = []
     if args.gbdt:

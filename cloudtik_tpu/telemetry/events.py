@@ -51,7 +51,10 @@ def default_path() -> str:
 
 
 class EventJournal:
-    """Append-only JSONL journal with size-capped rotation."""
+    """Append-only JSONL journal with size-capped rotation.
+
+    The serve request ledger (serve/reqlog.py) subclasses this to reuse
+    the rotation + torn-line discipline under its own fault seam."""
 
     def __init__(self, path: str, max_bytes: int = DEFAULT_MAX_BYTES):
         self.path = os.path.expanduser(path)
@@ -62,12 +65,15 @@ class EventJournal:
         self._seq = 0
         self._torn = False
 
-    def append(self, name: str, fields: Dict[str, Any]) -> Dict[str, Any]:
-        """Write one event record; returns the record as written."""
+    def _fire_seam(self, name: str) -> Optional[str]:
         # the torn-write drill point: same cooperative directive as the
         # checkpoint seam — the line lands truncated, mid-record, which
         # is exactly what a host dying mid-append leaves behind
-        directive = seams.fire("events.append", name=name, path=self.path)
+        return seams.fire("events.append", name=name, path=self.path)
+
+    def append(self, name: str, fields: Dict[str, Any]) -> Dict[str, Any]:
+        """Write one event record; returns the record as written."""
+        directive = self._fire_seam(name)
         traceparent = core.current_traceparent()
         with self._lock:
             self._seq += 1
@@ -124,32 +130,78 @@ class EventJournal:
 
 # ------------------------------------------------------------- module api --
 
-_JOURNAL: Optional[EventJournal] = None
-_write_warned = False
+class JournalSlot:
+    """The module-level journal state one journal family owns: install /
+    installed / uninstall / file listing, plus the warn-once append
+    guard.  events.py and the serve request ledger (serve/reqlog.py)
+    each hold one instance, so the rotation-listing and disk-failure
+    discipline exist in exactly one place."""
+
+    def __init__(self, journal_cls, default_path_fn, max_bytes_env: str,
+                 label: str):
+        self.journal_cls = journal_cls
+        self.default_path_fn = default_path_fn
+        self.max_bytes_env = max_bytes_env
+        self.label = label
+        self.journal = None
+        self._write_warned = False
+
+    def install(self, path: Optional[str] = None,
+                max_bytes: Optional[int] = None):
+        if max_bytes is None:
+            # malformed env falls back to the default — a bad knob must
+            # never take a daemon down at boot
+            from cloudtik_tpu.utils.constants import env_integer
+            max_bytes = env_integer(self.max_bytes_env,
+                                    DEFAULT_MAX_BYTES)
+        if self.journal is not None:
+            self.journal.close()
+        self.journal = self.journal_cls(path or self.default_path_fn(),
+                                        max_bytes)
+        return self.journal
+
+    def uninstall(self) -> None:
+        if self.journal is not None:
+            self.journal.close()
+        self.journal = None
+
+    def files(self, path: Optional[str] = None) -> List[str]:
+        """Existing journal files for `path` (default: the installed
+        journal's path, else the family default), oldest first."""
+        if path is None:
+            path = self.journal.path if self.journal is not None \
+                else self.default_path_fn()
+        path = os.path.expanduser(path)
+        return [p for p in (path + ROTATED_SUFFIX, path)
+                if os.path.isfile(p)]
+
+    def guarded_append(self, journal, name: str,
+                       fields: Dict[str, Any]) -> None:
+        try:
+            journal.append(name, fields)
+        except OSError as e:
+            # a full/readonly disk must never take the writer down
+            if not self._write_warned:
+                self._write_warned = True
+                logger.warning("%s write failed: %s", self.label, e)
+
+
+_SLOT = JournalSlot(EventJournal, default_path, "TIK_EVENTS_MAX_BYTES",
+                    "flight recorder")
 
 
 def install(path: Optional[str] = None,
             max_bytes: Optional[int] = None) -> EventJournal:
     """Install the process journal (daemons call this at boot)."""
-    global _JOURNAL
-    if max_bytes is None:
-        max_bytes = int(os.environ.get("TIK_EVENTS_MAX_BYTES",
-                                       DEFAULT_MAX_BYTES))
-    if _JOURNAL is not None:
-        _JOURNAL.close()
-    _JOURNAL = EventJournal(path or default_path(), max_bytes)
-    return _JOURNAL
+    return _SLOT.install(path, max_bytes)
 
 
 def installed() -> Optional[EventJournal]:
-    return _JOURNAL
+    return _SLOT.journal
 
 
 def uninstall() -> None:
-    global _JOURNAL
-    if _JOURNAL is not None:
-        _JOURNAL.close()
-    _JOURNAL = None
+    _SLOT.uninstall()
 
 
 def emit(name: str, **fields) -> None:
@@ -157,17 +209,10 @@ def emit(name: str, **fields) -> None:
     journal installed) is attribute checks only."""
     if not core.STATE.enabled:
         return
-    journal = _JOURNAL
+    journal = _SLOT.journal
     if journal is None:
         return
-    try:
-        journal.append(name, fields)
-    except OSError as e:
-        # a full/readonly disk must never take the control plane down
-        global _write_warned
-        if not _write_warned:
-            _write_warned = True
-            logger.warning("flight recorder write failed: %s", e)
+    _SLOT.guarded_append(journal, name, fields)
 
 
 # --------------------------------------------------------------- readers --
@@ -200,11 +245,7 @@ def read_file(path: str) -> Tuple[List[Dict[str, Any]], int]:
 def journal_files(path: Optional[str] = None) -> List[str]:
     """Existing journal files for `path` (default: the installed
     journal's path, else default_path()), oldest first."""
-    if path is None:
-        journal = _JOURNAL
-        path = journal.path if journal is not None else default_path()
-    path = os.path.expanduser(path)
-    return [p for p in (path + ROTATED_SUFFIX, path) if os.path.isfile(p)]
+    return _SLOT.files(path)
 
 
 def read_events(path: Optional[str] = None) -> List[Dict[str, Any]]:

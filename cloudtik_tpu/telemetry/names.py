@@ -199,6 +199,14 @@ _ALL = [
     _m("tik_alerts_firing", "gauge",
        "1 per firing alert rule, 0 otherwise (collector's alert "
        "engine).", "runtimes", ("rule",), source="external"),
+    _m("tik_slo_error_budget_remaining", "gauge",
+       "Fraction of the SLO's error budget left over the collector's "
+       "retained window (1 = untouched, <0 = overspent).", "runtimes",
+       ("slo",), source="external"),
+    _m("tik_slo_burn_rate", "gauge",
+       "Error-budget burn rate per SLO over the fast/slow window "
+       "(1.0 = spending exactly the budget).", "runtimes",
+       ("slo", "window"), source="external"),
 ]
 
 METRICS: Dict[str, MetricSpec] = {}

@@ -13,8 +13,10 @@ import pytest
 
 from cloudtik_tpu import telemetry
 from cloudtik_tpu.runtimes.prometheus.alerts import (
-    AlertEngine, AlertRule, _histogram_quantile, default_alert_rules,
+    AlertEngine, AlertRule, default_alert_rules,
     samples_from_exposition)
+from cloudtik_tpu.runtimes.prometheus.windows import (
+    histogram_quantile as _histogram_quantile)
 from cloudtik_tpu.telemetry import events
 
 HEALTHY = """\

@@ -159,8 +159,13 @@ class TestBenchProbeDiagnostics:
 
     def test_run_device_probe_raises_with_diagnostics(self, bench):
         with pytest.raises(bench.DeviceProbeError) as excinfo:
+            # generous probe_s: the child exits instantly, but a loaded
+            # box can take >1s just to spawn it — a tight timeout turns
+            # this into a flaky spawn-phase timeout instead of rc=9.
+            # budget == probe_s leaves no room for a retry, so exactly
+            # one attempt runs and the test stays fast.
             bench.run_device_probe(
-                probe_s=0.3, budget_s=0.5, retry_wait_s=0.1,
+                probe_s=30, budget_s=30, retry_wait_s=0.1,
                 probe_cmd=[sys.executable, "-c", "raise SystemExit(9)"])
         diagnostics = excinfo.value.diagnostics
         assert diagnostics["returncode"] == 9
@@ -173,6 +178,90 @@ class TestBenchProbeDiagnostics:
         assert diagnostics["phase"] == "done"
         assert diagnostics["devices"]
         assert diagnostics["jax_platforms"] == "cpu"
+
+
+class TestCpuDryrunFallback:
+    """Open item 3 first step: a probe failure must never record 0.0
+    again — bench.py falls back to a labeled CPU-dryrun measurement,
+    and perf_gate keeps it out of real-device medians."""
+
+    def test_gate_excludes_dryrun_from_real_median(self, perf_gate,
+                                                   tmp_path):
+        _trajectory(tmp_path, [48.0, 48.2], metric="m")
+        dryrun = tmp_path / "BENCH_r09.json"
+        # a mislabeled dryrun under the SAME metric name must still be
+        # excluded from the real trajectory's median
+        dryrun.write_text(json.dumps({"parsed": {
+            "metric": "m", "value": 9000.0, "mode": "cpu_dryrun"}}))
+        paths = [str(p) for p in tmp_path.glob("BENCH_*.json")]
+        history = perf_gate.load_history(paths, metric="m")
+        assert [v for _p, v in history] == [48.0, 48.2]
+
+    def test_dryrun_metric_forms_its_own_trajectory(self, perf_gate,
+                                                    tmp_path):
+        record = {"parsed": {
+            "metric": "train_cpu_dryrun_tokens_per_sec",
+            "value": 18000.0, "mode": "cpu_dryrun"}}
+        (tmp_path / "BENCH_r10.json").write_text(json.dumps(record))
+        paths = [str(p) for p in tmp_path.glob("BENCH_*.json")]
+        history = perf_gate.load_history(
+            paths, metric="train_cpu_dryrun_tokens_per_sec")
+        assert [v for _p, v in history] == [18000.0]
+        code, report = perf_gate.gate(
+            {"metric": "train_cpu_dryrun_tokens_per_sec",
+             "value": 17500.0, "mode": "cpu_dryrun"}, history, 10.0)
+        assert code == 0
+        assert report["mode"] == "cpu_dryrun"
+
+    def test_probe_failure_falls_back_to_dryrun_record(self, bench,
+                                                       monkeypatch,
+                                                       capsys):
+        def fail_probe(*a, **k):
+            raise bench.DeviceProbeError(
+                "probe timed out", {"phase": "device_init",
+                                    "timed_out": True})
+
+        monkeypatch.setattr(bench, "run_device_probe", fail_probe)
+        monkeypatch.setattr(
+            bench, "run_cpu_dryrun",
+            lambda **k: {"metric": bench.DRYRUN_METRIC,
+                         "value": 12345.0, "unit": "tokens/s",
+                         "mode": "cpu_dryrun"})
+        assert bench.main([]) == 0
+        line = [l for l in capsys.readouterr().out.splitlines()
+                if l.strip().startswith("{")][-1]
+        record = json.loads(line)
+        assert record["metric"] == bench.DRYRUN_METRIC
+        assert record["mode"] == "cpu_dryrun"
+        assert record["value"] == 12345.0
+        # the probe's diagnostics ride along: the fallback record still
+        # tells the BENCH_r05 story in-band
+        assert "probe timed out" in record["probe_error"]
+        assert record["diagnostics"]["phase"] == "device_init"
+
+    def test_dryrun_child_parse_skips_commentary(self, bench,
+                                                 monkeypatch):
+        class FakeProc:
+            stdout = ("# warmup noise\nnot json\n"
+                      + json.dumps({"metric": bench.DRYRUN_METRIC,
+                                    "value": 5.0,
+                                    "mode": "cpu_dryrun"}) + "\n")
+            stderr = ""
+
+        monkeypatch.setattr(bench.subprocess, "run",
+                            lambda *a, **k: FakeProc())
+        record = bench.run_cpu_dryrun()
+        assert record["value"] == 5.0
+
+    def test_dryrun_child_emits_labeled_record(self, bench, capsys):
+        """The actual --cpu-dryrun child workload, in-process (this
+        test session IS a CPU jax)."""
+        assert bench.run_cpu_dryrun_child() == 0
+        record = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert record["metric"] == bench.DRYRUN_METRIC
+        assert record["mode"] == "cpu_dryrun"
+        assert record["value"] > 0
 
 
 class TestBenchSuiteDispatch:

@@ -1,0 +1,293 @@
+"""Request-lifecycle ledger (serve/reqlog.py): journal durability
+(rotation under the byte cap, torn final line skipped via the
+serve.reqlog.append seam), engine integration (one record per finished
+request with derived latencies), offline stats, and the
+`tik serve requests` CLI."""
+
+from __future__ import annotations
+
+import json
+import threading
+import types
+
+import jax
+import pytest
+
+from cloudtik_tpu import telemetry
+from cloudtik_tpu.faults import seams
+from cloudtik_tpu.faults.plan import FaultPlan, FaultPoint
+from cloudtik_tpu.serve import reqlog
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.enable()
+    telemetry.reset()
+    yield
+    reqlog.uninstall()
+    telemetry.enable()
+    telemetry.reset()
+
+
+def _fake_request(request_id=1, finish_shape="full"):
+    """A Request-shaped object: reqlog.record only reads attributes."""
+    req = types.SimpleNamespace(
+        request_id=request_id,
+        prompt=[1, 2, 3, 4],
+        tokens=[7, 8, 9, 10],
+        traceparent=None,
+        bucket=8,
+        created=100.0, admitted=100.2, first_token_time=100.5,
+        done_time=101.1,
+        created_mono=10.0, admitted_mono=10.2, first_token_mono=10.5,
+        done_mono=11.1)
+    if finish_shape == "queued_only":
+        req.admitted = req.first_token_time = req.done_time = None
+        req.admitted_mono = req.first_token_mono = None
+        req.done_mono = 10.1
+        req.tokens = []
+    return req
+
+
+class TestJournalDurability:
+    def test_record_fields_and_derived_latencies(self, tmp_path):
+        path = str(tmp_path / "req.jsonl")
+        reqlog.install(path)
+        reqlog.record(_fake_request(42), reqlog.FINISH_DONE)
+        records = reqlog.read_requests(path)
+        assert len(records) == 1
+        rec = records[0]
+        assert rec["name"] == "request"
+        assert rec["request_id"] == 42
+        assert rec["finish"] == "done"
+        assert rec["bucket"] == 8
+        assert rec["prompt_tokens"] == 4
+        assert rec["output_tokens"] == 4
+        assert rec["queue_wait_s"] == pytest.approx(0.2)
+        assert rec["ttft_s"] == pytest.approx(0.5)
+        # tpot over output_tokens - 1 inter-token gaps
+        assert rec["tpot_s"] == pytest.approx(0.6 / 3)
+
+    def test_rotation_keeps_newest_under_the_cap(self, tmp_path):
+        import os
+        path = str(tmp_path / "req.jsonl")
+        journal = reqlog.install(path, max_bytes=2048)
+        for i in range(200):
+            reqlog.record(_fake_request(i), reqlog.FINISH_DONE)
+        files = reqlog.journal_files(path)
+        assert files                      # current (and maybe rotated)
+        total = sum(os.path.getsize(f) for f in files)
+        assert total <= 2 * journal.max_bytes + 1024
+        records = reqlog.read_requests(path)
+        # the NEWEST records always survive rotation
+        assert records[-1]["request_id"] == 199
+        ids = [r["request_id"] for r in records]
+        assert ids == sorted(ids)
+
+    def test_torn_final_line_skipped_via_seam(self, tmp_path):
+        path = str(tmp_path / "req.jsonl")
+        reqlog.install(path)
+        plan = FaultPlan([FaultPoint(seam="serve.reqlog.append",
+                                     kind="torn_write", at_call=3)])
+        with seams.armed(plan):
+            for i in range(3):
+                reqlog.record(_fake_request(i), reqlog.FINISH_DONE)
+        assert plan.points[0].fired == 1
+        records = reqlog.read_requests(path)
+        assert [r["request_id"] for r in records] == [0, 1]
+        # the next append terminates the torn line; only IT was lost
+        reqlog.record(_fake_request(3), reqlog.FINISH_DONE)
+        records = reqlog.read_requests(path)
+        assert [r["request_id"] for r in records] == [0, 1, 3]
+
+    def test_no_journal_and_disabled_are_noops(self, tmp_path):
+        # no journal installed: nothing written, nothing raised
+        reqlog.record(_fake_request(1), reqlog.FINISH_DONE)
+        assert reqlog.read_requests(str(tmp_path / "nope.jsonl")) == []
+        # telemetry off: the installed journal must not be touched
+        path = str(tmp_path / "req.jsonl")
+        reqlog.install(path)
+        telemetry.disable()
+        try:
+            reqlog.record(_fake_request(2), reqlog.FINISH_DONE)
+        finally:
+            telemetry.enable()
+        assert reqlog.read_requests(path) == []
+
+    def test_queued_only_request_records_nulls(self, tmp_path):
+        path = str(tmp_path / "req.jsonl")
+        reqlog.install(path)
+        reqlog.record(_fake_request(5, "queued_only"),
+                      reqlog.FINISH_CANCELLED)
+        rec = reqlog.read_requests(path)[0]
+        assert rec["finish"] == "cancelled"
+        assert rec["queue_wait_s"] is None
+        assert rec["ttft_s"] is None
+        assert rec["tpot_s"] is None
+
+
+class TestStats:
+    def _records(self):
+        out = []
+        for i in range(20):
+            out.append({"name": "request", "finish": "done",
+                        "ttft_s": 0.01 * (i + 1),
+                        "queue_wait_s": 0.001,
+                        "tpot_s": 0.002})
+        out.append({"name": "request", "finish": "error"})
+        out.append({"name": "request", "finish": "cancelled"})
+        return out
+
+    def test_percentiles_and_availability(self):
+        stats = reqlog.compute_stats(self._records())
+        assert stats["count"] == 22
+        assert stats["finish"] == {"cancelled": 1, "done": 20,
+                                   "error": 1}
+        # cancellations spend no budget: 20 done / (20 done + 1 error)
+        assert stats["availability"] == pytest.approx(20 / 21)
+        assert stats["ttft_s"]["count"] == 20
+        assert stats["ttft_s"]["p50"] == pytest.approx(0.105)
+        assert stats["ttft_s"]["p99"] <= 0.2
+        assert stats["ttft_s"]["p95"] <= stats["ttft_s"]["p99"]
+
+    def test_empty_population(self):
+        stats = reqlog.compute_stats([])
+        assert stats["count"] == 0
+        assert stats["availability"] is None
+        assert stats["ttft_s"]["p95"] is None
+
+
+class TestEngineIntegration:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        from cloudtik_tpu.models import transformer as T
+        from cloudtik_tpu.serve.engine import DecodeEngine, EngineConfig
+        cfg = T.config("tiny", dtype=jax.numpy.float32,
+                       attention_impl="reference", remat=False)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        engine = DecodeEngine(
+            params, cfg,
+            EngineConfig(slots=2, max_len=64, prefill_buckets=(8, 16)))
+        engine.start()
+        yield engine
+        engine.stop()
+
+    def test_done_record_carries_lifecycle(self, engine, tmp_path):
+        from cloudtik_tpu.serve.engine import Request
+        path = str(tmp_path / "req.jsonl")
+        reqlog.install(path)
+        req = engine.submit(Request([3, 1, 4, 1, 5], max_new_tokens=6))
+        req.wait(timeout=300)
+        records = [r for r in reqlog.read_requests(path)
+                   if r["request_id"] == req.request_id]
+        assert len(records) == 1
+        rec = records[0]
+        assert rec["finish"] == "done"
+        assert rec["prompt_tokens"] == 5
+        assert rec["output_tokens"] == 6
+        assert rec["bucket"] == 8           # 5 tokens -> bucket 8
+        assert rec["ttft_s"] > 0
+        assert rec["queue_wait_s"] >= 0
+        assert rec["tpot_s"] > 0
+        # the record joins the request's distributed trace
+        assert rec.get("traceparent") == req.traceparent
+        # monotonic stamps are ordered
+        assert rec["arrival_mono"] <= rec["admitted_mono"] \
+            <= rec["first_token_mono"] <= rec["done_mono"]
+
+    def test_cancelled_and_rejected_records(self, engine, tmp_path):
+        from cloudtik_tpu.serve.engine import Request, RequestCancelled
+        path = str(tmp_path / "req.jsonl")
+        reqlog.install(path)
+        victim = engine.submit(Request([9, 8, 7], max_new_tokens=40))
+        for _ in range(400):
+            if len(victim.tokens) >= 1:
+                break
+            threading.Event().wait(0.02)
+        victim.cancel()
+        with pytest.raises(RequestCancelled):
+            victim.wait(timeout=60)
+        rejected = engine.submit(Request([], max_new_tokens=4))
+        with pytest.raises(ValueError):
+            rejected.wait(timeout=5)
+        by_id = {r["request_id"]: r
+                 for r in reqlog.read_requests(path)}
+        assert by_id[victim.request_id]["finish"] == "cancelled"
+        # submit-time refusal is client-caused: distinct from "error"
+        # so it spends no availability budget (matching the SLO)
+        assert by_id[rejected.request_id]["finish"] == "rejected"
+
+    def test_stop_drains_as_drained(self, tmp_path):
+        """An engine stopped with queued work books those requests as
+        `drained`, not `error` — shutdown churn is distinguishable."""
+        from cloudtik_tpu.serve.engine import DecodeEngine, Request
+        path = str(tmp_path / "req.jsonl")
+        reqlog.install(path)
+        # a never-started engine: stop() drains the queue caller-side
+        engine = DecodeEngine.__new__(DecodeEngine)
+        from cloudtik_tpu.serve.engine import EngineConfig
+        engine.ec = EngineConfig(slots=1, max_len=64)
+        import queue as _queue
+        engine._queue = _queue.Queue()
+        engine._slots = [None]
+        engine._stop = threading.Event()
+        engine._wake = threading.Event()
+        engine._thread = None
+        req = Request([1, 2, 3], max_new_tokens=4)
+        req._engine = engine
+        engine._queue.put(req)
+        engine.stop()
+        records = [r for r in reqlog.read_requests(path)
+                   if r["request_id"] == req.request_id]
+        assert records and records[0]["finish"] == "drained"
+
+
+class TestServeRequestsCLI:
+    def _write_ledger(self, tmp_path):
+        path = str(tmp_path / "req.jsonl")
+        reqlog.install(path)
+        for i in range(5):
+            reqlog.record(_fake_request(i), reqlog.FINISH_DONE)
+        reqlog.record(_fake_request(99, "queued_only"),
+                      reqlog.FINISH_CANCELLED)
+        reqlog.uninstall()
+        return path
+
+    def test_dump_tail_and_filters(self, tmp_path):
+        from click.testing import CliRunner
+
+        from cloudtik_tpu.scripts.cli import cli
+        path = self._write_ledger(tmp_path)
+        runner = CliRunner()
+        result = runner.invoke(cli, ["serve", "requests", "--path",
+                                     path, "--json"])
+        assert result.exit_code == 0, result.output
+        assert len(json.loads(result.output)) == 6
+        result = runner.invoke(cli, ["serve", "requests", "--path",
+                                     path, "--tail", "2", "--json"])
+        assert len(json.loads(result.output)) == 2
+        result = runner.invoke(cli, ["serve", "requests", "--path",
+                                     path, "--finish", "cancelled",
+                                     "--json"])
+        records = json.loads(result.output)
+        assert len(records) == 1 and records[0]["request_id"] == 99
+
+    def test_stats_surface(self, tmp_path):
+        from click.testing import CliRunner
+
+        from cloudtik_tpu.scripts.cli import cli
+        path = self._write_ledger(tmp_path)
+        result = CliRunner().invoke(
+            cli, ["serve", "requests", "--path", path, "--stats",
+                  "--json"])
+        assert result.exit_code == 0, result.output
+        stats = json.loads(result.output)
+        assert stats["count"] == 6
+        assert stats["availability"] == 1.0   # cancel spends no budget
+        assert stats["ttft_s"]["p95"] == pytest.approx(0.5)
+        # human table renders too
+        result = CliRunner().invoke(
+            cli, ["serve", "requests", "--path", path, "--stats"])
+        assert result.exit_code == 0, result.output
+        assert "availability" in result.output
+        assert "ttft" in result.output

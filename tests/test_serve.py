@@ -77,6 +77,36 @@ class TestServeServer:
         assert _post(server.port, "/v1/nope", {})[0] == 404
 
 
+class TestResponseIdentityHeaders:
+    def test_tuple_backends_set_headers(self):
+        """A backend returning (payload, headers) — the engine backend
+        hands back request_id + traceparent — must surface those as
+        HTTP response headers so clients can join `tik serve requests`
+        and `tik cluster trace export --trace-id`."""
+        from cloudtik_tpu.serve.server import ModelBackend, ServeServer
+
+        backend = ModelBackend("fake", {"generate": lambda payload: (
+            {"tokens": [[1]], "request_id": 714},
+            {"x-tik-request-id": "714",
+             "x-tik-traceparent": "00-" + "ab" * 16 + "-"
+             + "cd" * 8 + "-01"})})
+        server = ServeServer([backend], host="127.0.0.1")
+        server.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/v1/generate",
+                data=json.dumps({"tokens": [[1, 2]]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                body = json.loads(resp.read())
+                assert resp.headers["x-tik-request-id"] == "714"
+                assert resp.headers["x-tik-traceparent"].startswith(
+                    "00-")
+            assert body["request_id"] == 714
+        finally:
+            server.stop()
+
+
 class TestTransformerServing:
     def test_generate_endpoint_matches_direct(self):
         from cloudtik_tpu.models import generate as G

@@ -1,0 +1,404 @@
+"""Windowed query engine + SLO burn-rate substrate: WindowStore
+semantics (delta/rate/quantile over cycles, flap handling), the SLO
+engine's multi-window burn rates and alert-event journaling, the
+collector's /api/v1/query_range + /api/v1/slos surfaces, and the
+`tik slo status` CLI."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from cloudtik_tpu import telemetry
+from cloudtik_tpu.runtimes.prometheus.alerts import (
+    samples_from_exposition)
+from cloudtik_tpu.runtimes.prometheus.windows import (
+    WindowStore, histogram_quantile)
+from cloudtik_tpu.telemetry import events
+from cloudtik_tpu.telemetry.slo import (
+    SLO, SloEngine, default_slos, evaluate_exposition)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.enable()
+    telemetry.reset()
+    yield
+    telemetry.enable()
+    telemetry.reset()
+
+
+def _samples(text):
+    return samples_from_exposition(text)
+
+
+DEGRADED_SERVE = """\
+tik_serve_ttft_seconds_bucket{le="1"} 2
+tik_serve_ttft_seconds_bucket{le="2.5"} 5
+tik_serve_ttft_seconds_bucket{le="+Inf"} 100
+tik_serve_tpot_seconds_bucket{le="0.25"} 100
+tik_serve_tpot_seconds_bucket{le="+Inf"} 100
+tik_serve_requests_total{result="ok"} 80
+tik_serve_requests_total{result="error"} 20
+"""
+
+HEALTHY_SERVE = """\
+tik_serve_ttft_seconds_bucket{le="1"} 98
+tik_serve_ttft_seconds_bucket{le="2.5"} 100
+tik_serve_ttft_seconds_bucket{le="+Inf"} 100
+tik_serve_tpot_seconds_bucket{le="0.25"} 100
+tik_serve_tpot_seconds_bucket{le="+Inf"} 100
+tik_serve_requests_total{result="ok"} 100
+tik_serve_requests_total{result="cancelled"} 5
+"""
+
+# a first cycle of all-zero counters: the baseline a long-lived store
+# needs before deltas mean "recent traffic" (windows.py young-series
+# baseline — a restarted collector must not read since-boot totals as
+# fresh errors)
+ZERO_SERVE = """\
+tik_serve_ttft_seconds_bucket{le="1"} 0
+tik_serve_ttft_seconds_bucket{le="2.5"} 0
+tik_serve_ttft_seconds_bucket{le="+Inf"} 0
+tik_serve_tpot_seconds_bucket{le="0.25"} 0
+tik_serve_tpot_seconds_bucket{le="+Inf"} 0
+tik_serve_requests_total{result="ok"} 0
+tik_serve_requests_total{result="error"} 0
+"""
+
+
+class TestWindowStore:
+    def test_delta_over_window_counts_increase(self):
+        store = WindowStore(cycles=10)
+        for value in (10, 20, 50):
+            store.ingest(_samples(f'tik_x_total{{job="a"}} {value}\n'))
+        deltas = store.delta_over_window("tik_x_total", window=1)
+        assert deltas == [({"job": "a"}, 30.0)]
+        deltas = store.delta_over_window("tik_x_total", window=2)
+        assert deltas == [({"job": "a"}, 40.0)]
+        # wider than the series' life: baseline at the first RETAINED
+        # point — the 10 the counter was born with (e.g. a collector
+        # restart seeing a warm service) never counts as recent
+        deltas = store.delta_over_window("tik_x_total", window=9)
+        assert deltas == [({"job": "a"}, 40.0)]
+
+    def test_since_boot_store_counts_from_zero(self):
+        # the one-shot `--file` evaluation path: a single exposition IS
+        # the whole population, so the first cycle yields full deltas
+        store = WindowStore(cycles=10, since_boot=True)
+        store.ingest(_samples('tik_x_total{job="a"} 50\n'))
+        deltas = store.delta_over_window("tik_x_total", window=5)
+        assert deltas == [({"job": "a"}, 50.0)]
+
+    def test_new_series_on_reporting_instance_counts_in_full(self):
+        # a label materializing mid-run (the first error) really did
+        # start from zero — its whole count is recent
+        store = WindowStore(cycles=10)
+        store.ingest(_samples(
+            'tik_x_total{instance="h:1",result="ok"} 10\n'))
+        store.ingest(_samples(
+            'tik_x_total{instance="h:1",result="ok"} 12\n'
+            'tik_x_total{instance="h:1",result="error"} 3\n'))
+        deltas = dict((labels["result"], delta) for labels, delta in
+                      store.delta_over_window("tik_x_total", window=5))
+        assert deltas["error"] == 3.0    # born after its instance
+        assert deltas["ok"] == 2.0       # born with its instance
+
+    def test_flapped_series_returns_none(self):
+        store = WindowStore(cycles=10)
+        store.ingest(_samples("tik_x_total 5\n"))
+        store.ingest([])          # the target flapped this cycle
+        assert store.delta_over_window("tik_x_total", window=1) is None
+        assert store.quantile_over_window(
+            0.95, "tik_serve_ttft_seconds") is None
+
+    def test_counter_reset_clamps_to_zero(self):
+        store = WindowStore(cycles=10)
+        store.ingest(_samples("tik_x_total 100\n"))
+        store.ingest(_samples("tik_x_total 3\n"))   # process restarted
+        deltas = store.delta_over_window("tik_x_total", window=1)
+        assert deltas == [({}, 0.0)]
+
+    def test_rate_over_window(self):
+        store = WindowStore(cycles=10)
+        store.ingest(_samples("tik_x_total 0\n"), now=100.0)
+        store.ingest(_samples("tik_x_total 50\n"), now=110.0)
+        rate = store.rate_over_window("tik_x_total", window=1)
+        assert rate == pytest.approx(5.0)
+        # single-point series: no span to rate over
+        fresh = WindowStore()
+        fresh.ingest(_samples("tik_x_total 5\n"), now=100.0)
+        assert fresh.rate_over_window("tik_x_total", window=1) is None
+
+    def test_quantile_over_window_uses_deltas(self):
+        store = WindowStore(cycles=10)
+        # cycle 0: the zero baseline; cycle 1: 100 fast observations
+        store.ingest(_samples(
+            'tik_serve_ttft_seconds_bucket{le="0.1"} 0\n'
+            'tik_serve_ttft_seconds_bucket{le="1"} 0\n'
+            'tik_serve_ttft_seconds_bucket{le="+Inf"} 0\n'))
+        store.ingest(_samples(
+            'tik_serve_ttft_seconds_bucket{le="0.1"} 100\n'
+            'tik_serve_ttft_seconds_bucket{le="1"} 100\n'
+            'tik_serve_ttft_seconds_bucket{le="+Inf"} 100\n'))
+        q = store.quantile_over_window(0.95, "tik_serve_ttft_seconds",
+                                       window=1)
+        assert q is not None and q <= 0.1
+        # cycle 2: 100 NEW slow observations land in (1, +Inf]... use
+        # a finite upper bucket so interpolation has a bound
+        store.ingest(_samples(
+            'tik_serve_ttft_seconds_bucket{le="0.1"} 100\n'
+            'tik_serve_ttft_seconds_bucket{le="1"} 100\n'
+            'tik_serve_ttft_seconds_bucket{le="+Inf"} 200\n'))
+        q = store.quantile_over_window(0.95, "tik_serve_ttft_seconds",
+                                       window=1)
+        assert q == pytest.approx(1.0)   # best effort: last finite bound
+        # zero delta (a quiet window): None, so consumers hold state
+        store.ingest(_samples(
+            'tik_serve_ttft_seconds_bucket{le="0.1"} 100\n'
+            'tik_serve_ttft_seconds_bucket{le="1"} 100\n'
+            'tik_serve_ttft_seconds_bucket{le="+Inf"} 200\n'))
+        assert store.quantile_over_window(
+            0.95, "tik_serve_ttft_seconds", window=1) is None
+
+    def test_query_range_returns_points(self):
+        store = WindowStore(cycles=4)
+        for i in range(6):
+            store.ingest(_samples(f"tik_serve_queue_depth {i}\n"),
+                         now=100.0 + i)
+        series = store.query_range("tik_serve_queue_depth")
+        assert len(series) == 1
+        # the ring retains only the last `cycles` points
+        assert [v for _ts, v in series[0]["points"]] == [2, 3, 4, 5]
+        series = store.query_range("tik_serve_queue_depth", window=2)
+        assert [v for _ts, v in series[0]["points"]] == [4, 5]
+
+    def test_histogram_quantile_interpolation(self):
+        buckets = [(0.1, 10.0), (1.0, 80.0), (10.0, 10.0),
+                   (float("inf"), 0.0)]
+        p50 = histogram_quantile(0.5, buckets)
+        assert 0.1 < p50 < 1.0
+        assert histogram_quantile(0.5, [(1.0, 0.0)]) is None
+
+
+class TestSloSpec:
+    def test_catalog_names_unique_and_metrics_known(self):
+        from cloudtik_tpu.telemetry.names import METRICS
+        slos = default_slos()
+        names = [s.name for s in slos]
+        assert len(names) == len(set(names))
+        assert {"serve-ttft", "serve-tpot",
+                "serve-availability"} <= set(names)
+        for slo in slos:
+            assert slo.metric in METRICS
+
+    def test_bad_specs_rejected(self):
+        with pytest.raises(ValueError, match="unknown kind"):
+            SLO(name="x", kind="nope", metric="tik_serve_ttft_seconds",
+                objective=0.9, summary="s")
+        with pytest.raises(ValueError, match="objective"):
+            SLO(name="x", kind="availability",
+                metric="tik_serve_requests_total", objective=1.5,
+                summary="s")
+        with pytest.raises(ValueError, match="threshold"):
+            SLO(name="x", kind="latency",
+                metric="tik_serve_ttft_seconds", objective=0.9,
+                summary="s")
+        with pytest.raises(ValueError, match="duplicate"):
+            slo = default_slos()[0]
+            SloEngine([slo, slo])
+
+
+class TestSloEngine:
+    def test_degraded_run_burns_and_journals(self, tmp_path,
+                                             monkeypatch):
+        monkeypatch.setenv("TIK_EVENTS_PATH",
+                           str(tmp_path / "events.jsonl"))
+        events.install()
+        try:
+            import dataclasses
+            store = WindowStore()
+            # cycle 1 is the zero baseline — a long-lived store counts
+            # increase it OBSERVED, not since-boot totals
+            store.ingest(_samples(ZERO_SERVE))
+            store.ingest(_samples(DEGRADED_SERVE))
+            # short windows so a 2-cycle drill can separate fast from
+            # slow (the defaults span 5/30 scrape cycles)
+            engine = SloEngine([
+                dataclasses.replace(s, fast_window=1, slow_window=2)
+                for s in default_slos()])
+            state = {s["name"]: s for s in engine.evaluate(store)}
+            ttft = state["serve-ttft"]
+            # 95/100 requests miss the 2.5s threshold: error rate 0.95
+            # over a 0.05 budget -> burn 19x on both windows
+            assert ttft["state"] == "firing"
+            assert ttft["burn_fast"] == pytest.approx(19.0)
+            assert ttft["burn_slow"] == pytest.approx(19.0)
+            assert ttft["budget_remaining"] < 0
+            avail = state["serve-availability"]
+            assert avail["state"] == "firing"
+            assert avail["burn_fast"] == pytest.approx(20.0)
+            # tpot was healthy throughout
+            assert state["serve-tpot"]["state"] == "ok"
+            fired = [e for e in events.read_events()
+                     if e["name"] == "tik_alert_fired"]
+            assert {e["rule"] for e in fired} >= {
+                "slo:serve-ttft", "slo:serve-availability"}
+            # recovery: 400 NEW fast+ok events swamp the error rate
+            store.ingest(_samples(
+                'tik_serve_ttft_seconds_bucket{le="1"} 402\n'
+                'tik_serve_ttft_seconds_bucket{le="2.5"} 405\n'
+                'tik_serve_ttft_seconds_bucket{le="+Inf"} 500\n'
+                'tik_serve_requests_total{result="ok"} 480\n'
+                'tik_serve_requests_total{result="error"} 20\n'))
+            state = {s["name"]: s for s in engine.evaluate(store)}
+            assert state["serve-availability"]["state"] == "ok"
+            resolved = [e for e in events.read_events()
+                        if e["name"] == "tik_alert_resolved"]
+            assert any(e["rule"] == "slo:serve-availability"
+                       for e in resolved)
+        finally:
+            events.uninstall()
+
+    def test_healthy_run_stays_ok_with_budget(self):
+        state = {s["name"]: s
+                 for s in evaluate_exposition(HEALTHY_SERVE)}
+        assert all(s["state"] == "ok" for s in state.values())
+        # 2 of 100 requests over 1s but under 2.5s: still good
+        assert state["serve-ttft"]["burn_fast"] == pytest.approx(0.0)
+        assert state["serve-ttft"]["budget_remaining"] == \
+            pytest.approx(1.0)
+        # cancellations spend no availability budget
+        assert state["serve-availability"]["burn_fast"] == \
+            pytest.approx(0.0)
+
+    def test_no_traffic_holds_state(self):
+        import dataclasses
+        store = WindowStore()
+        store.ingest(_samples(ZERO_SERVE))
+        store.ingest(_samples(DEGRADED_SERVE))
+        # fast_window=1 so the identical third cycle is a zero-delta
+        # (no-traffic) fast window, not a still-breaching one
+        engine = SloEngine([
+            dataclasses.replace(s, fast_window=1, slow_window=2)
+            for s in default_slos()])
+        state = {s["name"]: s for s in engine.evaluate(store)}
+        assert state["serve-ttft"]["state"] == "firing"
+        # identical exposition: zero delta = no traffic, state holds
+        store.ingest(_samples(DEGRADED_SERVE))
+        state = {s["name"]: s for s in engine.evaluate(store)}
+        assert state["serve-ttft"]["state"] == "firing"
+        assert state["serve-availability"]["state"] == "firing"
+
+
+class TestCollectorIntegration:
+    def _collector(self, tmp_path, text):
+        """A collector whose one target first reported zeros for a
+        cycle, then `text`: the degraded counts are increase the store
+        OBSERVED (a fresh collector scraping a warm service sees no
+        deltas on its first cycle — restart safety)."""
+        from cloudtik_tpu.runtimes.prometheus.collector import Collector
+        collector = Collector(str(tmp_path))
+        collector.state.update("10.0.0.3:9103", {"job": "telemetry"},
+                               ZERO_SERVE, None)
+        collector.evaluate_alerts()
+        collector.state.update("10.0.0.3:9103", {"job": "telemetry"},
+                               text, None)
+        return collector
+
+    def test_cycle_evaluates_slos_and_renders_gauges(self, tmp_path):
+        collector = self._collector(tmp_path, DEGRADED_SERVE)
+        collector.evaluate_alerts()
+        firing = {s["name"] for s in collector.slo_state()
+                  if s["state"] == "firing"}
+        assert {"serve-ttft", "serve-availability"} <= firing
+        text = collector.render_metrics()
+        assert 'tik_slo_burn_rate{slo="serve-ttft",window="fast"}' \
+            in text
+        assert 'tik_slo_error_budget_remaining{slo="serve-ttft"}' \
+            in text
+
+    def test_restarted_collector_holds_on_warm_service(self, tmp_path):
+        """The restart drill itself: a FRESH collector scraping a
+        service with a bad history must not page — those errors are
+        history, not recent traffic."""
+        from cloudtik_tpu.runtimes.prometheus.collector import Collector
+        collector = Collector(str(tmp_path))
+        collector.state.update("10.0.0.3:9103", {"job": "telemetry"},
+                               DEGRADED_SERVE, None)
+        collector.evaluate_alerts()
+        collector.evaluate_alerts()
+        assert not [s for s in collector.slo_state()
+                    if s["state"] == "firing"]
+
+    def test_http_slos_and_query_range(self, tmp_path):
+        from http.server import ThreadingHTTPServer
+
+        from cloudtik_tpu.runtimes.prometheus.collector import (
+            make_handler)
+        collector = self._collector(tmp_path, DEGRADED_SERVE)
+        collector.evaluate_alerts()
+        server = ThreadingHTTPServer(("127.0.0.1", 0),
+                                     make_handler(collector))
+        thread = threading.Thread(target=server.serve_forever,
+                                  daemon=True)
+        thread.start()
+        try:
+            port = server.server_address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/api/v1/slos",
+                    timeout=5) as resp:
+                payload = json.loads(resp.read().decode())
+            slos = {s["name"]: s for s in payload["data"]["slos"]}
+            assert payload["status"] == "success"
+            assert slos["serve-ttft"]["state"] == "firing"
+            url = (f"http://127.0.0.1:{port}/api/v1/query_range?"
+                   "query=tik_serve_requests_total"
+                   '%7Bresult%3D%22ok%22%7D&window=10')
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                payload = json.loads(resp.read().decode())
+            assert payload["data"]["resultType"] == "matrix"
+            result = payload["data"]["result"]
+            assert len(result) == 1
+            assert result[0]["metric"]["result"] == "ok"
+            assert len(result[0]["values"]) == 2   # two cycles ingested
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_shared_store_feeds_alert_quantiles(self, tmp_path):
+        """The alert engine's quantile rules read the SAME store the
+        collector ingests — no private bucket snapshots."""
+        collector = self._collector(tmp_path, DEGRADED_SERVE)
+        assert collector.alerts.windows is collector.windows
+        for _ in range(3):
+            collector.evaluate_alerts()
+        by = {a["name"]: a for a in collector.alerts.state()}
+        # 95% of TTFT observations above 2.5s: the quantile rule fires
+        assert by["ServeTTFTHigh"]["state"] == "firing"
+
+
+class TestSloCLI:
+    def test_status_from_file_and_catalog(self, tmp_path):
+        from click.testing import CliRunner
+
+        from cloudtik_tpu.scripts.cli import cli
+        degraded = tmp_path / "degraded.txt"
+        degraded.write_text(DEGRADED_SERVE)
+        runner = CliRunner()
+        result = runner.invoke(cli, ["slo", "status", "--file",
+                                     str(degraded), "--json"])
+        assert result.exit_code == 0, result.output
+        by = {s["name"]: s for s in json.loads(result.output)}
+        assert by["serve-ttft"]["state"] == "firing"
+        result = runner.invoke(cli, ["slo", "status", "--file",
+                                     str(degraded)])
+        assert result.exit_code == 0, result.output
+        assert "burning" in result.output
+        result = runner.invoke(cli, ["slo", "status", "--catalog"])
+        assert result.exit_code == 0, result.output
+        for name in ("serve-ttft", "serve-tpot", "serve-availability"):
+            assert name in result.output

@@ -119,10 +119,12 @@ class TestDisabledPathIsFree:
             raise AssertionError(
                 "telemetry record path reached while disabled")
 
+        from cloudtik_tpu.serve import reqlog as treqlog
         monkeypatch.setattr(tcore.Counter, "_record", boom)
         monkeypatch.setattr(tcore.Gauge, "_record", boom)
         monkeypatch.setattr(tcore.Histogram, "_record", boom)
         monkeypatch.setattr(tcore.SpanRing, "append", boom)
+        monkeypatch.setattr(treqlog.RequestJournal, "append", boom)
         monkeypatch.setenv("TIK_TELEMETRY", "off")
         telemetry.configure_from_env()
         yield
@@ -168,15 +170,22 @@ class TestDisabledPathIsFree:
             "echo hi", with_output=True)
         assert out == "out"
 
+        from cloudtik_tpu.serve import reqlog
         from cloudtik_tpu.serve.engine import DecodeEngine, Request
         rejected = DecodeEngine.__new__(DecodeEngine)  # no device state
-        # reject path runs _finish_request without touching slots
+        # reject path runs _finish_request without touching slots; a
+        # request journal IS installed, so the ledger append in the
+        # completion path must stay behind the attribute check too
         from cloudtik_tpu.serve.engine import EngineConfig
         rejected.ec = EngineConfig(slots=1, max_len=8)
-        req = Request([])
-        rejected.submit(req)
-        with pytest.raises(ValueError):
-            req.wait(timeout=1)
+        reqlog.install(str(tmp_path / "requests.jsonl"))
+        try:
+            req = Request([])
+            rejected.submit(req)
+            with pytest.raises(ValueError):
+                req.wait(timeout=1)
+        finally:
+            reqlog.uninstall()
 
 
 class TestServeDrill:

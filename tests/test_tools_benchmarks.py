@@ -1,19 +1,30 @@
-"""Tests for the tools/benchmarks harnesses (dry-run command plans)."""
+"""Tests for the tools/benchmarks harnesses (dry-run command plans)
+and the serving benchmark (`bench.py --suite serving`): a tiny-rate
+smoke whose JSON line pipes into `perf_gate --fresh -`, and the
+degraded-engine drill — fault-injected decode latency measurably
+lowers `serving_rps_at_slo` while `tik slo status` reports the burn."""
 
 import importlib.util
+import io
+import json
 import sys
 from pathlib import Path
 
 import pytest
 
-TOOLS = Path(__file__).resolve().parents[1] / "tools" / "benchmarks"
+REPO = Path(__file__).resolve().parents[1]
+TOOLS = REPO / "tools" / "benchmarks"
 
 
-def _load(relpath, name):
-    spec = importlib.util.spec_from_file_location(name, TOOLS / relpath)
+def _load_path(path, name):
+    spec = importlib.util.spec_from_file_location(name, path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _load(relpath, name):
+    return _load_path(TOOLS / relpath, name)
 
 
 class TestTPCDS:
@@ -60,6 +71,113 @@ class TestServingLatency:
         out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
         assert out["requests"] == 10
         assert out["p50_ms"] > 0 and out["p99_ms"] >= out["p50_ms"]
+
+
+class TestServingBench:
+    @pytest.fixture(scope="class")
+    def serving(self):
+        return _load_path(REPO / "benchmarks" / "serving_bench.py",
+                          "serving_bench")
+
+    @pytest.fixture(autouse=True)
+    def _clean_telemetry(self):
+        from cloudtik_tpu import telemetry
+        telemetry.enable()
+        telemetry.reset()
+        yield
+        telemetry.enable()
+        telemetry.reset()
+
+    def test_smoke_line_pipes_into_perf_gate(self, serving, capsys,
+                                             monkeypatch):
+        """Tiny rate, few requests: main() emits one perf_gate-
+        compatible line and the gate accepts it (`--fresh -`)."""
+        rc = serving.main(["--requests", "5", "--iters", "1",
+                           "--lo", "4", "--max-rate", "8",
+                           "--slo-ttft-p95", "2.0"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        line = [l for l in out.splitlines()
+                if l.strip().startswith("{")][-1]
+        record = json.loads(line)
+        assert record["metric"] == "serving_rps_at_slo"
+        assert record["value"] > 0
+        assert "error" not in record
+        detail = record["detail"]
+        # percentile detail comes from the request ledger
+        assert detail["ttft_s"]["p95"] is not None
+        assert detail["queue_wait_s"]["p99"] is not None
+        assert detail["availability"] == 1.0
+
+        perf_gate = _load_path(REPO / "tools" / "perf_gate.py",
+                               "perf_gate_serving")
+        monkeypatch.setattr("sys.stdin", io.StringIO(line))
+        assert perf_gate.main(["--fresh", "-"]) == 0
+
+    def test_degraded_engine_lowers_rps_and_burns_slo(self, serving,
+                                                      tmp_path,
+                                                      monkeypatch):
+        """Fault-injected decode latency (the existing
+        `serve.decode_step` seam) must measurably lower
+        serving_rps_at_slo, and the engine's own exposition must show
+        the TTFT SLO burning via `tik slo status --file`."""
+        from click.testing import CliRunner
+
+        from cloudtik_tpu import telemetry
+        from cloudtik_tpu.faults import seams
+        from cloudtik_tpu.faults.plan import FaultPlan, FaultPoint
+        from cloudtik_tpu.scripts.cli import cli
+
+        # fixed 4-token generations keep the degraded trials to a few
+        # seconds each AND make the burn margin deterministic: wave-1
+        # requests hold both slots for ~3 injected decode steps (3s), so
+        # every queued request's TTFT lands well past the catalog's 2.5s
+        # threshold (prefill itself is not behind the decode_step seam,
+        # so only queue wait drives TTFT). The assertions are
+        # directional (degraded < healthy, burn fires) and don't need
+        # the bench's production output-length mix.
+        monkeypatch.setattr(serving, "OUTPUT_LENGTHS", (4,))
+
+        engine = serving.build_engine(slots=2)
+        try:
+            serving.warm_engine(engine)
+            slo_s = 1.0
+            healthy, _stats = serving.find_max_rate(
+                engine, slo_s, n_requests=5, seed=0,
+                ledger_dir=str(tmp_path / "healthy"), lo=4.0,
+                max_rate=16.0, iters=1)
+            assert healthy >= 4.0
+
+            # isolate the degraded phase's histograms so the SLO burn
+            # below reflects exactly the drilled traffic
+            telemetry.reset()
+            # 1.0s per decode step pushes queued requests' TTFT well
+            # past the catalog's 2.5s threshold (burn margin), while 4
+            # short requests keep each degraded trial to a few seconds
+            plan = FaultPlan([FaultPoint(
+                seam="serve.decode_step", kind="latency", times=0,
+                args={"seconds": 1.0})])
+            with seams.armed(plan):
+                degraded, _stats = serving.find_max_rate(
+                    engine, slo_s, n_requests=4, seed=0,
+                    ledger_dir=str(tmp_path / "degraded"), lo=4.0,
+                    max_rate=16.0, iters=1, min_rate=2.0)
+            assert plan.points[0].fired > 0
+            assert degraded < healthy
+
+            exposition = tmp_path / "metrics.txt"
+            exposition.write_text(telemetry.render_prometheus())
+            result = CliRunner().invoke(
+                cli, ["slo", "status", "--file", str(exposition),
+                      "--json"])
+            assert result.exit_code == 0, result.output
+            by = {s["name"]: s for s in json.loads(result.output)}
+            assert by["serve-ttft"]["burn_fast"] is not None
+            assert by["serve-ttft"]["burn_fast"] \
+                > by["serve-ttft"]["burn_threshold"]
+            assert by["serve-ttft"]["state"] == "firing"
+        finally:
+            engine.stop()
 
 
 class TestTPCxAI:

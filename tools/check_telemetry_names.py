@@ -31,7 +31,10 @@ cloudtik_tpu/telemetry/names.py:
      the source resolves against the registry in the
      cloudtik_tpu/faults/seams.py docstring AND the seam table in
      docs/fault-injection.md (a seam nobody documented cannot be
-     drilled).
+     drilled);
+  10. the SLO catalog (telemetry/slo.py default_slos): SLO names are
+     unique, every referenced metric resolves against the catalog, and
+     docs/observability.md documents every SLO by name.
 
 Run: ``python tools/check_telemetry_names.py`` (exit 1 on failure).
 """
@@ -273,6 +276,19 @@ def run_checks() -> List[str]:
             errors.append(f"alert rule {rule.name!r} references "
                           f"unknown metric {rule.metric!r}")
 
+    # 10. SLO catalog: unique names, resolvable metrics, docs
+    from cloudtik_tpu.telemetry.slo import default_slos
+    slos = default_slos()
+    slo_names = [s.name for s in slos]
+    for name in sorted({n for n in slo_names
+                        if slo_names.count(n) > 1}):
+        errors.append(f"SLO {name!r} declared more than once in "
+                      "default_slos()")
+    for slo in slos:
+        if not _resolves(slo.metric, known):
+            errors.append(f"SLO {slo.name!r} references unknown "
+                          f"metric {slo.metric!r}")
+
     # 6. docs catalog coverage
     doc_path = os.path.join(REPO_ROOT, "docs", "observability.md")
     if not os.path.exists(doc_path):
@@ -301,6 +317,10 @@ def run_checks() -> List[str]:
             if rule.name not in doc:
                 errors.append("docs/observability.md does not document "
                               f"alert rule {rule.name}")
+        for slo in slos:
+            if slo.name not in doc:
+                errors.append("docs/observability.md does not document "
+                              f"SLO {slo.name}")
     return errors
 
 
@@ -314,10 +334,11 @@ def main() -> int:
     from cloudtik_tpu.runtimes.prometheus.alerts import (
         default_alert_rules)
     from cloudtik_tpu.telemetry.names import EVENTS, METRICS, SPANS
+    from cloudtik_tpu.telemetry.slo import default_slos
     print(f"OK: {len(METRICS)} metrics, {len(SPANS)} spans, "
           f"{len(EVENTS)} events, {len(default_alert_rules())} alert "
-          "rules — catalog, registry, source, dashboards, and docs "
-          "all agree.")
+          f"rules, {len(default_slos())} SLOs — catalog, registry, "
+          "source, dashboards, and docs all agree.")
     return 0
 
 

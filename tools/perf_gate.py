@@ -74,6 +74,12 @@ def load_history(paths: List[str],
         if metric is not None and parsed.get("metric") not in (None,
                                                                metric):
             continue
+        if parsed.get("mode") == "cpu_dryrun" and \
+                "cpu_dryrun" not in str(metric or ""):
+            # probe-failure fallback records (bench.py run_cpu_dryrun)
+            # form their own trajectory; they must never feed a real
+            # device metric's median even if mislabeled
+            continue
         out.append((path, float(parsed["value"])))
     return out
 
@@ -101,6 +107,8 @@ def gate(fresh: Dict[str, Any], history: List[Tuple[str, float]],
     value = float(parsed["value"])
     floor = baseline * (1.0 - threshold_pct / 100.0)
     report.update(metric=parsed.get("metric"), value=value, floor=floor)
+    if parsed.get("mode") == "cpu_dryrun":
+        report["mode"] = "cpu_dryrun"   # labeled fallback measurement
     if value < floor:
         drop = (baseline - value) / baseline * 100.0
         report.update(status="fail",
