@@ -233,9 +233,14 @@ SUITES = {
 
 
 def run_suite(name: str) -> int:
-    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "benchmarks", SUITES[name])
-    return subprocess.call([sys.executable, script])
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    script = os.path.join(repo_root, "benchmarks", SUITES[name])
+    # uninstalled checkouts: the child's sys.path[0] is benchmarks/,
+    # so hand it the repo root explicitly
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    return subprocess.call([sys.executable, script], env=env)
 
 
 # --------------------------------------------------------- CPU dryrun --

@@ -16,6 +16,19 @@ better):
   {"metric": "serving_rps_at_slo", "value": <req/s>, "unit": "req/s",
    "detail": {ttft/tpot/queue-wait p50/p95/p99, availability, ...}}
 
+Two workloads (``--workload both`` is the default):
+
+  * **mixed** — independent prompts of mixed lengths; the flagship
+    ``serving_rps_at_slo`` line (printed LAST).
+  * **shared_prefix** — every request opens with the same long system
+    prompt, the paged engine's prefix-cache showcase: blocks for the
+    shared prefix prefill once and later admissions reuse them
+    (``serving_rps_at_slo_shared_prefix``).  The detail carries a
+    baseline run of the SAME workload with the prefix cache disabled
+    (``baseline_rps_no_prefix_cache``) plus the ledger's
+    ``prefix_tokens_saved`` / ``prefill_chunks`` aggregates, so the
+    win is attributable, not vibes.
+
 Runs on CPU (JAX_PLATFORMS defaults to cpu here) and TPU alike; always
 exits 0 (failures become an ``error`` record perf_gate skips).
 
@@ -40,12 +53,30 @@ import time
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 METRIC = "serving_rps_at_slo"
+METRIC_SHARED_PREFIX = "serving_rps_at_slo_shared_prefix"
 
 PROMPT_LENGTHS = (4, 6, 8, 12)
 OUTPUT_LENGTHS = (4, 8, 12)
+# shared-prefix workload: a 48-token system prompt (6 full 8-token
+# blocks — block-aligned so the prefix map can share all of it) plus a
+# short per-request user suffix and SHORT outputs: the workload is
+# deliberately prefill-dominated, so the rate knee measures prompt
+# processing (what the prefix cache removes), not decode
+SHARED_PREFIX_LEN = 48
+SUFFIX_LENGTHS = (2, 4, 6, 8)
+SHARED_OUTPUT_LENGTHS = (2, 4)
 
 
-def build_engine(slots: int = 4, max_len: int = 64):
+def shared_prefix_tokens(seed: int):
+    """The workload's system prompt — fixed per seed, across trials,
+    so the cache stays warm through the whole rate search (steady
+    state, not cold start)."""
+    rng = random.Random(seed + 104729)
+    return [rng.randrange(1, 100) for _ in range(SHARED_PREFIX_LEN)]
+
+
+def build_engine(slots: int = 4, max_len: int = 64,
+                 prefix_cache: bool = True):
     """Tiny-model engine, started; caller owns stop()."""
     import jax
 
@@ -58,7 +89,8 @@ def build_engine(slots: int = 4, max_len: int = 64):
     engine = DecodeEngine(
         params, cfg,
         EngineConfig(slots=slots, max_len=max_len,
-                     prefill_buckets=(8, 16)))
+                     prefill_buckets=(8, 16), block_size=8,
+                     prefix_cache=prefix_cache))
     engine.start()
     return engine
 
@@ -72,7 +104,7 @@ def warm_engine(engine) -> None:
 
 def run_trial(engine, rate: float, n_requests: int, seed: int,
               ledger_dir: str, trial: int = 0,
-              timeout_s: float = 300.0):
+              timeout_s: float = 300.0, workload: str = "mixed"):
     """One open-loop trial at `rate` req/s; returns the ledger stats.
 
     Deterministic: arrivals are seeded exponential inter-arrival draws
@@ -88,7 +120,12 @@ def run_trial(engine, rate: float, n_requests: int, seed: int,
     for _ in range(n_requests):
         t += rng.expovariate(rate)
         arrivals.append(t)
-    shapes = [(rng.choice(PROMPT_LENGTHS), rng.choice(OUTPUT_LENGTHS))
+    prefix = shared_prefix_tokens(seed) \
+        if workload == "shared_prefix" else []
+    suffix_lengths = SUFFIX_LENGTHS if prefix else PROMPT_LENGTHS
+    output_lengths = SHARED_OUTPUT_LENGTHS if prefix \
+        else OUTPUT_LENGTHS
+    shapes = [(rng.choice(suffix_lengths), rng.choice(output_lengths))
               for _ in range(n_requests)]
 
     # the trial index keeps every file unique even when two phases of
@@ -104,8 +141,8 @@ def run_trial(engine, rate: float, n_requests: int, seed: int,
             delay = t0 + due - time.monotonic()
             if delay > 0:
                 time.sleep(delay)
-            req = Request([rng.randrange(1, 100)
-                           for _ in range(prompt_len)],
+            req = Request(prefix + [rng.randrange(1, 100)
+                                    for _ in range(prompt_len)],
                           max_new_tokens=max_new)
             engine.submit(req)
             requests.append(req)
@@ -138,7 +175,7 @@ def meets_slo(stats, slo_ttft_p95_s: float) -> bool:
 def find_max_rate(engine, slo_ttft_p95_s: float, n_requests: int,
                   seed: int, ledger_dir: str, lo: float = 4.0,
                   max_rate: float = 64.0, iters: int = 4,
-                  min_rate: float = 0.5):
+                  min_rate: float = 0.5, workload: str = "mixed"):
     """(best_rate, best_stats): the highest rate meeting the SLO.
 
     Phase 1 doubles from `lo` until the SLO breaks (or `max_rate`);
@@ -150,7 +187,7 @@ def find_max_rate(engine, slo_ttft_p95_s: float, n_requests: int,
 
     def trial(rate):
         stats = run_trial(engine, rate, n_requests, seed, ledger_dir,
-                          trial=next(trials))
+                          trial=next(trials), workload=workload)
         print(f"# rate={rate:.2f} ttft_p95={stats['ttft_s']['p95']} "
               f"ok={meets_slo(stats, slo_ttft_p95_s)}", file=sys.stderr)
         return stats
@@ -186,18 +223,23 @@ def find_max_rate(engine, slo_ttft_p95_s: float, n_requests: int,
     return best, best_stats
 
 
-def run(slo_ttft_p95_s: float = 0.75, n_requests: int = 24,
-        seed: int = 0, slots: int = 4, lo: float = 4.0,
-        max_rate: float = 64.0, iters: int = 4):
-    engine = build_engine(slots=slots)
+def _search(workload: str, slo_ttft_p95_s: float, n_requests: int,
+            seed: int, slots: int, lo: float, max_rate: float,
+            iters: int, prefix_cache: bool = True):
+    """Build a fresh engine, search the max rate for one workload."""
+    engine = build_engine(slots=slots, prefix_cache=prefix_cache)
     try:
         warm_engine(engine)
         with tempfile.TemporaryDirectory() as ledger_dir:
-            best, stats = find_max_rate(
+            return find_max_rate(
                 engine, slo_ttft_p95_s, n_requests, seed, ledger_dir,
-                lo=lo, max_rate=max_rate, iters=iters)
+                lo=lo, max_rate=max_rate, iters=iters,
+                workload=workload)
     finally:
         engine.stop()
+
+
+def _detail(stats, slo_ttft_p95_s, n_requests, slots, seed):
     detail = {
         "slo_ttft_p95_s": slo_ttft_p95_s,
         "requests_per_trial": n_requests,
@@ -211,8 +253,64 @@ def run(slo_ttft_p95_s: float = 0.75, n_requests: int = 24,
             "queue_wait_s": stats["queue_wait_s"],
             "availability": stats["availability"],
             "finish": stats["finish"],
+            "prompt_tokens": stats.get("prompt_tokens"),
+            "prefix_tokens_saved": stats.get("prefix_tokens"),
+            "prefill_chunks": stats.get("prefill_chunks"),
+            "preemptions": stats.get("preemptions"),
         })
-    return best, detail
+    return detail
+
+
+def run(slo_ttft_p95_s: float = 0.75, n_requests: int = 24,
+        seed: int = 0, slots: int = 4, lo: float = 4.0,
+        max_rate: float = 64.0, iters: int = 4,
+        workload: str = "both"):
+    """Returns perf_gate-compatible records, the flagship mixed-
+    workload `serving_rps_at_slo` line LAST."""
+    records = []
+    kw = dict(slo_ttft_p95_s=slo_ttft_p95_s, n_requests=n_requests,
+              seed=seed, slots=slots, lo=lo, max_rate=max_rate,
+              iters=iters)
+    if workload in ("shared_prefix", "both"):
+        # the knee only shows if a trial can build enough backlog to
+        # break the SLO: 4x the requests, open at 8x the rate, search
+        # 8x higher — the per-request work is tiny (short outputs) —
+        # and judge a third of the flagship SLO: with 2-4 token
+        # outputs the latency budget is prompt-dominated, which is
+        # exactly the work the prefix cache removes
+        sp_kw = dict(kw, n_requests=n_requests * 4, lo=lo * 8,
+                     max_rate=max_rate * 8,
+                     slo_ttft_p95_s=slo_ttft_p95_s / 3.0)
+        best, stats = _search("shared_prefix", **sp_kw)
+        detail = _detail(stats, sp_kw["slo_ttft_p95_s"],
+                         n_requests * 4, slots, seed)
+        # the same workload against the same engine shape with the
+        # prefix cache OFF — every request re-prefills the system
+        # prompt, the static-cache engine's behavior — anchors the win
+        base_best, base_stats = _search("shared_prefix",
+                                        prefix_cache=False, **sp_kw)
+        detail["shared_prefix_len"] = SHARED_PREFIX_LEN
+        detail["baseline_rps_no_prefix_cache"] = round(base_best, 3)
+        if base_stats is not None:
+            detail["baseline_ttft_p95_s"] = base_stats["ttft_s"]["p95"]
+            detail["baseline_prefill_chunks"] = \
+                base_stats.get("prefill_chunks")
+        record = {"metric": METRIC_SHARED_PREFIX,
+                  "value": round(best, 3), "unit": "req/s",
+                  "detail": detail}
+        if best <= 0.0:
+            record["error"] = "no request rate met the TTFT SLO"
+        records.append(record)
+    if workload in ("mixed", "both"):
+        best, stats = _search("mixed", **kw)
+        record = {"metric": METRIC, "value": round(best, 3),
+                  "unit": "req/s",
+                  "detail": _detail(stats, slo_ttft_p95_s, n_requests,
+                                    slots, seed)}
+        if best <= 0.0:
+            record["error"] = "no request rate met the TTFT SLO"
+        records.append(record)
+    return records
 
 
 def main(argv=None) -> int:
@@ -230,22 +328,26 @@ def main(argv=None) -> int:
     parser.add_argument("--max-rate", type=float, default=64.0)
     parser.add_argument("--iters", type=int, default=4,
                         help="bisection rounds after the bracket")
+    parser.add_argument("--workload",
+                        choices=["mixed", "shared_prefix", "both"],
+                        default="both",
+                        help="which workload(s) to search; 'both' "
+                             "prints shared_prefix first and the "
+                             "flagship mixed line last")
     args = parser.parse_args(argv)
     try:
-        best, detail = run(
+        records = run(
             slo_ttft_p95_s=args.slo_ttft_p95, n_requests=args.requests,
             seed=args.seed, slots=args.slots, lo=args.lo,
-            max_rate=args.max_rate, iters=args.iters)
-        result = {"metric": METRIC, "value": round(best, 3),
-                  "unit": "req/s", "detail": detail}
-        if best <= 0.0:
-            result["error"] = "no request rate met the TTFT SLO"
+            max_rate=args.max_rate, iters=args.iters,
+            workload=args.workload)
     except Exception as e:
         import traceback
         traceback.print_exc()
-        result = {"metric": METRIC, "value": 0.0, "unit": "req/s",
-                  "error": f"{type(e).__name__}: {e}"}
-    print(json.dumps(result))
+        records = [{"metric": METRIC, "value": 0.0, "unit": "req/s",
+                    "error": f"{type(e).__name__}: {e}"}]
+    for record in records:
+        print(json.dumps(record))
     return 0
 
 

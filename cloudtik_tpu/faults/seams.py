@@ -23,6 +23,7 @@ Seam registry (keep docs/fault-injection.md in sync):
   checkpoint.save                 Checkpointer.save     {step, directory} supports torn_write
   events.append                   flight recorder append {name, path}    supports torn_write
   serve.reqlog.append             request ledger append {name, path}     supports torn_write
+  serve.kvcache.alloc             KV block pool alloc   {need, free, evictable}  raise -> pool exhausted
   train.prefetch.next             prefetcher hand-off   {qsize}         latency -> data_wait
   serve.decode_step               DecodeEngine._step    {active}
   utils.retry                     every retry sleep     {fn, attempt}

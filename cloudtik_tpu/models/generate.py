@@ -127,6 +127,93 @@ def forward_step(params: Params, tokens: jax.Array,
     return logits, new_cache
 
 
+# ---------------------------------------------------------------- paged --
+# Paged KV forward (PagedAttention): the serving engine keeps one global
+# block pool [L, num_blocks, block_size, Hkv, Dh] plus per-request block
+# tables instead of a contiguous [max_len] plane per slot
+# (serve/kvcache.py holds the host-side bookkeeping).  These helpers are
+# the device half: gather a table into the contiguous layout the
+# attention math expects, run the same forward_step against it, scatter
+# the written blocks back.  Unused table entries point at the reserved
+# null block 0, so every gather/scatter index is valid and the garbage
+# it moves is masked by the causal `t <= position` test (finite values
+# only — masked scores softmax to exactly 0.0 in f32, so garbage never
+# leaks into the weighted sum).
+
+
+def init_block_pool(cfg: TransformerConfig, num_blocks: int,
+                    block_size: int) -> Tuple[jax.Array, jax.Array]:
+    """Zeroed K/V pools [L, num_blocks, block_size, Hkv, Dh]."""
+    shape = (cfg.n_layers, num_blocks, block_size, cfg.n_kv_heads,
+             cfg.head_dim)
+    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+def gather_paged_cache(kp: jax.Array, vp: jax.Array, table: jax.Array
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """One request's logical KV sequence, gathered contiguous.
+
+    kp/vp [L, N, bs, Hkv, Dh], table [M] int32 ->
+    k/v [L, 1, M*bs, Hkv, Dh] where logical position p lives at
+    (table[p // bs], p % bs)."""
+    L, _N, bs, H, D = kp.shape
+    M = table.shape[0]
+    ck = kp[:, table].reshape(L, 1, M * bs, H, D)
+    cv = vp[:, table].reshape(L, 1, M * bs, H, D)
+    return ck, cv
+
+
+def paged_prefill_chunk(params: Params, kp: jax.Array, vp: jax.Array,
+                        table: jax.Array, tokens: jax.Array, start,
+                        cfg: TransformerConfig
+                        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Run one prompt chunk against a paged pool (chunked prefill).
+
+    tokens [1, C] at absolute positions [start, start+C); earlier
+    positions (a previous chunk, or prefix-cache blocks reused from
+    another request) are read straight out of the pool — that is what
+    makes chunked prefill and prefix reuse the same code path.  Returns
+    (kp, vp, logits [1, C, vocab]).  The scatter writes back every
+    gathered block: blocks outside the chunk's range carry their
+    original values (value-identical rewrite), duplicate null-block
+    entries race only over garbage.
+
+    The gathered plane carries C tokens of zero scratch beyond the
+    real capacity: C is the PADDED chunk width, so when start+C
+    overruns the table (a bucket wider than the remaining capacity)
+    `dynamic_update_slice` must not clamp the write start — a clamped
+    write shifts the whole chunk onto wrong positions and corrupts
+    earlier blocks, including prefix blocks shared with other
+    requests.  With the scratch tail the overrun lands in scratch
+    (only PADDING tokens can sit past the true capacity; real chunk
+    tokens always fit) and the write-back drops it.
+    """
+    L, _N, bs, H, D = kp.shape
+    M = table.shape[0]
+    C = tokens.shape[1]
+    ck, cv = gather_paged_cache(kp, vp, table)
+    scratch = jnp.zeros((L, 1, C, H, D), ck.dtype)
+    ck = jnp.concatenate([ck, scratch], axis=2)
+    cv = jnp.concatenate([cv, scratch], axis=2)
+    logits, cache = forward_step(params, tokens,
+                                 {"k": ck, "v": cv, "length": start},
+                                 cfg)
+    nk = cache["k"][:, :, :M * bs].reshape(L, M, bs, H, D)
+    nv = cache["v"][:, :, :M * bs].reshape(L, M, bs, H, D)
+    kp = kp.at[:, table].set(nk)
+    vp = vp.at[:, table].set(nv)
+    return kp, vp, logits
+
+
+def copy_block(kp: jax.Array, vp: jax.Array, src, dst
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Device-side block copy (the copy-on-write half: the pool decides
+    WHEN via needs_copy, this moves the bytes)."""
+    kp = kp.at[:, dst].set(kp[:, src])
+    vp = vp.at[:, dst].set(vp[:, src])
+    return kp, vp
+
+
 def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
             top_k: int) -> jax.Array:
     """logits [B, V] -> token ids [B]."""

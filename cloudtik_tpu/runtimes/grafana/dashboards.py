@@ -134,6 +134,26 @@ def ai_workload_dashboard() -> Dict[str, Any]:
                "tik_slo_burn_rate", "short", 0, 66),
         _panel(20, "SLO error budget remaining",
                "tik_slo_error_budget_remaining", "percentunit", 12, 66),
+        # -- Paged KV cache row: pool pressure + prefix-cache wins --------
+        {"id": 21, "type": "row", "title": "Paged KV cache",
+         "collapsed": False,
+         "gridPos": {"h": 1, "w": 24, "x": 0, "y": 74}, "panels": []},
+        # one expression per panel: these pairs share identical label
+        # sets, so a PromQL `a or b` would silently drop the right side
+        _panel(22, "KV pool utilization",
+               "tik_serve_kv_pool_utilization", "percentunit", 0, 75),
+        _panel(23, "KV blocks in use",
+               "tik_serve_kv_blocks_in_use", "short", 12, 75),
+        _panel(24, "Prefix-cache hit rate",
+               "rate(tik_serve_prefix_cache_hits_total[5m])",
+               "ops", 0, 83),
+        _panel(25, "Prefix-cache tokens saved",
+               "rate(tik_serve_prefix_cache_tokens_saved_total[5m])",
+               "short", 12, 83),
+        _panel(26, "Prefill chunk queue (pending tokens)",
+               "tik_serve_prefill_pending_tokens", "short", 0, 91),
+        _panel(27, "Pool preemptions",
+               "rate(tik_serve_preemptions_total[5m])", "ops", 12, 91),
     ]
     return {
         "uid": "tik-ai-workloads",

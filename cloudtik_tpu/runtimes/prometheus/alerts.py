@@ -184,6 +184,14 @@ def default_alert_rules() -> List[AlertRule]:
             metric="tik_serve_ttft_seconds", quantile=0.95,
             op=">", threshold=2.0, for_cycles=3, severity="warning",
             summary="serve time-to-first-token p95 above 2s"),
+        AlertRule(
+            name="ServePoolSaturated", kind=KIND_THRESHOLD,
+            metric="tik_serve_kv_pool_utilization",
+            op=">", threshold=0.9, for_cycles=3, severity="warning",
+            summary="serve KV block pool >90% held by requests — "
+                    "admissions will queue and preemptions start; "
+                    "tune block_size / num_blocks (docs/operations.md "
+                    "runbook)"),
     ]
 
 

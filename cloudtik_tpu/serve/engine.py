@@ -1,4 +1,4 @@
-"""Continuous-batching decode engine for the transformer family.
+"""Continuous-batching decode engine over a paged KV cache.
 
 Reference parity: the serving half of the AI runtime (SURVEY.md §2.3's
 model serving + §2.8's serving latency harness).  tik-serve's plain
@@ -8,29 +8,42 @@ resident program, and new requests join while others are mid-decode
 (continuous batching), so serving throughput comes from the MXU's
 batch dimension instead of request-at-a-time latency.
 
-Design:
+Memory model (PagedAttention, Kwon et al., SOSP'23 — serve/kvcache.py):
 
-* One shared static KV cache `[L, slots, max_len, Hkv, Dh]`.  A request
-  occupies one slot from admission to completion; slot state (length,
-  remaining budget, eos) lives host-side.
-* PREFILL per request: the prompt is padded to a power-of-two bucket
-  and run through `generate.forward_step` with a single-slot cache (one
-  compile per bucket), then the filled K/V planes are inserted into the
-  shared cache at the slot index.  Padded junk beyond the true length
-  is never read: the decode attention masks `t <= length[slot]` and
-  later writes overwrite it.
-* DECODE: ONE jitted step for all slots, compiled once.  Per-slot
-  lengths drive per-slot RoPE positions, per-slot scatter writes
-  (`cache.at[slot, length]`), and per-slot causal masks — that is what
-  lets a freshly admitted 7-token request share a step with one that is
-  500 tokens in.  Inactive slots are masked (their state does not
-  advance).
+* One global block pool `[L, num_blocks, block_size, Hkv, Dh]` with a
+  free-list allocator.  A request holds an ordered *block table*; HBM
+  is claimed one `block_size` page at a time as the sequence grows, so
+  a 10-token request no longer pays `max_len` tokens of HBM and the
+  same budget holds more concurrent requests.  Block 0 is the reserved
+  null block: inactive lanes and unallocated table slots point at it,
+  so every gather/scatter index in the jitted step is valid.
+* DECODE: ONE jitted step for all slots, compiled once.  Each lane
+  scatters its new K/V at `(table[length // bs], length % bs)` and
+  attends over its table gathered contiguous — block-table indices
+  replace the per-slot contiguous plane, but the math (and the greedy
+  tokens) is bit-identical to the static-cache engine.
+* PREFILL is CHUNKED (Sarathi-Serve, Agrawal et al., OSDI'24): prompts
+  run through `models/generate.paged_prefill_chunk` at most one
+  bucket-sized chunk per loop iteration, interleaved with decode steps
+  — a 500-token prompt can no longer stall in-flight requests' TPOT
+  for its whole prefill; the existing bucket ladder is the chunk size.
+* PREFIX REUSE: full prompt blocks are chain-keyed into the pool's
+  prefix map; a request whose prompt opens with cached blocks starts
+  prefill AFTER them (`tik_serve_prefix_cache_{hits,tokens_saved}_total`
+  count the win) — shared system prompts prefill once.  Copy-on-write
+  (`pool.needs_copy` + a device block copy) guards any shared block an
+  append would mutate.
+* EXHAUSTION: a full pool queues new admissions and preempts/requeues
+  the NEWEST in-flight request (recompute-style preemption) — the
+  oldest request always progresses, and the loop never crashes.  The
+  `serve.kvcache.alloc` fault seam injects exhaustion for drills.
 * Sampling on device: greedy / per-slot temperature (traced — no
   recompiles per request), engine-level static top_k.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import itertools
 import logging
@@ -45,12 +58,14 @@ import numpy as np
 
 from cloudtik_tpu import telemetry
 from cloudtik_tpu.faults import seams
-from cloudtik_tpu.serve import reqlog
+from cloudtik_tpu.faults.plan import FaultInjected
+from cloudtik_tpu.serve import kvcache, reqlog
+from cloudtik_tpu.serve.kvcache import BlockPool, BlockPoolExhausted
 from cloudtik_tpu.telemetry import events, goodput
 from cloudtik_tpu.telemetry import instruments as ti
 from cloudtik_tpu.telemetry.core import STATE as _telemetry_state
-from cloudtik_tpu.models.generate import (
-    _NEG, _rms_norm, forward_step, init_cache)
+from cloudtik_tpu.models import generate as G
+from cloudtik_tpu.models.generate import _NEG, _rms_norm
 from cloudtik_tpu.models.transformer import (
     TransformerConfig, _embed_lookup, _lm_head, _rope)
 
@@ -62,20 +77,41 @@ Params = Dict[str, Any]
 @dataclasses.dataclass
 class EngineConfig:
     slots: int = 4                    # concurrent decode lanes
-    max_len: int = 512                # cache capacity per slot
+    max_len: int = 512                # per-request KV capacity (tokens)
     prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128, 256)
     top_k: int = 0                    # static (part of the decode jit)
+    block_size: int = 16              # KV page size (tokens per block)
+    # pool size; None = slots * ceil(max_len/block_size) + null block
+    # (full provisioning — shrink it, or raise slots, to oversubscribe)
+    num_blocks: Optional[int] = None
+    prefix_cache: bool = True         # share hashed full prompt blocks
+    # max prompt tokens prefilled per loop iteration; None = largest
+    # bucket (the ladder is the chunk size).  max_len disables chunking.
+    chunk_size: Optional[int] = None
 
 
 @dataclasses.dataclass
 class _Slot:
     request: "Request"
-    length: int                       # tokens in cache
-    remaining: int                    # new tokens still wanted
+    table: List[int]                  # physical block ids, logical order
+    true_len: int                     # prompt tokens
+    prefill_pos: int                  # prompt tokens already in cache
+    length: int = 0                   # tokens in cache once decoding
+    remaining: int = 0                # new tokens still wanted
+    decoding: bool = False            # prefill finished
 
 
 class RequestCancelled(RuntimeError):
     """The request was cancelled; its slot has been freed."""
+
+
+class RequestRejected(ValueError):
+    """Refused at submit; `.reason` is machine-readable for the HTTP
+    layer (`capacity` -> 413, `empty_prompt` -> 400)."""
+
+    def __init__(self, message: str, reason: str = "capacity"):
+        super().__init__(message)
+        self.reason = reason
 
 
 _request_ids = itertools.count(1)
@@ -88,7 +124,9 @@ class Request:
     `created` at construction, `admitted` when a slot is taken,
     `first_token_time` when prefill produces the first token, and
     `done_time` at completion — TTFT is first_token_time - created,
-    and queue wait is admitted - created.
+    and queue wait is admitted - created.  A preempted request's
+    admitted/first-token stamps reset (it re-runs from scratch);
+    `preemptions` counts how often that happened.
     """
 
     def __init__(self, prompt: List[int], max_new_tokens: int = 32,
@@ -115,7 +153,13 @@ class Request:
         self.admitted_mono: Optional[float] = None
         self.first_token_mono: Optional[float] = None
         self.done_mono: Optional[float] = None
-        self.bucket: Optional[int] = None     # prefill bucket at admit
+        self.bucket: Optional[int] = None     # first prefill chunk bucket
+        # paged-cache accounting (request-ledger fields)
+        self.kv_blocks: int = 0               # peak blocks held
+        self.prefix_blocks: int = 0           # blocks reused from cache
+        self.prefix_tokens: int = 0           # prompt tokens not recomputed
+        self.prefill_chunks: int = 0          # chunks the prompt took
+        self.preemptions: int = 0             # pool-exhaustion requeues
         self._done = threading.Event()
         self._cancel = False
         # serializes completion: cancel() (caller thread) can race the
@@ -166,12 +210,20 @@ class Request:
 
 
 def _decode_layer(cfg: TransformerConfig, x: jax.Array, layer: Params,
-                  ck: jax.Array, cv: jax.Array, lengths: jax.Array,
-                  active: jax.Array
+                  ck: jax.Array, cv: jax.Array, tables: jax.Array,
+                  lengths: jax.Array, active: jax.Array, block_size: int
                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One layer, one token per slot.  x [B,1,d]; ck/cv [B,T,Hkv,Dh];
-    lengths [B] int32 (per-slot absolute position); active [B] bool."""
+    """One layer, one token per slot, against the paged pool.
+
+    x [B,1,d]; ck/cv [N,bs,Hkv,Dh] (this layer's pool plane); tables
+    [B,M] physical block ids; lengths [B] int32 (per-slot absolute
+    position); active [B] bool.  Each lane scatters its new K/V at
+    (table[length // bs], length % bs) and attends over its gathered
+    table — inactive lanes target the null block and their output is
+    discarded by the caller."""
     B = x.shape[0]
+    M = tables.shape[1]
+    bs = block_size
     positions = lengths[:, None]                      # [B,1]
     h = _rms_norm(x, layer["ln_attn"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cfg.dtype))
@@ -179,21 +231,23 @@ def _decode_layer(cfg: TransformerConfig, x: jax.Array, layer: Params,
     v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(cfg.dtype))
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    # per-slot scatter at each slot's own length; inactive slots write
-    # their current cell back (no-op)
+    # per-slot scatter at each slot's own (block, offset); inactive
+    # slots write the null block's garbage (always masked)
     rows = jnp.arange(B)
-    write_pos = jnp.where(active, lengths, 0)
-    cur_k = ck[rows, write_pos]
-    cur_v = cv[rows, write_pos]
-    new_k = jnp.where(active[:, None, None], k[:, 0], cur_k)
-    new_v = jnp.where(active[:, None, None], v[:, 0], cur_v)
-    ck = ck.at[rows, write_pos].set(new_k.astype(ck.dtype))
-    cv = cv.at[rows, write_pos].set(new_v.astype(cv.dtype))
-    # attention: slot b may see cache positions <= lengths[b]
-    T = ck.shape[1]
-    groups = q.shape[2] // ck.shape[2]
-    ck_h = jnp.repeat(ck, groups, axis=2)
-    cv_h = jnp.repeat(cv, groups, axis=2)
+    blk_idx = jnp.clip(lengths // bs, 0, M - 1)
+    phys = jnp.where(active, tables[rows, blk_idx], kvcache.NULL_BLOCK)
+    off = jnp.where(active, lengths % bs, 0)
+    ck = ck.at[phys, off].set(k[:, 0].astype(ck.dtype))
+    cv = cv.at[phys, off].set(v[:, 0].astype(cv.dtype))
+    # attention: gather each slot's logical view; slot b may see
+    # logical positions <= lengths[b] (unallocated table slots gather
+    # the null block — finite garbage, masked to exactly 0 by softmax)
+    ck_seq = ck[tables].reshape(B, M * bs, ck.shape[-2], ck.shape[-1])
+    cv_seq = cv[tables].reshape(B, M * bs, cv.shape[-2], cv.shape[-1])
+    T = M * bs
+    groups = q.shape[2] // ck_seq.shape[2]
+    ck_h = jnp.repeat(ck_seq, groups, axis=2)
+    cv_h = jnp.repeat(cv_seq, groups, axis=2)
     scores = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
                         ck_h.astype(jnp.float32)) * (q.shape[-1] ** -0.5)
     mask = (jnp.arange(T)[None, None, None, :]
@@ -221,27 +275,28 @@ def _decode_layer(cfg: TransformerConfig, x: jax.Array, layer: Params,
     return x + down, ck, cv
 
 
-def decode_step(params: Params, tokens: jax.Array, ks: jax.Array,
-                vs: jax.Array, lengths: jax.Array, active: jax.Array,
-                temps: jax.Array, rng: jax.Array,
-                cfg: TransformerConfig, top_k: int
+def decode_step(params: Params, tokens: jax.Array, kp: jax.Array,
+                vp: jax.Array, tables: jax.Array, lengths: jax.Array,
+                active: jax.Array, temps: jax.Array, rng: jax.Array,
+                cfg: TransformerConfig, block_size: int, top_k: int
                 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """One token for every active slot.
+    """One token for every active slot, paged.
 
-    tokens [B] (each slot's last token), ks/vs [L,B,T,Hkv,Dh],
-    lengths/active/temps [B].  Returns (next_tokens, ks, vs,
-    new_lengths); inactive slots keep their state.
+    tokens [B] (each slot's last token), kp/vp [L,N,bs,Hkv,Dh] block
+    pools, tables [B,M], lengths/active/temps [B].  Returns
+    (next_tokens, kp, vp, new_lengths); inactive slots keep their
+    state.
     """
     x = _embed_lookup(params["embed"], tokens[:, None], cfg)
 
     def body(carry, xs):
         x = carry
         layer, ck, cv = xs
-        x, ck, cv = _decode_layer(cfg, x, layer, ck, cv, lengths,
-                                  active)
+        x, ck, cv = _decode_layer(cfg, x, layer, ck, cv, tables,
+                                  lengths, active, block_size)
         return x, (ck, cv)
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], ks, vs))
+    x, (kp, vp) = jax.lax.scan(body, x, (params["layers"], kp, vp))
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum(
         "bsd,dv->bsv", x, _lm_head(params, cfg).astype(cfg.dtype),
@@ -256,15 +311,16 @@ def decode_step(params: Params, tokens: jax.Array, ks: jax.Array,
     nxt = jnp.where(temps > 1e-5, sampled, greedy)
     nxt = jnp.where(active, nxt, tokens)
     new_lengths = jnp.where(active, lengths + 1, lengths)
-    return nxt, ks, vs, new_lengths
+    return nxt, kp, vp, new_lengths
 
 
 class DecodeEngine:
     """Host loop + device programs for continuous-batching generation.
 
     submit() is thread-safe; callers block on Request.wait().  One
-    background thread owns all device state, so there is never more
-    than one in-flight program (the single-process TPU rule)."""
+    background thread owns all device state AND the block pool, so
+    there is never more than one in-flight program (the single-process
+    TPU rule) and the allocator needs no locking."""
 
     def __init__(self, params: Params, cfg: TransformerConfig,
                  engine_config: Optional[EngineConfig] = None,
@@ -274,22 +330,35 @@ class DecodeEngine:
         self.ec = engine_config or EngineConfig()
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         B, T = self.ec.slots, self.ec.max_len
-        # buckets must COVER max_len: any prompt submit() accepts
-        # (prompt + max_new <= max_len) has to land in some bucket, so
-        # extend the configured ladder by doubling up to max_len
-        buckets = [b for b in self.ec.prefill_buckets if b <= T]
+        bs = self.ec.block_size
+        # per-request logical capacity, in blocks (rounded UP: the
+        # table covers max_len even when block_size doesn't divide it)
+        self._blocks_per_req = kvcache.blocks_for(T, bs)
+        self._capacity_tokens = self._blocks_per_req * bs
+        num_blocks = self.ec.num_blocks
+        if num_blocks is None:
+            num_blocks = B * self._blocks_per_req + 1   # + null block
+        self.pool = BlockPool(num_blocks, bs)
+        # bucket ladder = chunk-size ladder: it must cover the largest
+        # prefill chunk, so extend the configured rungs by doubling
+        buckets = sorted({b for b in self.ec.prefill_buckets if b <= T})
         if not buckets:
             buckets = [min(16, T)]
-        while buckets[-1] < T:
+        chunk_max = min(self.ec.chunk_size or buckets[-1], T)
+        while buckets[-1] < chunk_max:
             buckets.append(min(buckets[-1] * 2, T))
         self._buckets = tuple(buckets)
-        shape = (cfg.n_layers, B, T, cfg.n_kv_heads, cfg.head_dim)
-        self._ks = jnp.zeros(shape, cfg.dtype)
-        self._vs = jnp.zeros(shape, cfg.dtype)
+        self._chunk_max = chunk_max
+        self._kp, self._vp = G.init_block_pool(cfg, num_blocks, bs)
+        self._tables_np = np.zeros((B, self._blocks_per_req), np.int32)
         self._lengths = jnp.zeros((B,), jnp.int32)
         self._tokens = jnp.zeros((B,), jnp.int32)
         self._slots: List[Optional[_Slot]] = [None] * B
         self._queue: "queue.Queue[Request]" = queue.Queue()
+        # loop-owned admission deque: exhaustion leaves requests here
+        # (FIFO), preemption re-queues at the FRONT so the victim
+        # re-admits as soon as blocks free up
+        self._waiting: "collections.deque[Request]" = collections.deque()
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -298,40 +367,45 @@ class DecodeEngine:
         self._ledger = goodput.get_ledger("serve")
 
         self._decode = jax.jit(
-            lambda p, tok, ks, vs, ln, act, tmp, rng: decode_step(
-                p, tok, ks, vs, ln, act, tmp, rng, cfg=cfg,
-                top_k=self.ec.top_k))
+            lambda p, tok, kp, vp, tbl, ln, act, tmp, rng: decode_step(
+                p, tok, kp, vp, tbl, ln, act, tmp, rng, cfg=cfg,
+                block_size=bs, top_k=self.ec.top_k))
 
-        def _prefill(p, prompt, true_len):
-            cache = init_cache(cfg, 1, T)
-            logits, cache = forward_step(p, prompt, cache, cfg)
+        def _prefill_chunk(p, kp, vp, table, tokens, start, last_idx):
+            kp, vp, logits = G.paged_prefill_chunk(
+                p, kp, vp, table, tokens, start, cfg)
             last = jax.lax.dynamic_index_in_dim(
-                logits[0], true_len - 1, 0, keepdims=False)
-            return cache["k"][:, 0], cache["v"][:, 0], \
-                last.argmax(-1).astype(jnp.int32)
+                logits[0], last_idx, 0, keepdims=False)
+            return kp, vp, last.argmax(-1).astype(jnp.int32)
 
-        self._prefill = jax.jit(_prefill)
-
-        def _insert(ks, vs, pk, pv, slot):
-            ks = jax.lax.dynamic_update_slice(
-                ks, pk[:, None], (0, slot, 0, 0, 0))
-            vs = jax.lax.dynamic_update_slice(
-                vs, pv[:, None], (0, slot, 0, 0, 0))
-            return ks, vs
-
-        self._insert = jax.jit(_insert)
+        self._prefill_chunk = jax.jit(_prefill_chunk)
+        self._copy_block = jax.jit(G.copy_block)
 
     # -- public ----------------------------------------------------------
     def submit(self, request: Request) -> Request:
         if not request.prompt:
             self._finish_request(
-                request, "rejected", ValueError("empty prompt"))
+                request, "rejected",
+                RequestRejected("empty prompt", reason="empty_prompt"))
             return request
-        if len(request.prompt) + request.max_new_tokens > self.ec.max_len:
-            self._finish_request(request, "rejected", ValueError(
+        bs = self.ec.block_size
+        total = len(request.prompt) + request.max_new_tokens
+        need = kvcache.blocks_for(total, bs)
+        if total > self._capacity_tokens:
+            self._finish_request(request, "rejected", RequestRejected(
                 f"prompt+max_new ({len(request.prompt)} + "
-                f"{request.max_new_tokens}) exceeds max_len "
-                f"{self.ec.max_len}"))
+                f"{request.max_new_tokens} = {total} tokens) needs "
+                f"{need} KV blocks of {bs} tokens; per-request "
+                f"block-table capacity is {self._blocks_per_req} "
+                f"blocks ({self._capacity_tokens} tokens)"))
+            return request
+        if need > self.pool.usable_blocks:
+            self._finish_request(request, "rejected", RequestRejected(
+                f"prompt+max_new ({total} tokens) needs {need} KV "
+                f"blocks of {bs} tokens, but the engine's whole pool "
+                f"holds {self.pool.usable_blocks} usable blocks "
+                f"({self.pool.usable_blocks * bs} tokens) — the "
+                "request can never be scheduled"))
             return request
         request._engine = self
         with telemetry.span("serve.enqueue",
@@ -339,7 +413,8 @@ class DecodeEngine:
                             prompt_len=len(request.prompt)) as span:
             request.traceparent = getattr(span, "traceparent", None)
             self._queue.put(request)
-        ti.SERVE_QUEUE_DEPTH.set(self._queue.qsize())
+        ti.SERVE_QUEUE_DEPTH.set(self._queue.qsize()
+                                 + len(self._waiting))
         self._wake.set()
         return request
 
@@ -430,6 +505,13 @@ class DecodeEngine:
     def _drain_queue(self, reason: str) -> None:
         while True:
             try:
+                req = self._waiting.popleft()
+            except IndexError:
+                break
+            self._finish_request(req, "error", RuntimeError(reason),
+                                 finish=reqlog.FINISH_DRAINED)
+        while True:
+            try:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
@@ -441,105 +523,300 @@ class DecodeEngine:
         """Fail everything still queued or mid-decode — callers must not
         sit in wait() until their timeout after a shutdown.  The ledger
         books these as `drained` so shutdown churn is distinguishable
-        from per-request errors when reading availability."""
+        from per-request errors when reading availability.  Every slot's
+        blocks go back to the pool: after stop, used() is zero."""
         self._drain_queue(reason)
         for slot_id, slot in enumerate(self._slots):
             if slot is not None:
+                self._release_slot(slot_id)
                 self._finish_request(slot.request, "error",
                                      RuntimeError(reason),
                                      finish=reqlog.FINISH_DRAINED)
-                self._slots[slot_id] = None
+
+    # -- block-table plumbing ---------------------------------------------
+    def _sync_table(self, slot_id: int) -> None:
+        """Mirror a slot's block table into the device-bound array."""
+        slot = self._slots[slot_id]
+        row = self._tables_np[slot_id]
+        row[:] = kvcache.NULL_BLOCK
+        if slot is not None:
+            row[:len(slot.table)] = slot.table
+
+    def _release_slot(self, slot_id: int) -> None:
+        """Return a slot's blocks to the pool and clear its lane."""
+        slot = self._slots[slot_id]
+        if slot is None:
+            return
+        self._slots[slot_id] = None
+        self.pool.release(slot.table)
+        slot.table = []
+        self._sync_table(slot_id)
+
+    def _newest_slot(self) -> Optional[int]:
+        """The most recently admitted occupied slot (preemption victim
+        — the oldest request always progresses)."""
+        newest, newest_mono = None, -1.0
+        for slot_id, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            mono = slot.request.admitted_mono or 0.0
+            if mono >= newest_mono:
+                newest, newest_mono = slot_id, mono
+        return newest
+
+    def _preempt(self, slot_id: int) -> None:
+        """Pool exhausted: evict this slot's request and requeue it at
+        the admission front (recompute-on-readmit, vLLM-style)."""
+        slot = self._slots[slot_id]
+        req = slot.request
+        self._release_slot(slot_id)
+        req.tokens.clear()
+        req.admitted = None
+        req.admitted_mono = None
+        req.first_token_time = None
+        req.first_token_mono = None
+        req.preemptions += 1
+        ti.SERVE_PREEMPTIONS.inc()
+        with telemetry.trace_context(req.traceparent):
+            events.emit("tik_serve_preemption", request=req.request_id,
+                        slot=slot_id, preemptions=req.preemptions)
+        self._waiting.appendleft(req)
+        ti.SERVE_QUEUE_DEPTH.set(self._queue.qsize()
+                                 + len(self._waiting))
+
+    def _alloc_blocks(self, slot_id: int, n: int) -> Optional[List[int]]:
+        """Allocate n blocks for the slot, preempting the newest other
+        request on exhaustion.  Returns None when the slot ITSELF was
+        the newest and got preempted (caller abandons the operation);
+        an injected `serve.kvcache.alloc` fault lands here too, so
+        chaos exhaustion takes the same queue-and-preempt path."""
+        while True:
+            try:
+                return self.pool.alloc(n)
+            except (BlockPoolExhausted, FaultInjected):
+                victim = self._newest_slot()
+                if victim is None:
+                    raise     # no slot held — submit() sizing bug
+                self._preempt(victim)
+                if victim == slot_id:
+                    return None
+
+    def _grow_table(self, slot_id: int, slot: _Slot, n: int) -> bool:
+        blocks = self._alloc_blocks(slot_id, n)
+        if blocks is None:
+            return False
+        slot.table.extend(blocks)
+        slot.request.kv_blocks = max(slot.request.kv_blocks,
+                                     len(slot.table))
+        self._sync_table(slot_id)
+        return True
 
     # -- engine loop ------------------------------------------------------
     def _bucket(self, n: int) -> int:
         for b in self._buckets:
             if n <= b:
                 return b
-        raise ValueError(f"prompt length {n} exceeds largest bucket")
+        raise ValueError(f"chunk length {n} exceeds largest bucket")
 
     def _admit(self) -> None:
-        for slot_id in range(self.ec.slots):
-            if self._slots[slot_id] is not None:
-                continue
-            while True:
-                try:
-                    req = self._queue.get_nowait()
-                except queue.Empty:
-                    ti.SERVE_QUEUE_DEPTH.set(0)
-                    return
-                ti.SERVE_QUEUE_DEPTH.set(self._queue.qsize())
-                if req._cancel:   # cancelled while queued: no slot taken
-                    self._finish_request(
-                        req, "cancelled",
-                        RequestCancelled("request cancelled"))
-                    continue
+        """Move submissions into slots.  Pool exhaustion stops
+        admission (requests stay queued, FIFO) — it must never crash
+        the loop or drop a request."""
+        while True:
+            try:
+                self._waiting.append(self._queue.get_nowait())
+            except queue.Empty:
                 break
+        while self._waiting:
+            slot_id = next((i for i, s in enumerate(self._slots)
+                            if s is None), None)
+            if slot_id is None:
+                break
+            req = self._waiting[0]
+            if req._done.is_set():
+                self._waiting.popleft()
+                continue
+            if req._cancel:   # cancelled while queued: no slot taken
+                self._waiting.popleft()
+                self._finish_request(
+                    req, "cancelled",
+                    RequestCancelled("request cancelled"))
+                continue
+            true_len = len(req.prompt)
+            if req.preemptions and self.pool.available() < \
+                    kvcache.blocks_for(
+                        true_len + req.max_new_tokens,
+                        self.ec.block_size):
+                # a preemption victim re-admits only once its WORST
+                # case fits — optimistic re-admission would thrash
+                # (prefill, grow, get preempted again, repeat)
+                break
+            reuse_blocks: List[int] = []
+            reuse_len = 0
+            if self.ec.prefix_cache:
+                reuse_blocks, reuse_len = \
+                    self.pool.match_prefix(req.prompt)
+            need = kvcache.blocks_for(true_len, self.ec.block_size) \
+                - len(reuse_blocks)
+            try:
+                fresh = self.pool.alloc(need)
+            except (BlockPoolExhausted, FaultInjected):
+                if reuse_blocks:
+                    self.pool.release(reuse_blocks)
+                break         # exhaustion queues new admissions
+            self._waiting.popleft()
             try:
                 req.admitted = time.time()
                 req.admitted_mono = time.monotonic()
                 ti.SERVE_QUEUE_WAIT.observe(req.admitted - req.created)
-                true_len = len(req.prompt)
-                req.bucket = self._bucket(true_len)
-                # re-enter the request's trace: this is the loop thread,
-                # so the submit-side context does not carry over
+                req.bucket = self._bucket(
+                    min(true_len - reuse_len, self._chunk_max))
+                req.prefix_blocks = len(reuse_blocks)
+                req.prefix_tokens = reuse_len
+                slot = _Slot(request=req,
+                             table=reuse_blocks + fresh,
+                             true_len=true_len,
+                             prefill_pos=reuse_len,
+                             remaining=req.max_new_tokens - 1)
+                req.kv_blocks = max(req.kv_blocks, len(slot.table))
+                self._slots[slot_id] = slot
+                self._sync_table(slot_id)
+                # re-enter the request's trace: this is the loop
+                # thread, so the submit-side context does not carry over
                 with telemetry.trace_context(req.traceparent):
                     events.emit("tik_serve_admission",
                                 request=req.request_id, slot=slot_id,
-                                prompt_len=true_len)
-                    with telemetry.span("serve.prefill",
-                                        request=req.request_id,
-                                        prompt_len=true_len,
-                                        slot=slot_id):
-                        padded = np.zeros((1, req.bucket), np.int32)
-                        padded[0, :true_len] = req.prompt
-                        pk, pv, first = self._prefill(
-                            self.params, jnp.asarray(padded),
-                            jnp.asarray(true_len, jnp.int32))
-                        self._ks, self._vs = self._insert(
-                            self._ks, self._vs, pk, pv, slot_id)
-                        first_tok = int(first)
-                req.tokens.append(first_tok)
-                req.first_token_time = time.time()
-                req.first_token_mono = time.monotonic()
-                ti.SERVE_TTFT.observe(req.first_token_time - req.created)
-                ti.SERVE_TOKENS.inc()
-                self._tokens = self._tokens.at[slot_id].set(first_tok)
-                self._lengths = self._lengths.at[slot_id].set(true_len)
-                slot = _Slot(req, true_len, req.max_new_tokens - 1)
-                if (req.eos_id is not None and first_tok == req.eos_id) \
-                        or slot.remaining <= 0:
-                    self._finish_request(req, "ok")
-                    continue
-                self._slots[slot_id] = slot
+                                prompt_len=true_len,
+                                prefix_tokens=reuse_len)
             except Exception as e:   # surface per-request failures
+                if self._slots[slot_id] is not None:
+                    self._release_slot(slot_id)
+                else:     # failed before the slot took ownership
+                    self.pool.release(reuse_blocks + fresh)
                 self._finish_request(req, "error", e)
+        ti.SERVE_QUEUE_DEPTH.set(self._queue.qsize()
+                                 + len(self._waiting))
+
+    def _prefill_tick(self) -> None:
+        """Run ONE prompt chunk for the oldest prefilling slot.  One
+        chunk per loop iteration is the Sarathi interleave: a decode
+        step runs between chunks, so in-flight TPOT is bounded by one
+        chunk's compute, not a whole long prompt's."""
+        cand = [(s.request.admitted_mono or 0.0, i)
+                for i, s in enumerate(self._slots)
+                if s is not None and not s.decoding]
+        if not cand:
+            return
+        slot_id = min(cand)[1]
+        slot = self._slots[slot_id]
+        req = slot.request
+        try:
+            chunk = min(slot.true_len - slot.prefill_pos,
+                        self._chunk_max)
+            covered = kvcache.blocks_for(slot.prefill_pos + chunk,
+                                         self.ec.block_size)
+            if len(slot.table) < covered:
+                if not self._grow_table(slot_id, slot,
+                                        covered - len(slot.table)):
+                    return          # preempted itself; re-admits later
+            bucket = self._bucket(chunk)
+            with telemetry.trace_context(req.traceparent):
+                with telemetry.span("serve.prefill",
+                                    request=req.request_id,
+                                    slot=slot_id,
+                                    start=slot.prefill_pos,
+                                    chunk_len=chunk):
+                    padded = np.zeros((1, bucket), np.int32)
+                    padded[0, :chunk] = req.prompt[
+                        slot.prefill_pos:slot.prefill_pos + chunk]
+                    self._kp, self._vp, tok = self._prefill_chunk(
+                        self.params, self._kp, self._vp,
+                        jnp.asarray(self._tables_np[slot_id]),
+                        jnp.asarray(padded),
+                        jnp.asarray(slot.prefill_pos, jnp.int32),
+                        jnp.asarray(chunk - 1, jnp.int32))
+            slot.prefill_pos += chunk
+            req.prefill_chunks += 1
+            ti.SERVE_PREFILL_CHUNKS.inc()
+            if slot.prefill_pos < slot.true_len:
+                return
+            # prompt complete: the final chunk's last logits ARE the
+            # first generated token
+            first_tok = int(tok)
+            req.tokens.append(first_tok)
+            req.first_token_time = time.time()
+            req.first_token_mono = time.monotonic()
+            ti.SERVE_TTFT.observe(req.first_token_time - req.created)
+            ti.SERVE_TOKENS.inc()
+            if self.ec.prefix_cache:
+                self.pool.register_prefix(
+                    req.prompt, slot.table,
+                    start_block=req.prefix_blocks)
+            self._tokens = self._tokens.at[slot_id].set(first_tok)
+            self._lengths = self._lengths.at[slot_id].set(
+                slot.true_len)
+            slot.length = slot.true_len
+            slot.decoding = True
+            if (req.eos_id is not None and first_tok == req.eos_id) \
+                    or slot.remaining <= 0:
+                self._release_slot(slot_id)
+                self._finish_request(req, "ok")
+        except Exception as e:   # surface per-request failures
+            self._release_slot(slot_id)
+            self._finish_request(req, "error", e)
 
     def _reap_cancelled(self) -> None:
         """Free slots whose request was cancelled — runs on the loop
         thread, which owns slot state."""
         for slot_id, slot in enumerate(self._slots):
             if slot is not None and slot.request._cancel:
+                self._release_slot(slot_id)
                 self._finish_request(
                     slot.request, "cancelled",
                     RequestCancelled("request cancelled"))
-                self._slots[slot_id] = None
+
+    def _prepare_decode(self) -> None:
+        """Host pre-pass before the jitted step: every decoding slot's
+        next write position must land in an allocated, privately-owned
+        block — grow tables across block boundaries, copy-on-write any
+        block another holder shares (pool.needs_copy; shared blocks
+        come from fork_table, e.g. speculative decoding)."""
+        for slot_id, slot in enumerate(self._slots):
+            if slot is None or not slot.decoding:
+                continue
+            j = slot.length // self.ec.block_size
+            if j >= len(slot.table):
+                self._grow_table(slot_id, slot, 1)
+                continue      # preempt handled inside; mask re-reads
+            if self.pool.needs_copy(slot.table[j]):
+                fresh = self._alloc_blocks(slot_id, 1)
+                if fresh is None:
+                    continue
+                self._kp, self._vp = self._copy_block(
+                    self._kp, self._vp, slot.table[j], fresh[0])
+                self.pool.release([slot.table[j]])
+                slot.table[j] = fresh[0]
+                self._sync_table(slot_id)
 
     def _step(self) -> None:
-        n_active = sum(s is not None for s in self._slots)
+        self._prepare_decode()
+        decoding = [s is not None and s.decoding for s in self._slots]
+        n_active = sum(decoding)
+        if n_active == 0:
+            return
         seams.fire("serve.decode_step", active=n_active)
         ti.SERVE_ACTIVE_SLOTS.set(n_active)
         t_step = time.perf_counter()
         with telemetry.span("serve.decode_step", active=n_active):
-            active_mask = np.array(
-                [s is not None for s in self._slots], np.bool_)
+            active_mask = np.array(decoding, np.bool_)
             temps = np.array(
-                [s.request.temperature if s else 0.0
-                 for s in self._slots], np.float32)
+                [s.request.temperature if s is not None and s.decoding
+                 else 0.0 for s in self._slots], np.float32)
             self._rng, step_rng = jax.random.split(self._rng)
-            nxt, self._ks, self._vs, self._lengths = self._decode(
-                self.params, self._tokens, self._ks, self._vs,
-                self._lengths, jnp.asarray(active_mask),
-                jnp.asarray(temps), step_rng)
+            nxt, self._kp, self._vp, self._lengths = self._decode(
+                self.params, self._tokens, self._kp, self._vp,
+                jnp.asarray(self._tables_np), self._lengths,
+                jnp.asarray(active_mask), jnp.asarray(temps), step_rng)
             self._tokens = nxt
             host_tokens = np.asarray(nxt)
         ti.SERVE_TOKENS.inc(n_active)
@@ -557,7 +834,8 @@ class DecodeEngine:
             # engine must not serve stale goodput gauges
             self._ledger.tick()
         for slot_id, slot in enumerate(self._slots):
-            if slot is None:
+            if slot is None or not slot.decoding \
+                    or not active_mask[slot_id]:
                 continue
             tok = int(host_tokens[slot_id])
             slot.request.tokens.append(tok)
@@ -566,10 +844,10 @@ class DecodeEngine:
             done = slot.remaining <= 0 or \
                 (slot.request.eos_id is not None
                  and tok == slot.request.eos_id) or \
-                slot.length + 1 >= self.ec.max_len
+                slot.length + 1 >= self._capacity_tokens
             if done:
+                self._release_slot(slot_id)
                 self._finish_request(slot.request, "ok")
-                self._slots[slot_id] = None
 
     def _loop(self) -> None:
         try:
@@ -577,9 +855,22 @@ class DecodeEngine:
                 try:
                     self._reap_cancelled()
                     self._admit()
-                    if any(s is not None for s in self._slots):
+                    prefilling = any(
+                        s is not None and not s.decoding
+                        for s in self._slots)
+                    if prefilling:
+                        self._prefill_tick()
+                    if _telemetry_state.enabled:
+                        ti.SERVE_PREFILL_PENDING.set(sum(
+                            s.true_len - s.prefill_pos
+                            for s in self._slots
+                            if s is not None and not s.decoding))
+                    if any(s is not None and s.decoding
+                           for s in self._slots):
                         self._step()
-                    elif self._queue.empty():
+                    elif not prefilling \
+                            and all(s is None for s in self._slots) \
+                            and self._queue.empty():
                         self._wake.wait(timeout=0.5)
                         self._wake.clear()
                         # waiting with no work: fold the gap into idle
@@ -589,10 +880,10 @@ class DecodeEngine:
                     # fail everything in flight rather than hang callers
                     for slot_id, slot in enumerate(self._slots):
                         if slot is not None:
+                            self._release_slot(slot_id)
                             self._finish_request(
                                 slot.request, "error", RuntimeError(
                                     "engine loop failed; see logs"))
-                            self._slots[slot_id] = None
         finally:
             # slot/queue teardown happens HERE, on the thread that owns
             # the slot state — stop() only joins and falls back to a

@@ -9,9 +9,16 @@ appends one record per completed request:
 
     {ts, seq, name: "request", traceparent?, request_id, finish,
      bucket, prompt_tokens, output_tokens,
+     kv_blocks, prefix_blocks, prefix_tokens, prefill_chunks,
+     preemptions                                  (paged KV cache),
      arrival_ts/admitted_ts/first_token_ts/done_ts           (epoch),
      arrival_mono/admitted_mono/first_token_mono/done_mono   (monotonic),
      queue_wait_s, ttft_s, tpot_s}
+
+``RECORD_FIELDS`` is the authoritative record schema:
+`tools/check_telemetry_names.py` verifies that every field
+docs/observability.md's ledger table names exists here, and vice versa
+— the ledger docs stay honest as fields are added.
 
 ``finish`` is one of ``done | cancelled | rejected | error | drained``
 (drained = the engine shut down with the request still in flight;
@@ -40,6 +47,18 @@ from cloudtik_tpu.telemetry import core, events
 from cloudtik_tpu.telemetry.events import EventJournal, read_file
 
 RECORD_NAME = "request"
+
+# Every field a request record may carry (the journal adds the envelope
+# ts/seq/name/traceparent).  Keep docs/observability.md's "Record
+# fields" table in sync — tools/check_telemetry_names.py enforces it.
+RECORD_FIELDS = (
+    "request_id", "finish", "bucket", "prompt_tokens", "output_tokens",
+    "kv_blocks", "prefix_blocks", "prefix_tokens", "prefill_chunks",
+    "preemptions",
+    "arrival_ts", "admitted_ts", "first_token_ts", "done_ts",
+    "arrival_mono", "admitted_mono", "first_token_mono", "done_mono",
+    "queue_wait_s", "ttft_s", "tpot_s",
+)
 
 FINISH_DONE = "done"
 FINISH_CANCELLED = "cancelled"
@@ -110,6 +129,12 @@ def record(req, finish: str) -> None:
         "bucket": getattr(req, "bucket", None),
         "prompt_tokens": len(req.prompt),
         "output_tokens": len(req.tokens),
+        # paged KV cache accounting (serve/kvcache.py)
+        "kv_blocks": getattr(req, "kv_blocks", None),
+        "prefix_blocks": getattr(req, "prefix_blocks", None),
+        "prefix_tokens": getattr(req, "prefix_tokens", None),
+        "prefill_chunks": getattr(req, "prefill_chunks", None),
+        "preemptions": getattr(req, "preemptions", None),
         "arrival_ts": req.created,
         "admitted_ts": req.admitted,
         "first_token_ts": req.first_token_time,
@@ -210,4 +235,12 @@ def compute_stats(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             "p95": percentile(values, 0.95),
             "p99": percentile(values, 0.99),
         }
+    # paged-KV aggregates: how much prompt work the prefix cache saved,
+    # how many chunks prefill took, and how much preemption churn the
+    # population survived (zeros when the records predate the fields)
+    for field in ("prompt_tokens", "prefix_tokens", "prefill_chunks",
+                  "preemptions"):
+        stats[field] = sum(
+            rec[field] for rec in records
+            if isinstance(rec.get(field), (int, float)))
     return stats

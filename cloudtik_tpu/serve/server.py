@@ -38,10 +38,13 @@ class BackendError(Exception):
 
     def __init__(self, message: str,
                  headers: Optional[Dict[str, str]] = None,
-                 status: int = 400):
+                 status: int = 400, reason: Optional[str] = None):
         super().__init__(message)
         self.headers = dict(headers or {})
         self.status = status
+        # machine-readable rejection reason (e.g. "capacity") echoed in
+        # the response body so clients can branch without parsing prose
+        self.reason = reason
 
 
 class ModelBackend:
@@ -108,6 +111,8 @@ def transformer_backend(model: str = "tiny",
 def engine_backend(model: str = "tiny",
                    checkpoint_dir: Optional[str] = None,
                    slots: int = 4, max_len: int = 512,
+                   block_size: int = 16,
+                   num_blocks: Optional[int] = None,
                    **config_overrides) -> ModelBackend:
     """Continuous-batching generation endpoint (serve/engine.py).
 
@@ -119,7 +124,7 @@ def engine_backend(model: str = "tiny",
 
     from cloudtik_tpu.models import transformer as T
     from cloudtik_tpu.serve.engine import (
-        DecodeEngine, EngineConfig, Request)
+        DecodeEngine, EngineConfig, Request, RequestRejected)
 
     cfg = T.config(model, **config_overrides)
     params = T.init_params(jax.random.PRNGKey(0), cfg)
@@ -131,7 +136,9 @@ def engine_backend(model: str = "tiny",
                               partial=True)["params"]
         ckpt.close()
     engine = DecodeEngine(
-        params, cfg, EngineConfig(slots=slots, max_len=max_len))
+        params, cfg, EngineConfig(slots=slots, max_len=max_len,
+                                  block_size=block_size,
+                                  num_blocks=num_blocks))
     engine.start()
 
     def generate(payload: Dict[str, Any]):
@@ -154,6 +161,13 @@ def engine_backend(model: str = "tiny",
             headers["x-tik-traceparent"] = req.traceparent
         try:
             tokens = req.wait(timeout=600)
+        except RequestRejected as e:
+            # submit-time refusal, in KV-pool-capacity terms: 413 for
+            # a request the pool can never hold, 400 for a malformed
+            # one; the machine-readable reason rides the body
+            status = 413 if e.reason == "capacity" else 400
+            raise BackendError(str(e), headers, status=status,
+                               reason=e.reason) from e
         except Exception as e:
             raise BackendError(str(e), headers) from e
         return ({"tokens": [tokens],
@@ -270,7 +284,10 @@ class ServeServer:
                         self._send(200, result)
                 except BackendError as e:
                     logger.exception("serve request failed")
-                    self._send(e.status, {"error": str(e)}, e.headers)
+                    body = {"error": str(e)}
+                    if e.reason:
+                        body["reason"] = e.reason
+                    self._send(e.status, body, e.headers)
                 except Exception as e:
                     logger.exception("serve request failed")
                     self._send(400, {"error": str(e)})
@@ -303,6 +320,11 @@ def main(argv=None) -> int:
                         "requests share decode steps)")
     p.add_argument("--slots", type=int, default=4)
     p.add_argument("--max-len", type=int, default=512)
+    p.add_argument("--block-size", type=int, default=16,
+                   help="KV cache page size in tokens (engine mode)")
+    p.add_argument("--num-blocks", type=int, default=None,
+                   help="KV pool size in blocks (engine mode; default "
+                        "fully provisions slots x max_len)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8200)
     args = p.parse_args(argv)
@@ -327,7 +349,8 @@ def main(argv=None) -> int:
     elif args.engine:
         backends.append(engine_backend(
             args.model, checkpoint_dir=args.checkpoint_dir,
-            slots=args.slots, max_len=args.max_len))
+            slots=args.slots, max_len=args.max_len,
+            block_size=args.block_size, num_blocks=args.num_blocks))
     else:
         backends.append(transformer_backend(
             args.model, checkpoint_dir=args.checkpoint_dir))

@@ -63,6 +63,16 @@ SERVE_TOKENS: Counter = _build("tik_serve_tokens_generated_total")
 SERVE_ACTIVE_SLOTS: Gauge = _build("tik_serve_active_slots")
 SERVE_QUEUE_DEPTH: Gauge = _build("tik_serve_queue_depth")
 
+# serve paged KV cache (serve/kvcache.py + chunked prefill scheduler)
+SERVE_KV_POOL_UTILIZATION: Gauge = _build("tik_serve_kv_pool_utilization")
+SERVE_KV_BLOCKS_IN_USE: Gauge = _build("tik_serve_kv_blocks_in_use")
+SERVE_PREFIX_HITS: Counter = _build("tik_serve_prefix_cache_hits_total")
+SERVE_PREFIX_TOKENS_SAVED: Counter = _build(
+    "tik_serve_prefix_cache_tokens_saved_total")
+SERVE_PREFILL_CHUNKS: Counter = _build("tik_serve_prefill_chunks_total")
+SERVE_PREFILL_PENDING: Gauge = _build("tik_serve_prefill_pending_tokens")
+SERVE_PREEMPTIONS: Counter = _build("tik_serve_preemptions_total")
+
 # goodput ledger / step profiler
 GOODPUT_SECONDS: Counter = _build("tik_goodput_seconds_total")
 GOODPUT_WALL: Gauge = _build("tik_goodput_wall_seconds")

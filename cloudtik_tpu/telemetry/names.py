@@ -126,6 +126,26 @@ _ALL = [
        "Decode slots occupied this step.", "serve"),
     _m("tik_serve_queue_depth", "gauge",
        "Requests waiting for a slot.", "serve"),
+    # -- serve paged KV cache (serve/kvcache.py) -------------------------
+    _m("tik_serve_kv_pool_utilization", "gauge",
+       "Fraction of usable KV blocks held by requests (cached-idle "
+       "prefix blocks count as reclaimable, not used).", "serve"),
+    _m("tik_serve_kv_blocks_in_use", "gauge",
+       "KV blocks held by in-flight requests.", "serve"),
+    _m("tik_serve_prefix_cache_hits_total", "counter",
+       "Admissions whose prompt opened with cached prefix blocks.",
+       "serve"),
+    _m("tik_serve_prefix_cache_tokens_saved_total", "counter",
+       "Prompt tokens served from the prefix cache instead of "
+       "recomputed by prefill.", "serve"),
+    _m("tik_serve_prefill_chunks_total", "counter",
+       "Prompt chunks run by the chunked-prefill scheduler.", "serve"),
+    _m("tik_serve_prefill_pending_tokens", "gauge",
+       "Prompt tokens admitted but not yet prefilled (the chunk "
+       "queue).", "serve"),
+    _m("tik_serve_preemptions_total", "counter",
+       "Requests preempted and requeued because the KV pool ran out "
+       "of blocks.", "serve"),
     # -- goodput ledger / step profiler ----------------------------------
     _m("tik_goodput_seconds_total", "counter",
        "Job wall time attributed to a goodput bucket "
@@ -240,6 +260,9 @@ _EVENT_LIST = [
      "a serve request took a decode slot."),
     ("tik_serve_cancel",
      "a serve request was cancelled."),
+    ("tik_serve_preemption",
+     "a serve request was preempted (KV pool exhausted) and requeued "
+     "for recompute-on-readmit."),
     ("tik_fault_fired",
      "an armed fault plan fired at a seam (chaos drills)."),
     ("tik_train_resume",
@@ -278,7 +301,7 @@ SPANS: Dict[str, str] = {
     "checkpoint.restore":     "checkpoint restore",
     "discovery.render":       "registry -> targets/dns render pass",
     "serve.enqueue":          "request submit -> queued",
-    "serve.prefill":          "prompt prefill + cache insert (first token)",
+    "serve.prefill":          "one prompt prefill chunk against the paged pool",
     "serve.decode_step":      "one engine decode step over all slots",
     "serve.decode":           "per-request decode window (first->last token)",
     "train.window":           "one log_every window of training steps",

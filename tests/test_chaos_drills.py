@@ -10,7 +10,10 @@ backend, bounded iterations):
   (b) a torn checkpoint write (truncated before its data is complete)
       is skipped on restore in favor of the previous committed step;
   (c) a heartbeat blackout shorter than TIK_BOOT_GRACE_S causes NO
-      recycle (no false-positive condemnation).
+      recycle (no false-positive condemnation);
+  (d) KV-pool exhaustion in the serving engine (injected at the
+      `serve.kvcache.alloc` seam AND real) queues admissions and
+      preempts/requeues the newest request instead of crashing.
 """
 
 import itertools
@@ -243,3 +246,69 @@ def test_run_drill_surfaces_injected_launch_failures():
     # the injected failure did not wedge the launcher: later passes
     # brought the cluster back to min_workers
     assert wait_for(lambda: len(provider.mock_nodes()) == 2)
+
+
+def test_drill_kv_pool_exhaustion_queues_preempts_and_recovers(tmp_path):
+    """Drill (d): KV-pool exhaustion, injected AND real.
+
+    Injected (`serve.kvcache.alloc` raise at an admission-shaped
+    alloc): the request stays QUEUED — no crash, no error — and admits
+    on the next pass.  Real (pool too small for two worst cases): the
+    NEWEST request is preempted and requeued, both finish bit-correct,
+    the ledger records `done` with the preemption count, and the pool
+    is fully free after stop."""
+    import jax
+    import numpy as np
+
+    from cloudtik_tpu.models import generate as G
+    from cloudtik_tpu.models import transformer as T
+    from cloudtik_tpu.serve import reqlog
+    from cloudtik_tpu.serve.engine import (
+        DecodeEngine, EngineConfig, Request)
+
+    cfg = T.config("tiny", dtype=jax.numpy.float32,
+                   attention_impl="reference", remat=False)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    engine = DecodeEngine(params, cfg, EngineConfig(
+        slots=2, max_len=32, prefill_buckets=(8,), block_size=4,
+        num_blocks=9, prefix_cache=False))       # 8 usable blocks
+    engine.start()
+    reqlog.install(str(tmp_path / "req.jsonl"))
+    try:
+        def reference(prompt, n):
+            out = G.generate(params, jax.numpy.asarray([prompt],
+                                                       np.int32),
+                             cfg, max_new_tokens=n)
+            return np.asarray(out)[0].tolist()
+
+        # phase 1 — injected exhaustion at admission: an 8-token
+        # prompt allocates need=2 blocks; the armed raise turns that
+        # into "pool exhausted" exactly once
+        plan = FaultPlan([FaultPoint("serve.kvcache.alloc", "raise",
+                                     times=1, match={"need": 2})],
+                         seed=3)
+        prompt8 = [1, 2, 3, 4, 5, 6, 7, 8]
+        with seams.armed(plan):
+            req = engine.submit(Request(prompt8, max_new_tokens=4))
+            assert req.wait(timeout=120) == reference(prompt8, 4)
+        assert plan.points[0].fired == 1
+        assert req.error is None          # queued, not failed
+
+        # phase 2 — real exhaustion mid-decode: two worst cases of 8
+        # blocks each cannot co-reside in 8 usable blocks
+        a = engine.submit(Request([9, 8, 7, 6], max_new_tokens=28))
+        b = engine.submit(Request([3, 1, 4, 1], max_new_tokens=28))
+        assert a.wait(timeout=300) == reference([9, 8, 7, 6], 28)
+        assert b.wait(timeout=300) == reference([3, 1, 4, 1], 28)
+        assert a.preemptions == 0         # oldest always progresses
+        assert b.preemptions >= 1         # newest is the victim
+    finally:
+        reqlog.uninstall()
+        engine.stop()
+    by_id = {r["request_id"]: r for r in reqlog.read_requests(
+        str(tmp_path / "req.jsonl"))}
+    assert by_id[a.request_id]["finish"] == "done"
+    assert by_id[b.request_id]["finish"] == "done"
+    assert by_id[b.request_id]["preemptions"] >= 1
+    assert by_id[b.request_id]["kv_blocks"] >= 1
+    assert engine.pool.used() == 0        # no leak through the chaos

@@ -183,6 +183,11 @@ def test_seams_are_noops_without_a_plan(monkeypatch, tmp_path):
                       total_resources={"CPU": 1})
     agent.heartbeat_once()
 
+    # paged-KV block allocation (serve.kvcache.alloc)
+    from cloudtik_tpu.serve.kvcache import BlockPool
+    pool = BlockPool(num_blocks=4, block_size=8)
+    pool.release(pool.alloc(2))
+
     # prefetcher consumer hand-off (train.prefetch.next)
     from cloudtik_tpu.train.prefetch import Prefetcher
     pf = Prefetcher(iter([{"x": 1}]), sharding=None)

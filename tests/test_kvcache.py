@@ -1,0 +1,174 @@
+"""Block-pool allocator invariants (serve/kvcache.py).
+
+The paged serving engine's correctness rests on this bookkeeping:
+alloc/free round-trips, refcounts, prefix-map sharing with LRU
+eviction, and the copy-on-write boundary.  These tests are pure host
+logic — no jax, no engine.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from cloudtik_tpu.serve.kvcache import (
+    NULL_BLOCK, BlockPool, BlockPoolExhausted, blocks_for)
+
+
+class TestAllocFree:
+    def test_null_block_is_reserved(self):
+        pool = BlockPool(num_blocks=5, block_size=4)
+        assert pool.usable_blocks == 4
+        blocks = pool.alloc(4)
+        assert NULL_BLOCK not in blocks
+        assert sorted(blocks) == [1, 2, 3, 4]
+
+    def test_alloc_free_roundtrip(self):
+        pool = BlockPool(num_blocks=9, block_size=4)
+        a = pool.alloc(3)
+        b = pool.alloc(2)
+        assert pool.used() == 5 and pool.available() == 3
+        pool.release(a)
+        pool.release(b)
+        assert pool.used() == 0
+        assert pool.available() == pool.usable_blocks
+
+    def test_exhaustion_is_all_or_nothing(self):
+        pool = BlockPool(num_blocks=5, block_size=4)
+        pool.alloc(3)
+        with pytest.raises(BlockPoolExhausted):
+            pool.alloc(2)
+        # the failed alloc must not have leaked a partial grab
+        assert pool.available() == 1
+        assert len(pool.alloc(1)) == 1
+
+    def test_release_unallocated_refuses(self):
+        pool = BlockPool(num_blocks=5, block_size=4)
+        with pytest.raises(ValueError):
+            pool.release([3])
+
+    def test_blocks_for(self):
+        assert blocks_for(0, 8) == 0
+        assert blocks_for(1, 8) == 1
+        assert blocks_for(8, 8) == 1
+        assert blocks_for(9, 8) == 2
+
+
+class TestRefcountCow:
+    def test_fork_shares_and_release_keeps_until_last(self):
+        pool = BlockPool(num_blocks=9, block_size=4)
+        table = pool.alloc(3)
+        fork = pool.fork_table(table)
+        assert all(pool.ref(b) == 2 for b in table)
+        pool.release(fork)
+        assert all(pool.ref(b) == 1 for b in table)
+        assert pool.used() == 3          # original holder keeps them
+        pool.release(table)
+        assert pool.used() == 0
+
+    def test_needs_copy_is_the_cow_boundary(self):
+        """A shared block must be copied before a write; a sole-owner
+        block must not be (that would waste a block per append)."""
+        pool = BlockPool(num_blocks=9, block_size=4)
+        table = pool.alloc(2)
+        assert not pool.needs_copy(table[1])
+        fork = pool.fork_table(table)
+        assert pool.needs_copy(table[1])
+        # the COW protocol: fresh block, (device copy), drop the share
+        fresh = pool.alloc(1)[0]
+        pool.release([fork[1]])
+        fork[1] = fresh
+        assert not pool.needs_copy(table[1])
+        assert not pool.needs_copy(fork[1])
+        pool.release(table)
+        pool.release(fork)
+        assert pool.used() == 0
+
+    def test_incref_null_block_refuses(self):
+        pool = BlockPool(num_blocks=5, block_size=4)
+        with pytest.raises(ValueError):
+            pool.incref(NULL_BLOCK)
+
+
+class TestPrefixMap:
+    def _filled(self, pool, prompt):
+        """Simulate a request: alloc, register, release (parks cached
+        full blocks on the evictable LRU)."""
+        table = pool.alloc(blocks_for(len(prompt), pool.block_size))
+        pool.register_prefix(prompt, table)
+        return table
+
+    def test_match_requires_full_blocks_and_leaves_a_tail(self):
+        pool = BlockPool(num_blocks=9, block_size=4)
+        prompt = list(range(10))          # 2 full blocks + 2 tokens
+        table = self._filled(pool, prompt)
+        # identical prompt: both full blocks match, tail recomputed
+        blocks, reuse = pool.match_prefix(prompt)
+        assert reuse == 8 and blocks == table[:2]
+        assert all(pool.ref(b) == 2 for b in blocks)
+        pool.release(blocks)
+        # exactly one full block of prompt: nothing to reuse (at least
+        # one token must remain for first-token logits)
+        blocks2, reuse2 = pool.match_prefix(prompt[:4])
+        assert blocks2 == [] and reuse2 == 0
+        pool.release(table)
+
+    def test_chain_keys_prevent_middle_matches(self):
+        """Block content only matches behind an identical full prefix
+        — the chain key includes the parent."""
+        pool = BlockPool(num_blocks=9, block_size=4)
+        table = self._filled(pool, [1, 2, 3, 4, 5, 6, 7, 8, 9])
+        # same second block content, different first block: no match
+        blocks, reuse = pool.match_prefix([9, 9, 9, 9, 5, 6, 7, 8, 1])
+        assert blocks == [] and reuse == 0
+        pool.release(table)
+
+    def test_cached_blocks_are_reclaimable_not_used(self):
+        pool = BlockPool(num_blocks=9, block_size=4)
+        prompt = list(range(9))           # 2 full blocks + 1
+        table = self._filled(pool, prompt)
+        pool.release(table)
+        # registered full blocks park on the LRU; the partial tail
+        # block goes straight back to the free list
+        assert pool.used() == 0
+        assert pool.free_count() == pool.usable_blocks - 2
+        assert pool.available() == pool.usable_blocks
+        # a new match revives them without recompute
+        blocks, reuse = pool.match_prefix(prompt)
+        assert reuse == 8 and blocks == table[:2]
+        assert pool.used() == 2
+        pool.release(blocks)
+
+    def test_eviction_reclaims_lru_cached_blocks(self):
+        pool = BlockPool(num_blocks=4, block_size=4)   # 3 usable
+        table = self._filled(pool, list(range(8)))     # 2 cached
+        pool.release(table)
+        assert pool.free_count() == 1
+        # demand 3 blocks: the free one + both cached (evicted, their
+        # prefix entries dropped)
+        got = pool.alloc(3)
+        assert len(got) == 3
+        blocks, reuse = pool.match_prefix(list(range(8)))
+        assert blocks == [] and reuse == 0
+        pool.release(got)
+
+    def test_first_writer_wins_registration(self):
+        pool = BlockPool(num_blocks=9, block_size=4)
+        prompt = list(range(8))
+        t1 = self._filled(pool, prompt)
+        t2 = pool.alloc(2)
+        assert pool.register_prefix(prompt, t2) == 0   # already cached
+        blocks, reuse = pool.match_prefix(prompt + [99])
+        assert blocks == [t1[0], t1[1]]
+        pool.release(blocks)
+        pool.release(t1)
+        pool.release(t2)
+
+    def test_hit_counters_accumulate(self):
+        pool = BlockPool(num_blocks=9, block_size=4)
+        table = self._filled(pool, list(range(9)))
+        assert pool.prefix_hits == 0
+        blocks, _reuse = pool.match_prefix(list(range(9)))
+        assert pool.prefix_hits == 1
+        assert pool.prefix_tokens_saved == 8
+        pool.release(blocks)
+        pool.release(table)

@@ -275,9 +275,15 @@ class TestBenchSuiteDispatch:
         (whose own JSON line feeds perf_gate) instead of the flagship
         probe+MFU path."""
         calls = []
-        monkeypatch.setattr(bench.subprocess, "call",
-                            lambda cmd: calls.append(cmd) or 0)
+        monkeypatch.setattr(
+            bench.subprocess, "call",
+            lambda cmd, **kw: calls.append((cmd, kw)) or 0)
         assert bench.main(["--suite", "input_pipeline"]) == 0
         assert len(calls) == 1
-        assert calls[0][0] == sys.executable
-        assert calls[0][1].endswith("input_pipeline_bench.py")
+        cmd, kw = calls[0]
+        assert cmd[0] == sys.executable
+        assert cmd[1].endswith("input_pipeline_bench.py")
+        # uninstalled checkouts: the child must see the repo root
+        import os as _os
+        assert kw["env"]["PYTHONPATH"].split(_os.pathsep)[0] == \
+            _os.path.dirname(_os.path.abspath(bench.__file__))

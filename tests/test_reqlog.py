@@ -227,8 +227,10 @@ class TestEngineIntegration:
         engine = DecodeEngine.__new__(DecodeEngine)
         from cloudtik_tpu.serve.engine import EngineConfig
         engine.ec = EngineConfig(slots=1, max_len=64)
+        import collections
         import queue as _queue
         engine._queue = _queue.Queue()
+        engine._waiting = collections.deque()
         engine._slots = [None]
         engine._stop = threading.Event()
         engine._wake = threading.Event()

@@ -89,17 +89,20 @@ class TestServingBench:
         telemetry.reset()
 
     def test_smoke_line_pipes_into_perf_gate(self, serving, capsys,
-                                             monkeypatch):
-        """Tiny rate, few requests: main() emits one perf_gate-
-        compatible line and the gate accepts it (`--fresh -`)."""
+                                             monkeypatch, tmp_path):
+        """Tiny rate, few requests: main() emits perf_gate-compatible
+        lines — shared-prefix first, the flagship mixed line LAST —
+        and the gate accepts the flagship line (`--fresh -`; history
+        isolated from the committed trajectory, whose real rates this
+        deliberately tiny run would read as a regression against)."""
         rc = serving.main(["--requests", "5", "--iters", "1",
                            "--lo", "4", "--max-rate", "8",
                            "--slo-ttft-p95", "2.0"])
         assert rc == 0
         out = capsys.readouterr().out
-        line = [l for l in out.splitlines()
-                if l.strip().startswith("{")][-1]
-        record = json.loads(line)
+        lines = [l for l in out.splitlines()
+                 if l.strip().startswith("{")]
+        record = json.loads(lines[-1])
         assert record["metric"] == "serving_rps_at_slo"
         assert record["value"] > 0
         assert "error" not in record
@@ -108,11 +111,21 @@ class TestServingBench:
         assert detail["ttft_s"]["p95"] is not None
         assert detail["queue_wait_s"]["p99"] is not None
         assert detail["availability"] == 1.0
+        # the shared-prefix workload rides along, with the prefix-
+        # cache win attributed against its no-cache baseline
+        shared = json.loads(lines[0])
+        assert shared["metric"] == "serving_rps_at_slo_shared_prefix"
+        assert shared["detail"]["prefix_tokens_saved"] > 0
+        assert "baseline_rps_no_prefix_cache" in shared["detail"]
+        assert shared["detail"]["prefill_chunks"] < \
+            shared["detail"]["baseline_prefill_chunks"]
 
         perf_gate = _load_path(REPO / "tools" / "perf_gate.py",
                                "perf_gate_serving")
-        monkeypatch.setattr("sys.stdin", io.StringIO(line))
-        assert perf_gate.main(["--fresh", "-"]) == 0
+        monkeypatch.setattr("sys.stdin", io.StringIO(lines[-1]))
+        assert perf_gate.main([
+            "--fresh", "-",
+            "--history", str(tmp_path / "BENCH_none_*.json")]) == 0
 
     def test_degraded_engine_lowers_rps_and_burns_slo(self, serving,
                                                       tmp_path,

@@ -34,7 +34,11 @@ cloudtik_tpu/telemetry/names.py:
      drilled);
   10. the SLO catalog (telemetry/slo.py default_slos): SLO names are
      unique, every referenced metric resolves against the catalog, and
-     docs/observability.md documents every SLO by name.
+     docs/observability.md documents every SLO by name;
+  11. the request-ledger record schema (serve/reqlog.py RECORD_FIELDS):
+     every field docs/observability.md's "Record fields" table names
+     exists in the schema, and every schema field is documented —
+     ledger docs stay honest as fields are added.
 
 Run: ``python tools/check_telemetry_names.py`` (exit 1 on failure).
 """
@@ -289,12 +293,39 @@ def run_checks() -> List[str]:
             errors.append(f"SLO {slo.name!r} references unknown "
                           f"metric {slo.metric!r}")
 
-    # 6. docs catalog coverage
+    # 6. docs catalog coverage (+ 11. the request-ledger record schema
+    # <-> the docs "Record fields" table — same file, one read)
     doc_path = os.path.join(REPO_ROOT, "docs", "observability.md")
     if not os.path.exists(doc_path):
         errors.append("docs/observability.md is missing")
     else:
         doc = open(doc_path, encoding="utf-8").read()
+        # 11. the docs table is the rows immediately following the
+        # literal "Record fields" marker; its first-cell backticked
+        # token is the field name.  Both directions checked: a
+        # documented field missing from RECORD_FIELDS is a docs lie,
+        # an undocumented schema field is a docs hole.
+        from cloudtik_tpu.serve.reqlog import RECORD_FIELDS
+        documented_fields = set()
+        marker = doc.find("Record fields")
+        if marker < 0:
+            errors.append("docs/observability.md has no \"Record "
+                          "fields\" request-ledger table")
+        else:
+            for line in doc[marker:].splitlines():
+                m = re.match(r"^\|\s*`([a-z0-9_]+)`\s*\|", line)
+                if m:
+                    documented_fields.add(m.group(1))
+                elif documented_fields and not line.startswith("|"):
+                    break           # table ended
+            for field in sorted(documented_fields - set(RECORD_FIELDS)):
+                errors.append(f"docs/observability.md documents ledger "
+                              f"field {field!r} that is not in "
+                              "serve/reqlog.py RECORD_FIELDS")
+            for field in sorted(set(RECORD_FIELDS) - documented_fields):
+                errors.append(f"ledger field {field!r} (serve/reqlog.py "
+                              "RECORD_FIELDS) is missing from docs/"
+                              "observability.md's Record fields table")
         for name in sorted(METRICS):
             if name not in doc:
                 errors.append(
@@ -333,12 +364,14 @@ def main() -> int:
         return 1
     from cloudtik_tpu.runtimes.prometheus.alerts import (
         default_alert_rules)
+    from cloudtik_tpu.serve.reqlog import RECORD_FIELDS
     from cloudtik_tpu.telemetry.names import EVENTS, METRICS, SPANS
     from cloudtik_tpu.telemetry.slo import default_slos
     print(f"OK: {len(METRICS)} metrics, {len(SPANS)} spans, "
           f"{len(EVENTS)} events, {len(default_alert_rules())} alert "
-          f"rules, {len(default_slos())} SLOs — catalog, registry, "
-          "source, dashboards, and docs all agree.")
+          f"rules, {len(default_slos())} SLOs, {len(RECORD_FIELDS)} "
+          "ledger fields — catalog, registry, source, dashboards, and "
+          "docs all agree.")
     return 0
 
 
