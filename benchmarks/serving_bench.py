@@ -29,11 +29,23 @@ Two workloads (``--workload both`` is the default):
     ``prefix_tokens_saved`` / ``prefill_chunks`` aggregates, so the
     win is attributable, not vibes.
 
+``--spec`` switches to the **speculative-decoding** trajectory
+(`run_spec`): a decode-heavy workload (short prompts, long outputs) on
+a spec-on engine — the draft is the target itself, so greedy
+acceptance is 1.0 and the bench measures the machinery's ceiling —
+against a spec-off engine on the same host.  It emits
+``serving_tpot_ms_spec`` (decode cadence + the spec-off baseline in
+detail) and the flagship ``serving_rps_at_slo_spec`` LAST; both carry
+``mode: "spec"`` so perf_gate medians them as their own trajectories
+and never mixes them into the spec-off serving lines.
+
 Runs on CPU (JAX_PLATFORMS defaults to cpu here) and TPU alike; always
 exits 0 (failures become an ``error`` record perf_gate skips).
 
 Run:  python bench.py --suite serving
 Gate: python bench.py --suite serving | \
+          python tools/perf_gate.py --fresh -
+Spec: python benchmarks/serving_bench.py --spec | \
           python tools/perf_gate.py --fresh -
 """
 
@@ -46,6 +58,7 @@ import random
 import sys
 import tempfile
 import time
+from typing import Optional
 
 # the serving column is a CPU-reachable trajectory: the tiny model on
 # whatever platform is attached, CPU by default so a wedged TPU runtime
@@ -54,9 +67,16 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 METRIC = "serving_rps_at_slo"
 METRIC_SHARED_PREFIX = "serving_rps_at_slo_shared_prefix"
+METRIC_SPEC = "serving_rps_at_slo_spec"
+METRIC_SPEC_TPOT = "serving_tpot_ms_spec"
 
 PROMPT_LENGTHS = (4, 6, 8, 12)
 OUTPUT_LENGTHS = (4, 8, 12)
+# speculative workload: short prompts, LONG outputs — decode-dominated,
+# because spec decoding is a per-token (TPOT) lever; prefill work would
+# only dilute the measurement
+SPEC_PROMPT_LENGTHS = (4, 6, 8)
+SPEC_OUTPUT_LENGTHS = (16, 24, 32)
 # shared-prefix workload: a 48-token system prompt (6 full 8-token
 # blocks — block-aligned so the prefix map can share all of it) plus a
 # short per-request user suffix and SHORT outputs: the workload is
@@ -76,12 +96,20 @@ def shared_prefix_tokens(seed: int):
 
 
 def build_engine(slots: int = 4, max_len: int = 64,
-                 prefix_cache: bool = True):
-    """Tiny-model engine, started; caller owns stop()."""
+                 prefix_cache: bool = True,
+                 spec_k: Optional[int] = None):
+    """Tiny-model engine, started; caller owns stop().
+
+    ``spec_k`` enables speculative decoding with the target ITSELF as
+    the draft — greedy acceptance is 1.0 by construction, so the bench
+    measures the spec machinery's ceiling: k fused draft forwards plus
+    one verify emitting k+1 tokens per round, instead of k+1 separate
+    decode dispatches."""
     import jax
 
     from cloudtik_tpu.models import transformer as T
-    from cloudtik_tpu.serve.engine import DecodeEngine, EngineConfig
+    from cloudtik_tpu.serve.engine import (
+        DecodeEngine, EngineConfig, SpecConfig)
 
     cfg = T.config("tiny", dtype=jax.numpy.float32,
                    attention_impl="reference", remat=False)
@@ -90,16 +118,21 @@ def build_engine(slots: int = 4, max_len: int = 64,
         params, cfg,
         EngineConfig(slots=slots, max_len=max_len,
                      prefill_buckets=(8, 16), block_size=8,
-                     prefix_cache=prefix_cache))
+                     prefix_cache=prefix_cache,
+                     spec=SpecConfig(k=spec_k) if spec_k else None),
+        draft=(params, cfg) if spec_k else None)
     engine.start()
     return engine
 
 
-def warm_engine(engine) -> None:
+def warm_engine(engine, spec: bool = False) -> None:
     """Compile prefill (both buckets) + decode outside any measured
-    trial — the SLO judges steady-state serving, not XLA."""
-    engine.generate([1, 2, 3, 4], max_new_tokens=2)
-    engine.generate(list(range(1, 11)), max_new_tokens=2)
+    trial — the SLO judges steady-state serving, not XLA.  Spec
+    engines generate enough tokens to compile the draft prefill /
+    propose / verify programs too."""
+    n = 8 if spec else 2
+    engine.generate([1, 2, 3, 4], max_new_tokens=n)
+    engine.generate(list(range(1, 11)), max_new_tokens=n)
 
 
 def run_trial(engine, rate: float, n_requests: int, seed: int,
@@ -120,11 +153,15 @@ def run_trial(engine, rate: float, n_requests: int, seed: int,
     for _ in range(n_requests):
         t += rng.expovariate(rate)
         arrivals.append(t)
-    prefix = shared_prefix_tokens(seed) \
-        if workload == "shared_prefix" else []
-    suffix_lengths = SUFFIX_LENGTHS if prefix else PROMPT_LENGTHS
-    output_lengths = SHARED_OUTPUT_LENGTHS if prefix \
-        else OUTPUT_LENGTHS
+    prefix = []
+    suffix_lengths, output_lengths = PROMPT_LENGTHS, OUTPUT_LENGTHS
+    if workload == "shared_prefix":
+        prefix = shared_prefix_tokens(seed)
+        suffix_lengths = SUFFIX_LENGTHS
+        output_lengths = SHARED_OUTPUT_LENGTHS
+    elif workload == "spec":
+        suffix_lengths = SPEC_PROMPT_LENGTHS
+        output_lengths = SPEC_OUTPUT_LENGTHS
     shapes = [(rng.choice(suffix_lengths), rng.choice(output_lengths))
               for _ in range(n_requests)]
 
@@ -313,6 +350,84 @@ def run(slo_ttft_p95_s: float = 0.75, n_requests: int = 24,
     return records
 
 
+def run_spec(slo_ttft_p95_s: float = 0.75, n_requests: int = 24,
+             seed: int = 0, slots: int = 2, lo: float = 2.0,
+             max_rate: float = 32.0, iters: int = 4, spec_k: int = 5,
+             tpot_rate: float = 2.0):
+    """Speculative-decoding trajectory (``--spec``): the decode-heavy
+    workload on a spec-on engine vs a spec-off engine on the same host.
+
+    The draft is the target itself (greedy acceptance 1.0 by
+    construction — the machinery's ceiling), so the measured TPOT win
+    is the dispatch arithmetic: one fused k-token draft program plus
+    one verify per k+1 tokens, vs k+1 separate decode steps.  Emits
+    two ``mode: "spec"`` records (their own perf_gate trajectories,
+    never the spec-off median): ``serving_tpot_ms_spec`` — per-token
+    decode cadence at a fixed low rate, with the spec-off baseline in
+    detail (NOTE: lower is better; this line is informational, not the
+    gate's fresh line) — and the flagship ``serving_rps_at_slo_spec``
+    LAST, which ``perf_gate --fresh -`` consumes.
+    """
+    records = []
+    engine = build_engine(slots=slots, spec_k=spec_k)
+    base = build_engine(slots=slots)
+    try:
+        warm_engine(engine, spec=True)
+        warm_engine(base)
+        with tempfile.TemporaryDirectory() as ledger_dir:
+            spec_stats = run_trial(engine, tpot_rate, n_requests, seed,
+                                   ledger_dir, trial=900,
+                                   workload="spec")
+            base_stats = run_trial(base, tpot_rate, n_requests, seed,
+                                   ledger_dir, trial=901,
+                                   workload="spec")
+            best, rate_stats = find_max_rate(
+                engine, slo_ttft_p95_s, n_requests, seed, ledger_dir,
+                lo=lo, max_rate=max_rate, iters=iters, workload="spec")
+    finally:
+        engine.stop()
+        base.stop()
+    tpot_ms = (spec_stats["tpot_s"]["p50"] or 0.0) * 1e3
+    base_ms = (base_stats["tpot_s"]["p50"] or 0.0) * 1e3
+    tpot_detail = {
+        "rate_rps": tpot_rate,
+        "requests": n_requests,
+        "slots": slots,
+        "spec_k": spec_k,
+        "seed": seed,
+        "tpot_ms_p50": tpot_ms,
+        "tpot_ms_p95": (spec_stats["tpot_s"]["p95"] or 0.0) * 1e3,
+        "baseline_tpot_ms_spec_off": base_ms,
+        "tpot_speedup_vs_spec_off":
+            base_ms / tpot_ms if tpot_ms else None,
+        "spec_acceptance_rate": spec_stats.get("spec_acceptance_rate"),
+        "spec_tokens_per_verify":
+            spec_stats.get("spec_tokens_per_verify"),
+        "draft_tokens": spec_stats.get("draft_tokens"),
+        "accepted_tokens": spec_stats.get("accepted_tokens"),
+        "spec_steps": spec_stats.get("spec_steps"),
+    }
+    record = {"metric": METRIC_SPEC_TPOT, "value": round(tpot_ms, 4),
+              "unit": "ms", "mode": "spec", "detail": tpot_detail}
+    if tpot_ms <= 0.0:
+        record["error"] = "no TPOT measured"
+    records.append(record)
+    detail = _detail(rate_stats, slo_ttft_p95_s, n_requests, slots,
+                     seed)
+    detail["spec_k"] = spec_k
+    if rate_stats is not None:
+        detail["spec_acceptance_rate"] = \
+            rate_stats.get("spec_acceptance_rate")
+        detail["spec_tokens_per_verify"] = \
+            rate_stats.get("spec_tokens_per_verify")
+    record = {"metric": METRIC_SPEC, "value": round(best, 3),
+              "unit": "req/s", "mode": "spec", "detail": detail}
+    if best <= 0.0:
+        record["error"] = "no request rate met the TTFT SLO"
+    records.append(record)
+    return records
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="requests/sec at a TTFT SLO (perf_gate line)")
@@ -322,9 +437,12 @@ def main(argv=None) -> int:
     parser.add_argument("--requests", type=int, default=24,
                         help="requests per trial")
     parser.add_argument("--seed", type=int, default=0)
-    parser.add_argument("--slots", type=int, default=4)
-    parser.add_argument("--lo", type=float, default=4.0,
-                        help="opening request rate")
+    parser.add_argument("--slots", type=int, default=None,
+                        help="decode slots (default 4; 2 with --spec, "
+                             "where low concurrency is the win case)")
+    parser.add_argument("--lo", type=float, default=None,
+                        help="opening request rate (default 4; 2 with "
+                             "--spec)")
     parser.add_argument("--max-rate", type=float, default=64.0)
     parser.add_argument("--iters", type=int, default=4,
                         help="bisection rounds after the bracket")
@@ -334,13 +452,30 @@ def main(argv=None) -> int:
                         help="which workload(s) to search; 'both' "
                              "prints shared_prefix first and the "
                              "flagship mixed line last")
+    parser.add_argument("--spec", action="store_true",
+                        help="speculative-decoding mode: decode-heavy "
+                             "workload on a spec-on engine (self-draft "
+                             "-> acceptance 1.0) vs spec-off, emitting "
+                             "the serving_*_spec trajectory lines")
+    parser.add_argument("--spec-k", type=int, default=5,
+                        help="draft tokens per verify round (--spec)")
     args = parser.parse_args(argv)
+    slots = args.slots if args.slots is not None \
+        else (2 if args.spec else 4)
+    lo = args.lo if args.lo is not None else (2.0 if args.spec else 4.0)
     try:
-        records = run(
-            slo_ttft_p95_s=args.slo_ttft_p95, n_requests=args.requests,
-            seed=args.seed, slots=args.slots, lo=args.lo,
-            max_rate=args.max_rate, iters=args.iters,
-            workload=args.workload)
+        if args.spec:
+            records = run_spec(
+                slo_ttft_p95_s=args.slo_ttft_p95,
+                n_requests=args.requests, seed=args.seed, slots=slots,
+                lo=lo, max_rate=args.max_rate, iters=args.iters,
+                spec_k=args.spec_k)
+        else:
+            records = run(
+                slo_ttft_p95_s=args.slo_ttft_p95,
+                n_requests=args.requests, seed=args.seed, slots=slots,
+                lo=lo, max_rate=args.max_rate, iters=args.iters,
+                workload=args.workload)
     except Exception as e:
         import traceback
         traceback.print_exc()

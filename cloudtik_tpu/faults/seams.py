@@ -24,6 +24,7 @@ Seam registry (keep docs/fault-injection.md in sync):
   events.append                   flight recorder append {name, path}    supports torn_write
   serve.reqlog.append             request ledger append {name, path}     supports torn_write
   serve.kvcache.alloc             KV block pool alloc   {need, free, evictable}  raise -> pool exhausted
+  serve.spec.verify               speculative verify    {request, width}  raise -> request degrades to plain decode
   train.prefetch.next             prefetcher hand-off   {qsize}         latency -> data_wait
   serve.decode_step               DecodeEngine._step    {active}
   utils.retry                     every retry sleep     {fn, attempt}

@@ -205,6 +205,63 @@ def paged_prefill_chunk(params: Params, kp: jax.Array, vp: jax.Array,
     return kp, vp, logits
 
 
+def paged_verify(params: Params, kp: jax.Array, vp: jax.Array,
+                 table: jax.Array, tokens: jax.Array, start,
+                 cfg: TransformerConfig
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Speculative-decoding verify: ONE target forward over a request's
+    proposed positions (Leviathan et al., ICML'23; Chen et al., 2023).
+
+    tokens [1, C] are the request's pending token followed by the
+    draft's proposals, at absolute positions [start, start+C).  The
+    logits at position i are the target's distribution AFTER consuming
+    tokens[:i+1], so their greedy argmax is exactly what token-by-token
+    decode would have produced — the caller accepts the longest
+    proposal prefix matching them and always takes the target's own
+    token at the first mismatch (or the bonus token on full
+    acceptance), keeping greedy output bit-identical to non-speculative
+    decode.
+
+    Earlier positions (prompt, accepted tokens) read straight out of
+    the pool, and the C cache writes scatter back through the same
+    gather -> forward_step -> scatter path as chunked prefill —
+    including the zero scratch tail, so a verify window whose padding
+    overruns the table's capacity lands in scratch instead of
+    clamp-shifting the writes onto earlier (possibly shared) blocks.
+    Rejected positions are the caller's to rewind: stale K/V past the
+    accepted cursor is masked by the causal test and overwritten by the
+    next verify/decode write before it can ever be attended.
+    """
+    return paged_prefill_chunk(params, kp, vp, table, tokens, start,
+                               cfg)
+
+
+def draft_propose(params: Params, token: jax.Array,
+                  cache: Dict[str, jax.Array], cfg: TransformerConfig,
+                  k: int) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """k greedy draft tokens in ONE jitted program.
+
+    The draft half of speculative decoding: autoregression is
+    inherently sequential, but the k single-token forwards fuse into a
+    single `lax.scan` so one spec round costs one draft dispatch plus
+    one verify dispatch instead of k+1 host round-trips.  `token` is
+    the scalar int32 seed (the request's pending token); returns
+    (proposals [k], updated cache) — proposal i's K/V is written at
+    cache position length+i, exactly the layout the verify step
+    re-derives on the target side.
+    """
+    def step(carry, _):
+        tok, cache = carry
+        logits, cache = forward_step(params, tok[None, None], cache,
+                                     cfg)
+        nxt = logits[0, -1].argmax(-1).astype(jnp.int32)
+        return (nxt, cache), nxt
+
+    (_, cache), toks = jax.lax.scan(step, (token, cache), None,
+                                    length=k)
+    return toks, cache
+
+
 def copy_block(kp: jax.Array, vp: jax.Array, src, dst
                ) -> Tuple[jax.Array, jax.Array]:
     """Device-side block copy (the copy-on-write half: the pool decides

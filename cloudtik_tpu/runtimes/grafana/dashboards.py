@@ -154,6 +154,20 @@ def ai_workload_dashboard() -> Dict[str, Any]:
                "tik_serve_prefill_pending_tokens", "short", 0, 91),
         _panel(27, "Pool preemptions",
                "rate(tik_serve_preemptions_total[5m])", "ops", 12, 91),
+        # -- Speculative decoding row: is the draft earning its keep? -----
+        {"id": 28, "type": "row", "title": "Speculative decoding",
+         "collapsed": False,
+         "gridPos": {"h": 1, "w": 24, "x": 0, "y": 99}, "panels": []},
+        _panel(29, "Spec acceptance rate",
+               "tik_serve_spec_acceptance_rate", "percentunit", 0, 100),
+        _panel(30, "Spec tokens per verify",
+               "tik_serve_spec_tokens_per_verify", "short", 12, 100),
+        _panel(31, "Draft tokens proposed",
+               "rate(tik_serve_spec_draft_tokens_total[5m])",
+               "ops", 0, 108),
+        _panel(32, "Verify rounds",
+               "rate(tik_serve_spec_verify_steps_total[5m])",
+               "ops", 12, 108),
     ]
     return {
         "uid": "tik-ai-workloads",

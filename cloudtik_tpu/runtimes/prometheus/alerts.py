@@ -192,6 +192,15 @@ def default_alert_rules() -> List[AlertRule]:
                     "admissions will queue and preemptions start; "
                     "tune block_size / num_blocks (docs/operations.md "
                     "runbook)"),
+        AlertRule(
+            name="SpecAcceptanceLow", kind=KIND_THRESHOLD,
+            metric="tik_serve_spec_acceptance_rate",
+            op="<", threshold=0.3, for_cycles=3, severity="warning",
+            summary="speculative-decoding acceptance rate below 30% — "
+                    "the draft disagrees with the target, so most "
+                    "draft+verify work is wasted; shrink spec.k or "
+                    "retire the draft model (docs/operations.md "
+                    "runbook)"),
     ]
 
 

@@ -1399,6 +1399,16 @@ def serve_requests(path, tail_n, since_s, finish_filter, as_stats,
             click.echo(f"{label:<12} {entry['count']:>7} "
                        f"{_ms(entry['p50'])} {_ms(entry['p95'])} "
                        f"{_ms(entry['p99'])}")
+        if stats.get("spec_steps"):
+            rate = stats.get("spec_acceptance_rate")
+            rate_s = f"{rate * 100:.1f}%" if rate is not None else "-"
+            tpv = stats.get("spec_tokens_per_verify")
+            tpv_s = f"{tpv:.2f}" if tpv is not None else "-"
+            click.echo(
+                f"spec: verify_steps {stats['spec_steps']}  "
+                f"draft {stats['draft_tokens']}  "
+                f"accepted {stats['accepted_tokens']}  "
+                f"acceptance {rate_s}  tokens/verify {tpv_s}")
         return
     if as_json:
         click.echo(json.dumps(records, indent=1, default=str))

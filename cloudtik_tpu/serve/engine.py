@@ -37,8 +37,23 @@ Memory model (PagedAttention, Kwon et al., SOSP'23 — serve/kvcache.py):
   the NEWEST in-flight request (recompute-style preemption) — the
   oldest request always progresses, and the loop never crashes.  The
   `serve.kvcache.alloc` fault seam injects exhaustion for drills.
+* SPECULATIVE DECODING (Leviathan et al., ICML'23 — EngineConfig.spec
+  + DecodeEngine(draft=...)): a small draft transformer proposes k
+  greedy tokens per round (one fused `lax.scan` dispatch against a
+  private static cache), then ONE jitted target verify
+  (`models/generate.paged_verify`, the chunked-prefill gather/scatter
+  pattern) scores all k+1 positions at once; the longest matching
+  prefix is accepted and the target's own token at the first mismatch
+  (or the bonus token) is always emitted, so greedy output stays
+  bit-identical to plain decode while each round yields up to k+1
+  tokens for ~2 dispatches.  Rejected positions rewind the write
+  cursor and speculation-only blocks return to the pool; a shared
+  block in the verify's write window is copy-on-write'd first
+  (`fork_table`/`needs_copy` — the COW boundary, load-bearing here).
+  A `serve.spec.verify` fault degrades the request to plain decode.
 * Sampling on device: greedy / per-slot temperature (traced — no
-  recompiles per request), engine-level static top_k.
+  recompiles per request), engine-level static top_k; sampled
+  (temperature > 0) requests always take the plain decode step.
 """
 
 from __future__ import annotations
@@ -75,6 +90,25 @@ Params = Dict[str, Any]
 
 
 @dataclasses.dataclass
+class SpecConfig:
+    """Draft-model speculative decoding (Leviathan et al., ICML'23;
+    Chen et al., "Accelerating LLM Decoding with Speculative
+    Sampling").
+
+    Each spec round runs `k` cheap draft forwards (one fused dispatch)
+    plus ONE target verify over the paged pool and emits 1..k+1 tokens.
+    Greedy output stays bit-identical to non-speculative decode: the
+    longest draft prefix matching the target's own greedy tokens is
+    accepted, and the target's token at the first mismatch (or the
+    bonus token on full acceptance) is always emitted.  The draft model
+    itself is handed to :class:`DecodeEngine` as ``draft=(params,
+    cfg)`` — a config object must stay picklable/serializable.
+    """
+
+    k: int = 4                        # draft tokens proposed per verify
+
+
+@dataclasses.dataclass
 class EngineConfig:
     slots: int = 4                    # concurrent decode lanes
     max_len: int = 512                # per-request KV capacity (tokens)
@@ -88,6 +122,8 @@ class EngineConfig:
     # max prompt tokens prefilled per loop iteration; None = largest
     # bucket (the ladder is the chunk size).  max_len disables chunking.
     chunk_size: Optional[int] = None
+    # draft-model speculative decoding; needs DecodeEngine(draft=...)
+    spec: Optional[SpecConfig] = None
 
 
 @dataclasses.dataclass
@@ -99,6 +135,14 @@ class _Slot:
     length: int = 0                   # tokens in cache once decoding
     remaining: int = 0                # new tokens still wanted
     decoding: bool = False            # prefill finished
+    # speculative decoding (EngineConfig.spec): the slot's private
+    # static draft cache, its prompt-prefill cursor, the host-side
+    # mirror of cache["length"], and the per-request degrade latch a
+    # verify fault flips (the request falls back to plain decode)
+    draft_cache: Optional[Dict[str, jax.Array]] = None
+    draft_pos: int = 0                # prompt tokens in the draft cache
+    draft_len: int = 0                # tokens the draft cache holds
+    spec_off: bool = False            # verify fault: degraded to plain
 
 
 class RequestCancelled(RuntimeError):
@@ -160,6 +204,10 @@ class Request:
         self.prefix_tokens: int = 0           # prompt tokens not recomputed
         self.prefill_chunks: int = 0          # chunks the prompt took
         self.preemptions: int = 0             # pool-exhaustion requeues
+        # speculative decoding accounting (request-ledger fields)
+        self.draft_tokens: int = 0            # proposals verified
+        self.accepted_tokens: int = 0         # proposals the target kept
+        self.spec_steps: int = 0              # draft/verify rounds
         self._done = threading.Event()
         self._cancel = False
         # serializes completion: cancel() (caller thread) can race the
@@ -207,6 +255,15 @@ class Request:
                     reqlog.record(self, reqlog.FINISH_CANCELLED)
                     self._done.set()
         return True
+
+
+def fire_verify_seam(request_id: int, width: int) -> None:
+    """The `serve.spec.verify` injection seam, fired immediately before
+    every speculative draft/verify round (`raise` -> the request
+    degrades to non-speculative decode for the rest of its life;
+    `latency` -> a slow verify).  With no plan armed this is one
+    attribute check (the tripwire test runs this exact path)."""
+    seams.fire("serve.spec.verify", request=request_id, width=width)
 
 
 def _decode_layer(cfg: TransformerConfig, x: jax.Array, layer: Params,
@@ -324,7 +381,9 @@ class DecodeEngine:
 
     def __init__(self, params: Params, cfg: TransformerConfig,
                  engine_config: Optional[EngineConfig] = None,
-                 rng: Optional[jax.Array] = None):
+                 rng: Optional[jax.Array] = None,
+                 draft: Optional[Tuple[Params, TransformerConfig]]
+                 = None):
         self.params = params
         self.cfg = cfg
         self.ec = engine_config or EngineConfig()
@@ -380,6 +439,54 @@ class DecodeEngine:
 
         self._prefill_chunk = jax.jit(_prefill_chunk)
         self._copy_block = jax.jit(G.copy_block)
+
+        # -- draft-model speculative decoding (EngineConfig.spec) ------
+        self._spec = self.ec.spec
+        if self._spec is not None:
+            if draft is None:
+                raise ValueError(
+                    "EngineConfig.spec is set but no draft model was "
+                    "passed — DecodeEngine(..., draft=(params, cfg))")
+            if self._spec.k < 1:
+                raise ValueError("SpecConfig.k must be >= 1")
+            self._draft_params, self._draft_cfg = draft
+            if self._draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab ({self._draft_cfg.vocab_size}) must "
+                    f"match the target's ({cfg.vocab_size}) — the "
+                    "verify step compares token ids")
+            # the draft's static per-slot cache plane carries a
+            # chunk_max scratch tail: a padded prefill bucket
+            # overrunning the capacity must land in scratch, not let
+            # dynamic_update_slice clamp-shift the chunk onto earlier
+            # positions (the paged pool's scratch-tail discipline,
+            # applied to the contiguous draft plane)
+            self._draft_plane = self._capacity_tokens + self._chunk_max
+            dcfg = self._draft_cfg
+            self._draft_prefill = jax.jit(
+                lambda p, t, c: G.forward_step(p, t, c, dcfg)[1])
+            self._draft_propose_k = jax.jit(
+                lambda p, t, c: G.draft_propose(p, t, c, dcfg,
+                                                self._spec.k))
+
+            def _draft_one(p, t, c):
+                logits, c = G.forward_step(p, t, c, dcfg)
+                return logits[0, -1].argmax(-1).astype(jnp.int32), c
+
+            self._draft_step = jax.jit(_draft_one)
+
+            def _verify(p, kp, vp, table, tokens, start):
+                kp, vp, logits = G.paged_verify(p, kp, vp, table,
+                                                tokens, start, cfg)
+                return kp, vp, logits[0].argmax(-1).astype(jnp.int32)
+
+            self._verify = jax.jit(_verify)
+            # cumulative totals behind the acceptance-rate and
+            # tokens-per-verify gauges (loop-thread only)
+            self._spec_draft_total = 0
+            self._spec_accepted_total = 0
+            self._spec_emitted_total = 0
+            self._spec_verifies = 0
 
     # -- public ----------------------------------------------------------
     def submit(self, request: Request) -> Request:
@@ -611,6 +718,24 @@ class DecodeEngine:
         self._sync_table(slot_id)
         return True
 
+    def _cow_block(self, slot_id: int, slot: _Slot, j: int) -> bool:
+        """Copy-on-write table entry `j` if another holder shares it:
+        allocate a fresh block, device-copy the contents, drop this
+        holder's share (the ONE place the protocol lives — the plain
+        decode and speculative paths must never diverge on it).
+        Returns False when the slot itself was preempted mid-alloc."""
+        if not self.pool.needs_copy(slot.table[j]):
+            return True
+        fresh = self._alloc_blocks(slot_id, 1)
+        if fresh is None:
+            return False
+        self._kp, self._vp = self._copy_block(
+            self._kp, self._vp, slot.table[j], fresh[0])
+        self.pool.release([slot.table[j]])
+        slot.table[j] = fresh[0]
+        self._sync_table(slot_id)
+        return True
+
     # -- engine loop ------------------------------------------------------
     def _bucket(self, n: int) -> int:
         for b in self._buckets:
@@ -678,6 +803,15 @@ class DecodeEngine:
                              true_len=true_len,
                              prefill_pos=reuse_len,
                              remaining=req.max_new_tokens - 1)
+                if self._spec is not None \
+                        and req.temperature <= 0.0:
+                    # private draft cache; the draft prefills the WHOLE
+                    # prompt (prefix-cache reuse only skips target
+                    # compute — the draft has no shared pool).  Sampled
+                    # requests can never speculate, so they get no
+                    # draft cache and pay no draft prefill
+                    slot.draft_cache = G.init_cache(
+                        self._draft_cfg, 1, self._draft_plane)
                 req.kv_blocks = max(req.kv_blocks, len(slot.table))
                 self._slots[slot_id] = slot
                 self._sync_table(slot_id)
@@ -701,7 +835,11 @@ class DecodeEngine:
         """Run ONE prompt chunk for the oldest prefilling slot.  One
         chunk per loop iteration is the Sarathi interleave: a decode
         step runs between chunks, so in-flight TPOT is bounded by one
-        chunk's compute, not a whole long prompt's."""
+        chunk's compute, not a whole long prompt's.  With speculative
+        decoding on, the draft model prefills the SAME prompt into the
+        slot's private cache one chunk per tick alongside the target's;
+        the slot starts decoding once BOTH caches cover the prompt (the
+        first token still arrives at target-prefill completion)."""
         cand = [(s.request.admitted_mono or 0.0, i)
                 for i, s in enumerate(self._slots)
                 if s is not None and not s.decoding]
@@ -711,59 +849,92 @@ class DecodeEngine:
         slot = self._slots[slot_id]
         req = slot.request
         try:
-            chunk = min(slot.true_len - slot.prefill_pos,
-                        self._chunk_max)
-            covered = kvcache.blocks_for(slot.prefill_pos + chunk,
-                                         self.ec.block_size)
-            if len(slot.table) < covered:
-                if not self._grow_table(slot_id, slot,
-                                        covered - len(slot.table)):
-                    return          # preempted itself; re-admits later
-            bucket = self._bucket(chunk)
-            with telemetry.trace_context(req.traceparent):
-                with telemetry.span("serve.prefill",
-                                    request=req.request_id,
-                                    slot=slot_id,
-                                    start=slot.prefill_pos,
-                                    chunk_len=chunk):
-                    padded = np.zeros((1, bucket), np.int32)
-                    padded[0, :chunk] = req.prompt[
-                        slot.prefill_pos:slot.prefill_pos + chunk]
-                    self._kp, self._vp, tok = self._prefill_chunk(
-                        self.params, self._kp, self._vp,
-                        jnp.asarray(self._tables_np[slot_id]),
-                        jnp.asarray(padded),
-                        jnp.asarray(slot.prefill_pos, jnp.int32),
-                        jnp.asarray(chunk - 1, jnp.int32))
-            slot.prefill_pos += chunk
-            req.prefill_chunks += 1
-            ti.SERVE_PREFILL_CHUNKS.inc()
             if slot.prefill_pos < slot.true_len:
-                return
-            # prompt complete: the final chunk's last logits ARE the
-            # first generated token
-            first_tok = int(tok)
-            req.tokens.append(first_tok)
-            req.first_token_time = time.time()
-            req.first_token_mono = time.monotonic()
-            ti.SERVE_TTFT.observe(req.first_token_time - req.created)
-            ti.SERVE_TOKENS.inc()
-            if self.ec.prefix_cache:
-                self.pool.register_prefix(
-                    req.prompt, slot.table,
-                    start_block=req.prefix_blocks)
-            self._tokens = self._tokens.at[slot_id].set(first_tok)
-            self._lengths = self._lengths.at[slot_id].set(
-                slot.true_len)
-            slot.length = slot.true_len
-            slot.decoding = True
-            if (req.eos_id is not None and first_tok == req.eos_id) \
-                    or slot.remaining <= 0:
-                self._release_slot(slot_id)
-                self._finish_request(req, "ok")
+                chunk = min(slot.true_len - slot.prefill_pos,
+                            self._chunk_max)
+                covered = kvcache.blocks_for(slot.prefill_pos + chunk,
+                                             self.ec.block_size)
+                if len(slot.table) < covered:
+                    if not self._grow_table(slot_id, slot,
+                                            covered - len(slot.table)):
+                        return    # preempted itself; re-admits later
+                bucket = self._bucket(chunk)
+                with telemetry.trace_context(req.traceparent):
+                    with telemetry.span("serve.prefill",
+                                        request=req.request_id,
+                                        slot=slot_id,
+                                        start=slot.prefill_pos,
+                                        chunk_len=chunk):
+                        padded = np.zeros((1, bucket), np.int32)
+                        padded[0, :chunk] = req.prompt[
+                            slot.prefill_pos:slot.prefill_pos + chunk]
+                        self._kp, self._vp, tok = self._prefill_chunk(
+                            self.params, self._kp, self._vp,
+                            jnp.asarray(self._tables_np[slot_id]),
+                            jnp.asarray(padded),
+                            jnp.asarray(slot.prefill_pos, jnp.int32),
+                            jnp.asarray(chunk - 1, jnp.int32))
+                slot.prefill_pos += chunk
+                req.prefill_chunks += 1
+                ti.SERVE_PREFILL_CHUNKS.inc()
+                if slot.prefill_pos >= slot.true_len:
+                    # prompt complete: the final chunk's last logits
+                    # ARE the first generated token
+                    first_tok = int(tok)
+                    req.tokens.append(first_tok)
+                    req.first_token_time = time.time()
+                    req.first_token_mono = time.monotonic()
+                    ti.SERVE_TTFT.observe(
+                        req.first_token_time - req.created)
+                    ti.SERVE_TOKENS.inc()
+                    if self.ec.prefix_cache:
+                        self.pool.register_prefix(
+                            req.prompt, slot.table,
+                            start_block=req.prefix_blocks)
+                    self._tokens = self._tokens.at[slot_id].set(
+                        first_tok)
+                    self._lengths = self._lengths.at[slot_id].set(
+                        slot.true_len)
+                    slot.length = slot.true_len
+                    if (req.eos_id is not None
+                            and first_tok == req.eos_id) \
+                            or slot.remaining <= 0:
+                        self._release_slot(slot_id)
+                        self._finish_request(req, "ok")
+                        return
+            if slot.draft_cache is not None \
+                    and slot.draft_pos < slot.true_len:
+                self._draft_prefill_chunk(slot)
+            if slot.prefill_pos >= slot.true_len \
+                    and (slot.draft_cache is None
+                         or slot.draft_pos >= slot.true_len):
+                slot.decoding = True
         except Exception as e:   # surface per-request failures
             self._release_slot(slot_id)
             self._finish_request(req, "error", e)
+
+    def _draft_prefill_chunk(self, slot: _Slot) -> None:
+        """One draft-model prompt chunk into the slot's private static
+        cache, padded to the same bucket ladder as the target's chunks.
+        Pad garbage lands beyond the cursor and is overwritten by the
+        next chunk before any query can attend it; the plane's scratch
+        tail absorbs a bucket overrunning the capacity."""
+        req = slot.request
+        chunk = min(slot.true_len - slot.draft_pos, self._chunk_max)
+        bucket = self._bucket(chunk)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :chunk] = req.prompt[
+            slot.draft_pos:slot.draft_pos + chunk]
+        cache = dict(slot.draft_cache)
+        cache["length"] = jnp.asarray(slot.draft_pos, jnp.int32)
+        cache = dict(self._draft_prefill(
+            self._draft_params, jnp.asarray(padded), cache))
+        slot.draft_pos += chunk
+        slot.draft_len = slot.draft_pos
+        # forward_step advanced length by the PADDED width; pin it to
+        # the real cursor so the next chunk overwrites the pad garbage
+        cache["length"] = jnp.asarray(slot.draft_pos, jnp.int32)
+        slot.draft_cache = cache
 
     def _reap_cancelled(self) -> None:
         """Free slots whose request was cancelled — runs on the loop
@@ -775,43 +946,222 @@ class DecodeEngine:
                     slot.request, "cancelled",
                     RequestCancelled("request cancelled"))
 
-    def _prepare_decode(self) -> None:
+    def _prepare_decode(self, skip=frozenset()) -> None:
         """Host pre-pass before the jitted step: every decoding slot's
         next write position must land in an allocated, privately-owned
         block — grow tables across block boundaries, copy-on-write any
         block another holder shares (pool.needs_copy; shared blocks
-        come from fork_table, e.g. speculative decoding)."""
+        come from fork_table, e.g. speculative decoding).  Slots in
+        `skip` already advanced speculatively this iteration and take
+        no plain decode write."""
         for slot_id, slot in enumerate(self._slots):
-            if slot is None or not slot.decoding:
+            if slot is None or not slot.decoding or slot_id in skip:
                 continue
             j = slot.length // self.ec.block_size
             if j >= len(slot.table):
                 self._grow_table(slot_id, slot, 1)
                 continue      # preempt handled inside; mask re-reads
-            if self.pool.needs_copy(slot.table[j]):
-                fresh = self._alloc_blocks(slot_id, 1)
-                if fresh is None:
-                    continue
-                self._kp, self._vp = self._copy_block(
-                    self._kp, self._vp, slot.table[j], fresh[0])
-                self.pool.release([slot.table[j]])
-                slot.table[j] = fresh[0]
+            self._cow_block(slot_id, slot, j)
+
+    # -- speculative decoding ---------------------------------------------
+    def _spec_width(self, slot: _Slot) -> int:
+        """Verify width for a slot: pending token + proposals, capped
+        so the emitted tokens can never overshoot max_new_tokens or
+        the per-request KV capacity."""
+        return min(self._spec.k + 1, slot.remaining,
+                   self._capacity_tokens - slot.length)
+
+    def _spec_eligible(self, slot: _Slot) -> bool:
+        """Spec rounds run for greedy decoding slots only: sampled
+        requests and verify-faulted (degraded) requests take the plain
+        batched step, and a width under 2 means there is nothing left
+        worth speculating on."""
+        return (slot.decoding and not slot.spec_off
+                and slot.draft_cache is not None
+                and slot.request.temperature <= 0.0
+                and self._spec_width(slot) >= 2)
+
+    def _spec_pass(self) -> set:
+        """One draft/verify round for every eligible slot; returns the
+        slot ids that advanced speculatively (they skip the plain
+        decode step this iteration).  Serve-side goodput books the
+        round's wall time into busy lanes vs slot_idle, exactly like a
+        plain decode step."""
+        done: set = set()
+        spec_ids = [i for i, s in enumerate(self._slots)
+                    if s is not None and self._spec_eligible(s)]
+        if not spec_ids:
+            return done
+        t_spec = time.perf_counter()
+        for slot_id in spec_ids:
+            slot = self._slots[slot_id]
+            if slot is None or not slot.decoding:
+                continue   # preempted/finished by an earlier lane
+            if self._spec_step(slot_id, slot):
+                done.add(slot_id)
+        if done and _telemetry_state.enabled:
+            dt = time.perf_counter() - t_spec
+            busy = dt * len(done) / self.ec.slots
+            self._ledger.attribute(goodput.BUCKET_STEP_COMPUTE, busy)
+            self._ledger.attribute(goodput.BUCKET_SLOT_IDLE, dt - busy)
+            self._ledger.tick()
+        return done
+
+    def _draft_propose(self, slot: _Slot, n: int) -> List[int]:
+        """Catch the draft cache up to the slot's cursor ([1,1] replay
+        of already-emitted tokens — at most the bonus token in steady
+        state), then run the fused k-step draft program.  Returns the
+        first n proposals; the host syncs ONCE, after dispatch."""
+        req = slot.request
+        cache = slot.draft_cache
+        while slot.draft_len < slot.length:
+            tok = req.tokens[slot.draft_len - slot.true_len]
+            _, cache = self._draft_step(
+                self._draft_params, jnp.asarray([[tok]], jnp.int32),
+                cache)
+            slot.draft_len += 1
+        toks, cache = self._draft_propose_k(
+            self._draft_params,
+            jnp.asarray(req.tokens[-1], jnp.int32), cache)
+        slot.draft_len += self._spec.k
+        slot.draft_cache = dict(cache)
+        return [int(t) for t in np.asarray(toks)[:n]]
+
+    def _spec_step(self, slot_id: int, slot: _Slot) -> bool:
+        """One draft/verify round for a slot: k fused draft forwards
+        plus ONE jitted target verify over the paged pool, emitting
+        1..k+1 tokens.  Greedy output is bit-identical to plain decode
+        — the longest proposal prefix matching the target's greedy
+        tokens is accepted and the target's own token at the first
+        mismatch (or the bonus token) is always emitted.  Rejected
+        positions rewind the write cursor (stale K/V past it is masked
+        and overwritten before it can be attended) and speculation-only
+        blocks go back to the pool; any shared block the verify would
+        write is copy-on-write'd first.  Returns True when the slot
+        advanced (or finished)."""
+        req = slot.request
+        bs = self.ec.block_size
+        length = slot.length
+        W = self._spec_width(slot)
+        try:
+            fire_verify_seam(req.request_id, W)
+        except FaultInjected:
+            # degrade THIS request to plain decode; nothing was written
+            # and the draft cache can never be read again — release it
+            slot.spec_off = True
+            slot.draft_cache = None
+            return False
+        try:
+            # the verify writes positions [length, length+W): cover
+            # them, and COW any block another holder shares (fork_table
+            # forks / shared prefix blocks) before appending into it
+            covered = kvcache.blocks_for(length + W, bs)
+            if len(slot.table) < covered:
+                if not self._grow_table(slot_id, slot,
+                                        covered - len(slot.table)):
+                    return False   # preempted itself; re-admits later
+            for j in range(length // bs, (length + W - 1) // bs + 1):
+                if not self._cow_block(slot_id, slot, j):
+                    return False   # preempted itself; re-admits later
+            with telemetry.trace_context(req.traceparent):
+                with telemetry.span("serve.spec.verify",
+                                    request=req.request_id,
+                                    slot=slot_id, width=W):
+                    proposals = self._draft_propose(slot, W - 1)
+                    padded = np.zeros((1, self._spec.k + 1), np.int32)
+                    padded[0, 0] = req.tokens[-1]
+                    padded[0, 1:W] = proposals
+                    self._kp, self._vp, target = self._verify(
+                        self.params, self._kp, self._vp,
+                        jnp.asarray(self._tables_np[slot_id]),
+                        jnp.asarray(padded),
+                        jnp.asarray(length, jnp.int32))
+            target = np.asarray(target)
+            accepted = 0
+            while accepted < W - 1 \
+                    and int(target[accepted]) == proposals[accepted]:
+                accepted += 1
+            emitted = proposals[:accepted] + [int(target[accepted])]
+            req.spec_steps += 1
+            req.draft_tokens += W - 1
+            req.accepted_tokens += accepted
+            ti.SERVE_SPEC_STEPS.inc()
+            ti.SERVE_SPEC_DRAFT_TOKENS.inc(W - 1)
+            if accepted:
+                ti.SERVE_SPEC_ACCEPTED_TOKENS.inc(accepted)
+            if _telemetry_state.enabled:
+                self._spec_draft_total += W - 1
+                self._spec_accepted_total += accepted
+                self._spec_emitted_total += len(emitted)
+                self._spec_verifies += 1
+                ti.SERVE_SPEC_ACCEPTANCE.set(
+                    self._spec_accepted_total / self._spec_draft_total)
+                ti.SERVE_SPEC_TOKENS_PER_VERIFY.set(
+                    self._spec_emitted_total / self._spec_verifies)
+            eos = req.eos_id
+            hit_eos = False
+            kept: List[int] = []
+            for tok in emitted:
+                kept.append(tok)
+                if eos is not None and tok == eos:
+                    hit_eos = True
+                    break
+            req.tokens.extend(kept)
+            ti.SERVE_TOKENS.inc(len(kept))
+            new_length = length + accepted + 1
+            slot.length = new_length
+            slot.remaining -= len(kept)
+            if hit_eos or slot.remaining <= 0 \
+                    or new_length + 1 >= self._capacity_tokens:
+                self._release_slot(slot_id)
+                self._finish_request(req, "ok")
+                return True
+            self._tokens = self._tokens.at[slot_id].set(kept[-1])
+            self._lengths = self._lengths.at[slot_id].set(new_length)
+            # rewind the draft behind any rejected positions; a full
+            # acceptance leaves it one token (the bonus) behind and the
+            # next round's catch-up replays it
+            if slot.draft_len > new_length:
+                slot.draft_len = new_length
+                slot.draft_cache["length"] = jnp.asarray(
+                    new_length, jnp.int32)
+            # free speculation-only blocks past the accepted cursor
+            # (one block of headroom stays for the pending token)
+            needed = kvcache.blocks_for(new_length + 1, bs)
+            if len(slot.table) > needed:
+                extra = slot.table[needed:]
+                del slot.table[needed:]
+                self.pool.release(extra)
                 self._sync_table(slot_id)
+            return True
+        except Exception as e:   # surface per-request failures
+            self._release_slot(slot_id)
+            self._finish_request(req, "error", e)
+            return False
 
     def _step(self) -> None:
-        self._prepare_decode()
-        decoding = [s is not None and s.decoding for s in self._slots]
+        spec_done: set = frozenset()
+        if self._spec is not None:
+            spec_done = self._spec_pass()
+        self._prepare_decode(skip=spec_done)
+        decoding = [s is not None and s.decoding and i not in spec_done
+                    for i, s in enumerate(self._slots)]
         n_active = sum(decoding)
+        # spec slots whose request finished inside the round are free
+        # lanes now — count only the ones still occupied as active
+        n_spec = sum(1 for i in spec_done
+                     if self._slots[i] is not None)
+        ti.SERVE_ACTIVE_SLOTS.set(n_active + n_spec)
         if n_active == 0:
             return
         seams.fire("serve.decode_step", active=n_active)
-        ti.SERVE_ACTIVE_SLOTS.set(n_active)
         t_step = time.perf_counter()
         with telemetry.span("serve.decode_step", active=n_active):
             active_mask = np.array(decoding, np.bool_)
             temps = np.array(
-                [s.request.temperature if s is not None and s.decoding
-                 else 0.0 for s in self._slots], np.float32)
+                [s.request.temperature
+                 if s is not None and decoding[i] else 0.0
+                 for i, s in enumerate(self._slots)], np.float32)
             self._rng, step_rng = jax.random.split(self._rng)
             nxt, self._kp, self._vp, self._lengths = self._decode(
                 self.params, self._tokens, self._kp, self._vp,

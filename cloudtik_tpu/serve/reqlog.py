@@ -11,6 +11,7 @@ appends one record per completed request:
      bucket, prompt_tokens, output_tokens,
      kv_blocks, prefix_blocks, prefix_tokens, prefill_chunks,
      preemptions                                  (paged KV cache),
+     draft_tokens, accepted_tokens, spec_steps    (speculative decode),
      arrival_ts/admitted_ts/first_token_ts/done_ts           (epoch),
      arrival_mono/admitted_mono/first_token_mono/done_mono   (monotonic),
      queue_wait_s, ttft_s, tpot_s}
@@ -55,6 +56,7 @@ RECORD_FIELDS = (
     "request_id", "finish", "bucket", "prompt_tokens", "output_tokens",
     "kv_blocks", "prefix_blocks", "prefix_tokens", "prefill_chunks",
     "preemptions",
+    "draft_tokens", "accepted_tokens", "spec_steps",
     "arrival_ts", "admitted_ts", "first_token_ts", "done_ts",
     "arrival_mono", "admitted_mono", "first_token_mono", "done_mono",
     "queue_wait_s", "ttft_s", "tpot_s",
@@ -135,6 +137,10 @@ def record(req, finish: str) -> None:
         "prefix_tokens": getattr(req, "prefix_tokens", None),
         "prefill_chunks": getattr(req, "prefill_chunks", None),
         "preemptions": getattr(req, "preemptions", None),
+        # speculative decoding (EngineConfig.spec draft/verify loop)
+        "draft_tokens": getattr(req, "draft_tokens", None),
+        "accepted_tokens": getattr(req, "accepted_tokens", None),
+        "spec_steps": getattr(req, "spec_steps", None),
         "arrival_ts": req.created,
         "admitted_ts": req.admitted,
         "first_token_ts": req.first_token_time,
@@ -239,8 +245,18 @@ def compute_stats(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     # how many chunks prefill took, and how much preemption churn the
     # population survived (zeros when the records predate the fields)
     for field in ("prompt_tokens", "prefix_tokens", "prefill_chunks",
-                  "preemptions"):
+                  "preemptions", "draft_tokens", "accepted_tokens",
+                  "spec_steps"):
         stats[field] = sum(
             rec[field] for rec in records
             if isinstance(rec.get(field), (int, float)))
+    # speculative decoding: how often the draft's proposals survived
+    # the target verify, and how many tokens each verify round emitted
+    # (accepted + the target's mismatch/bonus token)
+    draft = stats["draft_tokens"]
+    steps = stats["spec_steps"]
+    stats["spec_acceptance_rate"] = \
+        stats["accepted_tokens"] / draft if draft else None
+    stats["spec_tokens_per_verify"] = \
+        (stats["accepted_tokens"] + steps) / steps if steps else None
     return stats

@@ -113,32 +113,54 @@ def engine_backend(model: str = "tiny",
                    slots: int = 4, max_len: int = 512,
                    block_size: int = 16,
                    num_blocks: Optional[int] = None,
+                   spec_model: Optional[str] = None,
+                   spec_checkpoint_dir: Optional[str] = None,
+                   spec_k: int = 4,
                    **config_overrides) -> ModelBackend:
     """Continuous-batching generation endpoint (serve/engine.py).
 
     Each HTTP request submits ONE prompt to the shared DecodeEngine and
     blocks on its result; the ThreadingHTTPServer's concurrency is what
     fills the engine's decode slots — concurrent requests share decode
-    steps instead of queueing behind each other."""
+    steps instead of queueing behind each other.  `spec_model` enables
+    draft-model speculative decoding: the named preset (restored from
+    `spec_checkpoint_dir` when given) proposes `spec_k` greedy tokens
+    per round and ONE target verify accepts the matching prefix —
+    greedy output stays bit-identical to non-speculative decode."""
     import jax
 
     from cloudtik_tpu.models import transformer as T
     from cloudtik_tpu.serve.engine import (
-        DecodeEngine, EngineConfig, Request, RequestRejected)
+        DecodeEngine, EngineConfig, Request, RequestRejected,
+        SpecConfig)
+
+    def _restore(params, directory):
+        from cloudtik_tpu.train.checkpoint import (
+            CheckpointConfig, Checkpointer)
+        ckpt = Checkpointer(CheckpointConfig(directory=directory))
+        params = ckpt.restore({"params": params},
+                              partial=True)["params"]
+        ckpt.close()
+        return params
 
     cfg = T.config(model, **config_overrides)
     params = T.init_params(jax.random.PRNGKey(0), cfg)
     if checkpoint_dir:
-        from cloudtik_tpu.train.checkpoint import (
-            CheckpointConfig, Checkpointer)
-        ckpt = Checkpointer(CheckpointConfig(directory=checkpoint_dir))
-        params = ckpt.restore({"params": params},
-                              partial=True)["params"]
-        ckpt.close()
+        params = _restore(params, checkpoint_dir)
+    draft = None
+    spec = None
+    if spec_model:
+        draft_cfg = T.config(spec_model, **config_overrides)
+        draft_params = T.init_params(jax.random.PRNGKey(0), draft_cfg)
+        if spec_checkpoint_dir:
+            draft_params = _restore(draft_params, spec_checkpoint_dir)
+        draft = (draft_params, draft_cfg)
+        spec = SpecConfig(k=spec_k)
     engine = DecodeEngine(
         params, cfg, EngineConfig(slots=slots, max_len=max_len,
                                   block_size=block_size,
-                                  num_blocks=num_blocks))
+                                  num_blocks=num_blocks, spec=spec),
+        draft=draft)
     engine.start()
 
     def generate(payload: Dict[str, Any]):
@@ -325,6 +347,14 @@ def main(argv=None) -> int:
     p.add_argument("--num-blocks", type=int, default=None,
                    help="KV pool size in blocks (engine mode; default "
                         "fully provisions slots x max_len)")
+    p.add_argument("--spec-model", default=None,
+                   help="draft-model preset for speculative decoding "
+                        "(engine mode; greedy output stays "
+                        "bit-identical to non-speculative decode)")
+    p.add_argument("--spec-checkpoint-dir", default=None,
+                   help="checkpoint dir the draft model restores from")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="draft tokens proposed per verify round")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8200)
     args = p.parse_args(argv)
@@ -350,7 +380,10 @@ def main(argv=None) -> int:
         backends.append(engine_backend(
             args.model, checkpoint_dir=args.checkpoint_dir,
             slots=args.slots, max_len=args.max_len,
-            block_size=args.block_size, num_blocks=args.num_blocks))
+            block_size=args.block_size, num_blocks=args.num_blocks,
+            spec_model=args.spec_model,
+            spec_checkpoint_dir=args.spec_checkpoint_dir,
+            spec_k=args.spec_k))
     else:
         backends.append(transformer_backend(
             args.model, checkpoint_dir=args.checkpoint_dir))

@@ -100,8 +100,25 @@ class GoodputLedger:
                              f"taxonomy: {BUCKETS}")
         seconds = max(float(seconds), 0.0)
         with self._lock:
+            now = time.monotonic()
             if self._start is None:
-                self._start = time.monotonic()
+                # the first attribution defines the window: the work it
+                # measures just finished, so the wall anchors at that
+                # work's START — anchoring at `now` would make the
+                # clamp below zero out the duration (e.g. a checkpoint
+                # restore attributed before fit() calls start_job)
+                self._start = now - seconds
+            # a wall-time accountant may never book more than the wall
+            # that actually elapsed: concurrent attributors (the orbax
+            # async-save thread compiling while the step loop books its
+            # own segments, the jax.monitoring compile listener firing
+            # from any thread) would otherwise double-book the same
+            # second and push sum(buckets) past wall — first booked
+            # wins, the overlap is dropped, and the sum-to-wall
+            # invariant holds by construction instead of by hope
+            wall = max(now - self._start, 0.0)
+            attributed = sum(self._totals.values())
+            seconds = min(seconds, max(wall - attributed, 0.0))
             self._totals[bucket] = self._totals.get(bucket, 0.0) + seconds
         ti.GOODPUT_SECONDS.inc(seconds, bucket=bucket, job=self.job)
 

@@ -73,6 +73,16 @@ SERVE_PREFILL_CHUNKS: Counter = _build("tik_serve_prefill_chunks_total")
 SERVE_PREFILL_PENDING: Gauge = _build("tik_serve_prefill_pending_tokens")
 SERVE_PREEMPTIONS: Counter = _build("tik_serve_preemptions_total")
 
+# serve speculative decoding (EngineConfig.spec draft/verify loop)
+SERVE_SPEC_DRAFT_TOKENS: Counter = _build(
+    "tik_serve_spec_draft_tokens_total")
+SERVE_SPEC_ACCEPTED_TOKENS: Counter = _build(
+    "tik_serve_spec_accepted_tokens_total")
+SERVE_SPEC_STEPS: Counter = _build("tik_serve_spec_verify_steps_total")
+SERVE_SPEC_ACCEPTANCE: Gauge = _build("tik_serve_spec_acceptance_rate")
+SERVE_SPEC_TOKENS_PER_VERIFY: Gauge = _build(
+    "tik_serve_spec_tokens_per_verify")
+
 # goodput ledger / step profiler
 GOODPUT_SECONDS: Counter = _build("tik_goodput_seconds_total")
 GOODPUT_WALL: Gauge = _build("tik_goodput_wall_seconds")

@@ -146,6 +146,21 @@ _ALL = [
     _m("tik_serve_preemptions_total", "counter",
        "Requests preempted and requeued because the KV pool ran out "
        "of blocks.", "serve"),
+    # -- serve speculative decoding (EngineConfig.spec) ------------------
+    _m("tik_serve_spec_draft_tokens_total", "counter",
+       "Draft-model tokens proposed and verified by speculative "
+       "decoding.", "serve"),
+    _m("tik_serve_spec_accepted_tokens_total", "counter",
+       "Draft tokens the target verify accepted.", "serve"),
+    _m("tik_serve_spec_verify_steps_total", "counter",
+       "Speculative draft/verify rounds the decode engine ran.",
+       "serve"),
+    _m("tik_serve_spec_acceptance_rate", "gauge",
+       "Cumulative accepted/draft token ratio of speculative decoding "
+       "(the SpecAcceptanceLow alert watches it).", "serve"),
+    _m("tik_serve_spec_tokens_per_verify", "gauge",
+       "Mean tokens emitted per target verify step (accepted + 1; "
+       "upper bound spec.k + 1).", "serve"),
     # -- goodput ledger / step profiler ----------------------------------
     _m("tik_goodput_seconds_total", "counter",
        "Job wall time attributed to a goodput bucket "
@@ -302,6 +317,7 @@ SPANS: Dict[str, str] = {
     "discovery.render":       "registry -> targets/dns render pass",
     "serve.enqueue":          "request submit -> queued",
     "serve.prefill":          "one prompt prefill chunk against the paged pool",
+    "serve.spec.verify":      "one speculative draft/verify round for a slot",
     "serve.decode_step":      "one engine decode step over all slots",
     "serve.decode":           "per-request decode window (first->last token)",
     "train.window":           "one log_every window of training steps",

@@ -177,6 +177,7 @@ class Checkpointer:
                 f"no checkpoint found under {self.config.directory}")
         abstract = jax.tree.map(_as_abstract, state_like)
         t0 = time.perf_counter()
+        compile_marker = goodput.LEDGER.total(goodput.BUCKET_COMPILE)
         with telemetry.span("checkpoint.restore", step=step,
                             partial=partial):
             if partial:
@@ -189,7 +190,15 @@ class Checkpointer:
                 )["state"]
         dt = time.perf_counter() - t0
         ti.CHECKPOINT_RESTORE_SECONDS.observe(dt)
-        goodput.attribute(goodput.BUCKET_CHECKPOINT_RESTORE, dt)
+        # restore compiles device programs (resharding/device_put); the
+        # stepprof listener already booked those seconds to the compile
+        # bucket, so book only the remainder here — the same
+        # double-count guard the save window applies, keeping the
+        # ledger's sum-to-wall invariant honest
+        compiled = max(goodput.LEDGER.total(goodput.BUCKET_COMPILE)
+                       - compile_marker, 0.0)
+        goodput.attribute(goodput.BUCKET_CHECKPOINT_RESTORE,
+                          max(dt - compiled, 0.0))
         return restored_state
 
     def _restore_partial(self, abstract: Any, step: int) -> Any:

@@ -13,7 +13,10 @@ backend, bounded iterations):
       recycle (no false-positive condemnation);
   (d) KV-pool exhaustion in the serving engine (injected at the
       `serve.kvcache.alloc` seam AND real) queues admissions and
-      preempts/requeues the newest request instead of crashing.
+      preempts/requeues the newest request instead of crashing;
+  (e) a fault at the speculative verify seam (`serve.spec.verify`)
+      degrades that request to non-speculative decode — output stays
+      bit-identical, no error — and later requests speculate again.
 """
 
 import itertools
@@ -312,3 +315,64 @@ def test_drill_kv_pool_exhaustion_queues_preempts_and_recovers(tmp_path):
     assert by_id[b.request_id]["preemptions"] >= 1
     assert by_id[b.request_id]["kv_blocks"] >= 1
     assert engine.pool.used() == 0        # no leak through the chaos
+
+
+@pytest.mark.chaos
+def test_drill_spec_verify_fault_degrades_to_plain_decode(tmp_path):
+    """Drill (e): a mid-stream `raise` at the `serve.spec.verify` seam
+    must downgrade THAT request to non-speculative decode — greedy
+    output stays bit-identical and the ledger books `done`, not
+    `error` — while later requests speculate again, and the pool ends
+    fully free."""
+    import jax
+    import numpy as np
+
+    from cloudtik_tpu.models import generate as G
+    from cloudtik_tpu.models import transformer as T
+    from cloudtik_tpu.serve import reqlog
+    from cloudtik_tpu.serve.engine import (
+        DecodeEngine, EngineConfig, Request, SpecConfig)
+
+    cfg = T.config("tiny", dtype=jax.numpy.float32,
+                   attention_impl="reference", remat=False)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    engine = DecodeEngine(
+        params, cfg,
+        EngineConfig(slots=2, max_len=64, prefill_buckets=(8, 16),
+                     block_size=8, spec=SpecConfig(k=3)),
+        draft=(params, cfg))
+    engine.start()
+    reqlog.install(str(tmp_path / "req.jsonl"))
+    try:
+        def reference(prompt, n):
+            out = G.generate(params,
+                             jax.numpy.asarray([prompt], np.int32),
+                             cfg, max_new_tokens=n)
+            return np.asarray(out)[0].tolist()
+
+        plan = FaultPlan([FaultPoint("serve.spec.verify", "raise",
+                                     times=1)], seed=5,
+                         name="spec-verify-drill")
+        prompt = [9, 8, 7, 6]
+        with seams.armed(plan):
+            faulted = engine.submit(Request(prompt, max_new_tokens=10))
+            out = faulted.wait(timeout=300)
+        assert plan.points[0].fired == 1
+        assert out == reference(prompt, 10)   # degraded, not wrong
+        assert faulted.error is None
+        assert faulted.spec_steps == 0        # no verify round landed
+        # the degrade latch is per-request: the next request speculates
+        healthy = engine.submit(Request([3, 1, 4, 1],
+                                        max_new_tokens=10))
+        assert healthy.wait(timeout=300) == reference([3, 1, 4, 1], 10)
+        assert healthy.spec_steps > 0
+        assert healthy.accepted_tokens == healthy.draft_tokens
+    finally:
+        reqlog.uninstall()
+        engine.stop()
+    by_id = {r["request_id"]: r for r in reqlog.read_requests(
+        str(tmp_path / "req.jsonl"))}
+    assert by_id[faulted.request_id]["finish"] == "done"
+    assert by_id[faulted.request_id]["spec_steps"] == 0
+    assert by_id[healthy.request_id]["spec_steps"] > 0
+    assert engine.pool.used() == 0            # speculation blocks back

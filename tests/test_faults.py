@@ -188,6 +188,11 @@ def test_seams_are_noops_without_a_plan(monkeypatch, tmp_path):
     pool = BlockPool(num_blocks=4, block_size=8)
     pool.release(pool.alloc(2))
 
+    # speculative verify seam (serve.spec.verify) — the exact helper
+    # the engine's spec step calls before every draft/verify round
+    from cloudtik_tpu.serve.engine import fire_verify_seam
+    fire_verify_seam(1, 4)
+
     # prefetcher consumer hand-off (train.prefetch.next)
     from cloudtik_tpu.train.prefetch import Prefetcher
     pf = Prefetcher(iter([{"x": 1}]), sharding=None)
@@ -225,6 +230,19 @@ def test_seams_are_noops_without_a_plan(monkeypatch, tmp_path):
         assert provider.mock_nodes()
     finally:
         scaler.shutdown()
+
+
+def test_spec_verify_seam_fires_and_matches_context():
+    """An armed raise at serve.spec.verify reaches the caller (the
+    engine catches it and degrades that request to plain decode)."""
+    from cloudtik_tpu.serve.engine import fire_verify_seam
+    plan = FaultPlan([FaultPoint("serve.spec.verify", "raise", times=1,
+                                 match={"width": 4})])
+    with seams.armed(plan):
+        fire_verify_seam(7, 2)              # width mismatch: no fire
+        with pytest.raises(FaultInjected):
+            fire_verify_seam(7, 4)
+    assert plan.points[0].fired == 1
 
 
 def test_seam_fires_exactly_once_per_operation():

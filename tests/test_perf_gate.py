@@ -180,6 +180,41 @@ class TestBenchProbeDiagnostics:
         assert diagnostics["jax_platforms"] == "cpu"
 
 
+class TestSpecTrajectoryIsolation:
+    """Speculative-decoding serving records (serving_bench.py --spec)
+    carry mode="spec" and form their own trajectory — enabling spec
+    must never poison the spec-off serving median."""
+
+    def test_gate_excludes_spec_from_spec_off_median(self, perf_gate,
+                                                     tmp_path):
+        _trajectory(tmp_path, [64.0, 60.0], metric="serving_rps_at_slo")
+        mislabeled = tmp_path / "BENCH_r09.json"
+        # a spec record mislabeled under the spec-off metric name must
+        # still be excluded from the spec-off median
+        mislabeled.write_text(json.dumps({"parsed": {
+            "metric": "serving_rps_at_slo", "value": 9000.0,
+            "mode": "spec"}}))
+        paths = [str(p) for p in tmp_path.glob("BENCH_*.json")]
+        history = perf_gate.load_history(paths,
+                                         metric="serving_rps_at_slo")
+        assert sorted(v for _p, v in history) == [60.0, 64.0]
+
+    def test_spec_metric_forms_its_own_trajectory(self, perf_gate,
+                                                  tmp_path):
+        record = {"parsed": {"metric": "serving_rps_at_slo_spec",
+                             "value": 16.0, "mode": "spec"}}
+        (tmp_path / "BENCH_r09.json").write_text(json.dumps(record))
+        paths = [str(p) for p in tmp_path.glob("BENCH_*.json")]
+        history = perf_gate.load_history(
+            paths, metric="serving_rps_at_slo_spec")
+        assert [v for _p, v in history] == [16.0]
+        code, report = perf_gate.gate(
+            {"metric": "serving_rps_at_slo_spec", "value": 15.5,
+             "mode": "spec"}, history, 10.0)
+        assert code == 0
+        assert report["mode"] == "spec"
+
+
 class TestCpuDryrunFallback:
     """Open item 3 first step: a probe failure must never record 0.0
     again — bench.py falls back to a labeled CPU-dryrun measurement,

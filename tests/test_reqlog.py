@@ -293,3 +293,37 @@ class TestServeRequestsCLI:
         assert result.exit_code == 0, result.output
         assert "availability" in result.output
         assert "ttft" in result.output
+        # no speculative traffic: no spec line
+        assert "acceptance" not in result.output
+
+    def test_stats_aggregate_speculative_columns(self, tmp_path):
+        """`--stats` derives acceptance rate (accepted/draft) and mean
+        tokens-per-verify from the ledger's spec fields."""
+        from click.testing import CliRunner
+
+        from cloudtik_tpu.scripts.cli import cli
+        path = str(tmp_path / "req.jsonl")
+        reqlog.install(path)
+        for i, (draft, accepted, steps) in enumerate(
+                [(8, 6, 2), (4, 2, 2)]):
+            req = _fake_request(i)
+            req.draft_tokens = draft
+            req.accepted_tokens = accepted
+            req.spec_steps = steps
+            reqlog.record(req, reqlog.FINISH_DONE)
+        reqlog.uninstall()
+        result = CliRunner().invoke(
+            cli, ["serve", "requests", "--path", path, "--stats",
+                  "--json"])
+        assert result.exit_code == 0, result.output
+        stats = json.loads(result.output)
+        assert stats["draft_tokens"] == 12
+        assert stats["accepted_tokens"] == 8
+        assert stats["spec_steps"] == 4
+        assert stats["spec_acceptance_rate"] == pytest.approx(8 / 12)
+        assert stats["spec_tokens_per_verify"] == pytest.approx(3.0)
+        result = CliRunner().invoke(
+            cli, ["serve", "requests", "--path", path, "--stats"])
+        assert result.exit_code == 0, result.output
+        assert "acceptance 66.7%" in result.output
+        assert "tokens/verify 3.00" in result.output

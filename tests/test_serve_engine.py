@@ -307,6 +307,264 @@ class TestPagedCache:
         assert during_chunked > 2 * during_unchunked
 
 
+@pytest.fixture(scope="module")
+def spec_setup():
+    """Spec engine with the target as its own draft: greedy acceptance
+    is 1.0 by construction, so every speculative path (draft prefill,
+    fused propose, verify, full-accept catch-up) runs on every
+    request."""
+    from cloudtik_tpu.serve.engine import SpecConfig
+    cfg = T.config("tiny", dtype=jax.numpy.float32,
+                   attention_impl="reference", remat=False)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    engine = DecodeEngine(
+        params, cfg,
+        EngineConfig(slots=2, max_len=96, prefill_buckets=(8, 16, 32),
+                     block_size=8, spec=SpecConfig(k=3)),
+        draft=(params, cfg))
+    engine.start()
+    yield cfg, params, engine
+    engine.stop()
+
+
+class TestSpeculative:
+    """Draft-model speculative decoding: greedy output must be
+    BIT-IDENTICAL to non-speculative decode — with an agreeing draft
+    (every proposal accepted), a disagreeing draft (every proposal
+    rejected, the rewind path), and across chunked prefill and
+    prefix-reused prompts — and the pool invariant must hold."""
+
+    def test_self_draft_bit_identical_and_fully_accepted(self,
+                                                         spec_setup):
+        cfg, params, engine = spec_setup
+        prompt = [5, 17, 101, 9]
+        req = engine.submit(Request(prompt, max_new_tokens=12))
+        assert req.wait(timeout=300) == _reference(params, cfg,
+                                                   prompt, 12)
+        assert req.spec_steps > 0
+        assert req.draft_tokens > 0
+        # the draft IS the target: every verified proposal accepted
+        assert req.accepted_tokens == req.draft_tokens
+
+    def test_multi_chunk_and_prefix_reuse_stay_bit_identical(
+            self, spec_setup):
+        """The equivalence bar over the paged engine's own features:
+        a prompt spanning several prefill chunks, then the same prompt
+        again (prefix-cache blocks reused) — spec decode on top of
+        both must still match the static reference exactly."""
+        cfg, params, engine = spec_setup
+        prompt = [((i * 37) % 250) + 1 for i in range(40)]
+        first = engine.submit(Request(prompt, max_new_tokens=10))
+        out = first.wait(timeout=300)
+        assert out == _reference(params, cfg, prompt, 10)
+        assert first.prefill_chunks == 2          # 40 tokens, chunk 32
+        assert first.spec_steps > 0
+        again = engine.submit(Request(prompt, max_new_tokens=10))
+        assert again.wait(timeout=300) == out
+        assert again.prefix_tokens > 0            # reused blocks
+        assert again.spec_steps > 0
+
+    def test_disagreeing_draft_rejects_and_stays_bit_identical(self):
+        """A draft with different weights proposes garbage: every
+        round rejects at the first position, the cursor rewinds, and
+        output is STILL bit-identical — the correctness of speculative
+        decoding must never depend on the draft being right."""
+        from cloudtik_tpu.serve.engine import SpecConfig
+        cfg = T.config("tiny", dtype=jax.numpy.float32,
+                       attention_impl="reference", remat=False)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        draft_params = T.init_params(jax.random.PRNGKey(7), cfg)
+        engine = DecodeEngine(
+            params, cfg,
+            EngineConfig(slots=2, max_len=96,
+                         prefill_buckets=(8, 16, 32), block_size=8,
+                         spec=SpecConfig(k=3)),
+            draft=(draft_params, cfg))
+        engine.start()
+        try:
+            prompt = [9, 8, 7, 6]
+            req = engine.submit(Request(prompt, max_new_tokens=16))
+            assert req.wait(timeout=300) == _reference(params, cfg,
+                                                       prompt, 16)
+            assert req.spec_steps > 0
+            assert req.accepted_tokens < req.draft_tokens
+        finally:
+            engine.stop()
+        # pool invariant: speculation blocks all came back
+        assert engine.pool.used() == 0
+        assert engine.pool.available() == engine.pool.usable_blocks
+
+    def test_eos_inside_accepted_window_stops_early(self, spec_setup):
+        cfg, params, engine = spec_setup
+        prompt = [5, 17, 101, 9]
+        full = _reference(params, cfg, prompt, 8)
+        eos = full[4]         # pretend the 5th generated token is EOS
+        if eos in full[:4]:
+            pytest.skip("random model repeated the chosen eos earlier")
+        got = engine.generate(prompt, max_new_tokens=8, eos_id=eos)
+        assert got == full[:5]
+
+    def test_temperature_request_bypasses_spec(self, spec_setup):
+        """Sampled requests take the plain decode step (speculative
+        greedy verify would change their distribution)."""
+        cfg, params, engine = spec_setup
+        req = engine.submit(Request([1, 2, 3], max_new_tokens=6,
+                                    temperature=0.9))
+        assert len(req.wait(timeout=300)) == 6
+        assert req.spec_steps == 0
+
+    def test_ledger_records_spec_fields_and_stats_aggregate(
+            self, spec_setup, tmp_path):
+        """Satellite: acceptance rate and tokens-per-verify flow from
+        the per-request ledger records into compute_stats (what
+        `tik serve requests --stats` prints)."""
+        from cloudtik_tpu.serve import reqlog
+        cfg, params, engine = spec_setup
+        path = str(tmp_path / "req.jsonl")
+        reqlog.install(path)
+        try:
+            req = engine.submit(Request([3, 1, 4, 1, 5],
+                                        max_new_tokens=12))
+            req.wait(timeout=300)
+        finally:
+            reqlog.uninstall()
+        records = reqlog.read_requests(path)
+        rec = [r for r in records
+               if r["request_id"] == req.request_id][0]
+        assert rec["spec_steps"] == req.spec_steps > 0
+        assert rec["draft_tokens"] == req.draft_tokens
+        assert rec["accepted_tokens"] == req.accepted_tokens
+        stats = reqlog.compute_stats(records)
+        assert stats["spec_acceptance_rate"] == 1.0    # self-draft
+        assert stats["spec_tokens_per_verify"] > 1.0
+        # the win is visible in the Prometheus exposition too
+        from cloudtik_tpu import telemetry
+        exposition = telemetry.render_prometheus()
+        assert "tik_serve_spec_acceptance_rate" in exposition
+        assert "tik_serve_spec_verify_steps_total" in exposition
+
+    def test_pool_fully_free_after_cancel_and_stop(self):
+        """Pool invariant under speculation: cancel mid-flight + drain
+        on stop — every block (speculation growth included) returns."""
+        from cloudtik_tpu.serve.engine import SpecConfig
+        cfg = T.config("tiny", dtype=jax.numpy.float32,
+                       attention_impl="reference", remat=False)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        engine = DecodeEngine(
+            params, cfg,
+            EngineConfig(slots=2, max_len=64, prefill_buckets=(8, 16),
+                         block_size=8, spec=SpecConfig(k=3)),
+            draft=(params, cfg))
+        engine.start()
+        reqs = [engine.submit(Request([i + 1] * 6, max_new_tokens=40))
+                for i in range(4)]
+        for _ in range(200):
+            if reqs[0].tokens:
+                break
+            threading.Event().wait(0.01)
+        reqs[0].cancel()
+        reqs[3].cancel()
+        engine.stop()
+        for req in reqs:
+            assert req._done.is_set()
+        assert engine.pool.used() == 0
+        assert engine.pool.available() == engine.pool.usable_blocks
+
+    def test_spec_config_requires_draft(self):
+        from cloudtik_tpu.serve.engine import SpecConfig
+        cfg = T.config("tiny", dtype=jax.numpy.float32,
+                       attention_impl="reference", remat=False)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        with pytest.raises(ValueError, match="draft"):
+            DecodeEngine(params, cfg,
+                         EngineConfig(spec=SpecConfig(k=3)))
+
+
+class TestCowFork:
+    def test_fork_of_live_request_appends_through_both_forks(self):
+        """Engine-level COW regression (satellite): fork a LIVE
+        request's block table mid-decode — the speculative/beam sharing
+        shape — and append through BOTH forks.  The blocks that stay
+        shared must be bit-unchanged, exactly one side must copy the
+        shared tail block before writing (the other, left sole holder,
+        writes in place), both continuations must stay bit-identical
+        to the reference, and refcounts + the free list must reconcile
+        after both finish.
+
+        The engine is never started: the test thread drives the loop
+        phases itself, so it owns slot state."""
+        import time as _time
+
+        import numpy as np
+
+        from cloudtik_tpu.serve.engine import _Slot
+
+        cfg = T.config("tiny", dtype=jax.numpy.float32,
+                       attention_impl="reference", remat=False)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        engine = DecodeEngine(params, cfg, EngineConfig(
+            slots=2, max_len=32, prefill_buckets=(8,), block_size=4,
+            prefix_cache=False))
+        prompt = [5, 17, 101, 9, 33, 7, 2, 11]      # 2 full blocks
+        max_new = 10
+        ref = _reference(params, cfg, prompt, max_new)
+        a = Request(prompt, max_new_tokens=max_new)
+        engine.submit(a)
+        engine._admit()
+        slot = engine._slots[0]
+        assert slot is not None
+        for _ in range(10):
+            if slot.decoding:
+                break
+            engine._prefill_tick()
+        assert slot.decoding
+        for _ in range(2):
+            engine._step()
+        assert len(a.tokens) == 3 and slot.length == 10
+        shared = list(slot.table)                   # 3 blocks
+        full = shared[:2]                           # never written again
+        before_k = np.asarray(engine._kp[:, full])
+        before_v = np.asarray(engine._vp[:, full])
+        # fork: a second holder of every block continuing the SAME
+        # sequence from the same cursor
+        b = Request(prompt, max_new_tokens=max_new)
+        b.tokens = list(a.tokens)
+        b.admitted = _time.time()
+        b.admitted_mono = _time.monotonic()
+        fork = _Slot(request=b,
+                     table=engine.pool.fork_table(slot.table),
+                     true_len=len(prompt), prefill_pos=len(prompt),
+                     length=slot.length, remaining=slot.remaining,
+                     decoding=True)
+        engine._slots[1] = fork
+        engine._sync_table(1)
+        engine._lengths = engine._lengths.at[1].set(slot.length)
+        engine._tokens = engine._tokens.at[1].set(a.tokens[-1])
+        assert all(engine.pool.ref(blk) == 2 for blk in shared)
+        assert engine.pool.needs_copy(slot.table[2])
+        # one step: the first writer COWs the shared tail block, the
+        # second (now sole holder) writes it in place
+        engine._step()
+        assert slot.table[2] != fork.table[2]
+        assert engine.pool.ref(slot.table[2]) == 1
+        assert engine.pool.ref(fork.table[2]) == 1
+        for _ in range(30):
+            if a._done.is_set() and b._done.is_set():
+                break
+            engine._step()
+        # both forks decoded the SAME greedy continuation — and it is
+        # the single-request reference, so neither corrupted the other
+        assert a.tokens == ref
+        assert b.tokens == ref
+        # the blocks that stayed shared are bit-unchanged
+        assert np.array_equal(np.asarray(engine._kp[:, full]), before_k)
+        assert np.array_equal(np.asarray(engine._vp[:, full]), before_v)
+        # refcounts and the free list reconcile after both finished
+        assert engine.pool.used() == 0
+        assert engine.pool.available() == engine.pool.usable_blocks
+        assert all(engine.pool.ref(blk) == 0 for blk in shared)
+
+
 class TestEngineHTTP:
     def test_engine_backend_over_http(self, setup):
         """Concurrent HTTP posts ride the shared engine."""

@@ -127,6 +127,39 @@ class TestServingBench:
             "--fresh", "-",
             "--history", str(tmp_path / "BENCH_none_*.json")]) == 0
 
+    def test_spec_mode_emits_own_trajectory_with_acceptance(
+            self, serving, capsys, monkeypatch, tmp_path):
+        """`--spec` emits the serving_tpot_ms_spec line (with the
+        spec-off TPOT baseline in detail) and the flagship
+        serving_rps_at_slo_spec LAST, both mode="spec" so perf_gate
+        medians them as their own trajectories; the self-draft ledger
+        shows acceptance 1.0."""
+        rc = serving.main(["--spec", "--requests", "4", "--iters", "0",
+                           "--lo", "2", "--max-rate", "4",
+                           "--slo-ttft-p95", "2.0", "--spec-k", "3"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        lines = [l for l in out.splitlines()
+                 if l.strip().startswith("{")]
+        tpot = json.loads(lines[0])
+        assert tpot["metric"] == "serving_tpot_ms_spec"
+        assert tpot["mode"] == "spec"
+        assert tpot["value"] > 0
+        assert tpot["detail"]["baseline_tpot_ms_spec_off"] > 0
+        assert tpot["detail"]["spec_acceptance_rate"] == 1.0
+        assert tpot["detail"]["spec_tokens_per_verify"] > 1.0
+        flagship = json.loads(lines[-1])
+        assert flagship["metric"] == "serving_rps_at_slo_spec"
+        assert flagship["mode"] == "spec"
+        assert flagship["value"] > 0
+        assert "error" not in flagship
+        perf_gate = _load_path(REPO / "tools" / "perf_gate.py",
+                               "perf_gate_spec")
+        monkeypatch.setattr("sys.stdin", io.StringIO(lines[-1]))
+        assert perf_gate.main([
+            "--fresh", "-",
+            "--history", str(tmp_path / "BENCH_none_*.json")]) == 0
+
     def test_degraded_engine_lowers_rps_and_burns_slo(self, serving,
                                                       tmp_path,
                                                       monkeypatch):

@@ -80,6 +80,13 @@ def load_history(paths: List[str],
             # form their own trajectory; they must never feed a real
             # device metric's median even if mislabeled
             continue
+        if parsed.get("mode") == "spec" and \
+                "spec" not in str(metric or ""):
+            # speculative-decoding serving records
+            # (serving_bench.py --spec) form their own trajectory
+            # (serving_*_spec); they must never feed the spec-off
+            # serving median even if mislabeled
+            continue
         out.append((path, float(parsed["value"])))
     return out
 
@@ -107,8 +114,8 @@ def gate(fresh: Dict[str, Any], history: List[Tuple[str, float]],
     value = float(parsed["value"])
     floor = baseline * (1.0 - threshold_pct / 100.0)
     report.update(metric=parsed.get("metric"), value=value, floor=floor)
-    if parsed.get("mode") == "cpu_dryrun":
-        report["mode"] = "cpu_dryrun"   # labeled fallback measurement
+    if parsed.get("mode") in ("cpu_dryrun", "spec"):
+        report["mode"] = parsed["mode"]   # labeled own-trajectory mode
     if value < floor:
         drop = (baseline - value) / baseline * 100.0
         report.update(status="fail",
