@@ -229,6 +229,7 @@ SUITES = {
     "input_pipeline": "input_pipeline_bench.py",
     "telemetry_overhead": "telemetry_overhead.py",
     "serving": "serving_bench.py",
+    "elasticity": "elasticity_bench.py",
 }
 
 

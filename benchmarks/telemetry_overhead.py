@@ -66,6 +66,12 @@ def main() -> int:
         lambda: _prefetch._note_get(0.001, 2), n)
     disabled_prefetch_put_note_ns = _ns(
         lambda: _prefetch._note_put(0.001, 2), n)
+    # the elastic re-mesh instrumentation (slices gauge + remesh
+    # counter/histogram behind one gate) must be attribute checks when
+    # off — it sits on the step-boundary path of every elastic fit
+    from cloudtik_tpu.train import elastic as _elastic
+    disabled_elastic_note_ns = _ns(
+        lambda: _elastic._note_remesh("shrink", 0.01, 2), n)
     # the request ledger's per-request append must be attribute checks
     # when off (even with a journal installed)
     import types as _types
@@ -124,6 +130,8 @@ def main() -> int:
                 round(disabled_prefetch_put_note_ns, 1),
             "disabled_reqlog_record_ns":
                 round(disabled_reqlog_record_ns, 1),
+            "disabled_elastic_remesh_note_ns":
+                round(disabled_elastic_note_ns, 1),
             "enabled_span_ns": round(enabled_span_ns, 1),
             "enabled_counter_inc_ns": round(enabled_counter_ns, 1),
             "enabled_histogram_observe_ns":

@@ -22,8 +22,34 @@ from cloudtik_tpu import telemetry
 from cloudtik_tpu.faults import seams
 from cloudtik_tpu.telemetry import events
 from cloudtik_tpu.telemetry import instruments as ti
+from cloudtik_tpu.utils.retry import (
+    RetriesExhausted, RetryPolicy, call_with_retry)
 
 logger = logging.getLogger(__name__)
+
+# How a failed launch ask is retried IN the launcher thread before the
+# ask is surrendered back to the scaler's reconcile loop.  Exponential
+# backoff + jitter through the unified policy (utils/retry.py), so a
+# recycling slice that flaps (provider intermittently refusing the
+# create) cannot hot-loop the launcher — and every backoff sleep fires
+# the `utils.retry` seam, keeping the path drillable.
+
+
+def _launch_retryable(exc: BaseException) -> bool:
+    # provider/transport flaps are worth a backoff; programming or
+    # config errors (a bad node_type indexing the config) are not —
+    # they would fail identically on every attempt
+    return isinstance(exc, Exception) and not isinstance(
+        exc, (KeyError, TypeError, AttributeError))
+
+
+LAUNCH_RETRY_POLICY = RetryPolicy(
+    max_attempts=3, base_delay_s=1.0, multiplier=2.0,
+    max_delay_s=15.0, jitter=0.2, retryable=_launch_retryable)
+
+
+class _LauncherStopped(Exception):
+    """The launcher was stopped mid-backoff; abandon the retry."""
 
 
 class PendingLaunches:
@@ -71,6 +97,7 @@ class NodeLauncher(threading.Thread):
         launch_hashes: Dict[str, str],
         failure_callback=None,
         index: int = 0,
+        retry_policy: RetryPolicy = LAUNCH_RETRY_POLICY,
     ):
         super().__init__(name=f"tik-node-launcher-{index}", daemon=True)
         self.provider = provider
@@ -80,6 +107,7 @@ class NodeLauncher(threading.Thread):
         self.pending = pending
         self.launch_hashes = launch_hashes
         self.failure_callback = failure_callback
+        self.retry_policy = retry_policy
         self._stop = threading.Event()
 
     def stop(self) -> None:
@@ -99,11 +127,63 @@ class NodeLauncher(threading.Thread):
             traceparent = item[2] if len(item) > 2 else None
             try:
                 with telemetry.trace_context(traceparent):
-                    self.launch(node_type, count)
+                    self._launch_with_retry(node_type, count)
+            except _LauncherStopped:
+                pass
+            except RetriesExhausted as e:
+                logger.error("launch of %d x %s gave up after "
+                             "backoff retries: %s", count, node_type, e)
             except Exception:
                 logger.exception("launch of %d x %s failed", count, node_type)
             finally:
                 self.pending.dec(node_type, count)
+
+    def _launch_with_retry(self, node_type: str, count: int) -> None:
+        """One queue ask, retried under the unified backoff policy.
+
+        `launch_failed` asks are NOT immediately re-asked: each retry
+        backs off exponentially (with jitter) via `utils/retry.py`, so
+        a flapping provider cannot hot-loop this thread.  Partial group
+        successes reduce the retried count (the exception carries how
+        many nodes DID come up); `pending` stays held across the whole
+        retry so the scaler does not double-ask meanwhile.  Failure
+        accounting (metrics, `tik_node_launch_failed`, the availability
+        callback) runs ONCE per ask, on terminal failure, for the nodes
+        that never came up — not once per attempt, which would book a
+        3-attempt outage as 3x the failures launches must reconcile
+        against.  The sleep is stop-aware: `stop()` aborts a backoff
+        immediately.
+        """
+        remaining = [count]
+
+        def attempt() -> None:
+            try:
+                self.launch(node_type, remaining[0])
+            except BaseException as exc:
+                remaining[0] -= getattr(exc, "launched", 0)
+                if remaining[0] <= 0:
+                    return            # everything requested came up
+                raise
+
+        def sleep(delay: float) -> None:
+            if self._stop.wait(delay):
+                raise _LauncherStopped()
+
+        try:
+            call_with_retry(attempt, self.retry_policy, sleep=sleep)
+        except _LauncherStopped:
+            raise
+        except Exception as exc:
+            # Exception only: KeyboardInterrupt/SystemExit passing
+            # through are interruptions, not launch failures, and must
+            # not pollute the launches-vs-failures reconciliation
+            cause = exc.last if isinstance(exc, RetriesExhausted) \
+                else exc
+            self._record_launch_failure(node_type, remaining[0])
+            if isinstance(cause, NodeLaunchException) and \
+                    self.failure_callback:
+                self.failure_callback(node_type, remaining[0], cause)
+            raise
 
     def launch(self, node_type: str, count: int) -> None:
         node_types = self.config["available_node_types"]
@@ -144,26 +224,36 @@ class NodeLauncher(threading.Thread):
             events.emit("tik_node_launch", node_type=node_type,
                         count=launched)
         except NodeLaunchException as e:
-            self._record_launch_failure(node_type, count, launched)
+            self._credit_partial_launch(node_type, launched)
             logger.error("node launch failed (%s): %s", e.category,
                          e.description)
-            if self.failure_callback:
-                self.failure_callback(node_type, count, e)
+            e.launched = launched
             raise
-        except Exception:
-            self._record_launch_failure(node_type, count, launched)
+        except Exception as e:
+            self._credit_partial_launch(node_type, launched)
+            # the retry wrapper subtracts partial group successes so a
+            # retried ask never over-launches (best effort: some
+            # exception types refuse new attributes)
+            try:
+                e.launched = launched
+            except (AttributeError, TypeError):
+                pass
             raise
 
     @staticmethod
-    def _record_launch_failure(node_type: str, count: int,
-                               launched: int) -> None:
+    def _credit_partial_launch(node_type: str, launched: int) -> None:
         """launches + failures must reconcile against nodes that exist:
-        count what came up before the failure, fail only the rest."""
+        groups that DID come up before the failure still count."""
         if launched:
             ti.NODE_LAUNCHES.inc(launched, node_type=node_type)
             events.emit("tik_node_launch", node_type=node_type,
                         count=launched)
-        ti.NODE_LAUNCH_FAILURES.inc(max(count - launched, 1),
+
+    @staticmethod
+    def _record_launch_failure(node_type: str, failed: int) -> None:
+        """Terminal failure of one ask: the nodes that never came up
+        despite every retry."""
+        ti.NODE_LAUNCH_FAILURES.inc(max(failed, 1),
                                     node_type=node_type)
         events.emit("tik_node_launch_failed", node_type=node_type,
-                    count=max(count - launched, 1))
+                    count=max(failed, 1))

@@ -9,6 +9,7 @@ server tables instead of Redis.
 from __future__ import annotations
 
 import logging
+import os
 import socket
 import threading
 import time
@@ -79,10 +80,26 @@ class NodeAgent:
         heartbeat_period_s: float = TIK_HEARTBEAT_PERIOD_S,
         metrics_period_s: float = 5.0,
         total_resources: Optional[Dict[str, float]] = None,
+        slice_id: Optional[int] = None,
     ):
         self.state = state_client
         self.node_id = node_id
         self.node_ip = node_ip or _local_ip()
+        # which pod slice this host belongs to, as the DENSE index the
+        # elastic trainer meshes over (TIK_SLICE_INDEX exported by the
+        # launcher; explicit arg wins — NOT TIK_SLICE_ID, which is the
+        # provider's group-id string).  Stamped on every heartbeat so
+        # SliceMembership (control/membership.py) can judge slice
+        # liveness off the same state path.
+        if slice_id is None:
+            env = os.environ.get("TIK_SLICE_INDEX")
+            if env is not None:
+                try:
+                    slice_id = int(env)
+                except ValueError:
+                    logger.warning(
+                        "ignoring malformed TIK_SLICE_INDEX=%r", env)
+        self.slice_id = slice_id
         self.process_specs = process_specs or []
         self.heartbeat_period_s = heartbeat_period_s
         self.metrics_period_s = metrics_period_s
@@ -101,7 +118,6 @@ class NodeAgent:
         # psutil's per-sample cost matters on busy training hosts);
         # psutil remains the fallback when the build/start fails
         self._native_sampler = None
-        import os
         if os.environ.get("TIK_NATIVE_AGENT") == "1":
             try:
                 from cloudtik_tpu.native import NativeHostSampler
@@ -121,11 +137,14 @@ class NodeAgent:
         if seams.fire("node_agent.heartbeat", ip=self.node_ip,
                       node_id=self.node_id) == DIRECTIVE_DROP:
             return
-        self.state.table_put(TABLE_HEARTBEAT, self.node_id, {
+        record = {
             "node_id": self.node_id,
             "node_ip": self.node_ip,
             "time": time.time(),
-        })
+        }
+        if self.slice_id is not None:
+            record["slice_id"] = self.slice_id
+        self.state.table_put(TABLE_HEARTBEAT, self.node_id, record)
         ti.HEARTBEATS_PUBLISHED.inc()
 
     def publish_metrics_once(self) -> None:

@@ -26,6 +26,8 @@ Seam registry (keep docs/fault-injection.md in sync):
   serve.kvcache.alloc             KV block pool alloc   {need, free, evictable}  raise -> pool exhausted
   serve.spec.verify               speculative verify    {request, width}  raise -> request degrades to plain decode
   train.prefetch.next             prefetcher hand-off   {qsize}         latency -> data_wait
+  elastic.slice_lost              coordinator membership poll {slice, step}  drop -> slice treated as lost
+  elastic.remesh                  elastic re-mesh boundary {from_slices, to_slices, reason}  raise aborts the re-mesh
   serve.decode_step               DecodeEngine._step    {active}
   utils.retry                     every retry sleep     {fn, attempt}
 """

@@ -66,6 +66,42 @@ def auto_initialize(
     return True
 
 
+def slice_index(default: int = 0) -> int:
+    """Which pod slice this process belongs to, as a DENSE index in
+    ``[0, slice_count())``.
+
+    Precedence: the ``TIK_SLICE_INDEX`` env the launcher exports
+    (works on CPU simulations and containers alike) > the TPU
+    runtime's ``slice_index`` device attribute > ``default``.  (This
+    is deliberately NOT ``TIK_SLICE_ID`` — that env already carries
+    the provider's node-group id string, which is neither dense nor
+    stable across a recycle.)
+    """
+    env = os.environ.get("TIK_SLICE_INDEX")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            logger.warning("ignoring malformed TIK_SLICE_INDEX=%r", env)
+    idx = getattr(jax.local_devices()[0], "slice_index", None)
+    return int(idx) if idx is not None else default
+
+
+def slice_count(default: int = 1) -> int:
+    """How many pod slices the job spans (``TIK_NUM_SLICES`` env > the
+    distinct ``slice_index`` values of the global device set > default)."""
+    env = os.environ.get("TIK_NUM_SLICES")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            logger.warning("ignoring malformed TIK_NUM_SLICES=%r", env)
+    indices = {getattr(d, "slice_index", None) for d in jax.devices()}
+    if None not in indices and len(indices) > 1:
+        return len(indices)
+    return default
+
+
 def process_index() -> int:
     return jax.process_index()
 

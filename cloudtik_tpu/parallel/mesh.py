@@ -120,6 +120,81 @@ def _dcn_shape(shape: Tuple[int, ...], num_slices: int) -> Tuple[int, ...]:
     return (num_slices,) + (1,) * (len(shape) - 1)
 
 
+def slice_device_groups(
+    devices: Optional[Sequence[jax.Device]] = None,
+    num_slices: int = 1,
+) -> Dict[int, List[jax.Device]]:
+    """Partition devices into per-slice groups.
+
+    Real multislice TPU devices carry a ``slice_index`` attribute and
+    group by it; anything else (CPU hosts, single-slice TPU) splits
+    contiguously into ``num_slices`` equal groups — the simulated-slice
+    layout the elastic CPU drills run on.  Group ids are dense ints
+    starting at 0 either way.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    by_slice: Dict[int, List[jax.Device]] = {}
+    indices = {getattr(d, "slice_index", None) for d in devices}
+    if None not in indices and len(indices) > 1:
+        for d in devices:
+            by_slice.setdefault(int(d.slice_index), []).append(d)
+        return {i: by_slice[k] for i, k in enumerate(sorted(by_slice))}
+    if num_slices < 1 or len(devices) % num_slices != 0:
+        raise ValueError(
+            f"{len(devices)} devices not divisible into "
+            f"{num_slices} simulated slices")
+    per = len(devices) // num_slices
+    return {i: devices[i * per:(i + 1) * per] for i in range(num_slices)}
+
+
+def elastic_mesh_config(per_slice: MeshConfig, num_slices: int) -> MeshConfig:
+    """The K-slice mesh config derived from ONE slice's layout.
+
+    ``per_slice`` describes a single slice (its ``data`` axis must be
+    explicit, not -1: the fill axis has to be an intra-slice axis so
+    the per-slice shape is a constant while K varies).  The elastic
+    mesh multiplies the data axis by the number of live slices — the
+    data axis is the only axis that spans DCN, so shrinking or growing
+    K changes nothing inside a slice.
+    """
+    if per_slice.data == -1:
+        raise ValueError(
+            "elastic meshes need an explicit per-slice data axis "
+            "(data=-1 would change the intra-slice layout as K varies)")
+    if num_slices < 1:
+        raise ValueError(f"num_slices must be >= 1, got {num_slices}")
+    return dataclasses.replace(
+        per_slice, data=per_slice.data * num_slices,
+        num_slices=num_slices)
+
+
+def build_elastic_mesh(
+    per_slice: MeshConfig,
+    groups: Dict[int, Sequence[jax.Device]],
+    alive: Sequence[int],
+) -> Mesh:
+    """Mesh over the devices of the live slices only.
+
+    Devices are ordered slice-major (sorted slice id, then the group's
+    own order) so the outermost ``data`` axis maps slice-to-slice over
+    DCN and every intra-slice axis stays inside one slice's ICI.
+    """
+    alive = sorted(set(alive))
+    if not alive:
+        raise ValueError("cannot build a mesh over zero live slices")
+    missing = [s for s in alive if s not in groups]
+    if missing:
+        raise ValueError(f"unknown slice ids {missing}; "
+                         f"known: {sorted(groups)}")
+    sizes = {len(groups[s]) for s in alive}
+    if len(sizes) != 1:
+        raise ValueError(f"live slices differ in size: "
+                         f"{ {s: len(groups[s]) for s in alive} }")
+    devices = [d for s in alive for d in groups[s]]
+    return build_mesh(elastic_mesh_config(per_slice, len(alive)),
+                      devices=devices)
+
+
 def mesh_summary(mesh: Mesh) -> Dict[str, int]:
     return {a: int(s) for a, s in zip(mesh.axis_names, mesh.devices.shape)
             if s > 1}

@@ -906,8 +906,8 @@ def goodput_cmd(url, config_file, snapshot_file, job, as_json):
 
     Buckets (docs/observability.md "Goodput ledger"): step_compute,
     compile, data_wait, host_transfer, checkpoint_save,
-    checkpoint_restore, restart_replay, slot_idle, idle — summing to
-    total wall time."""
+    checkpoint_restore, restart_replay, elastic_remesh, slot_idle,
+    idle — summing to total wall time."""
     from cloudtik_tpu.telemetry import goodput as tgoodput
     if snapshot_file:
         with open(snapshot_file) as f:

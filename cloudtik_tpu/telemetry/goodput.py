@@ -48,6 +48,12 @@ BUCKET_HOST_TRANSFER = "host_transfer"
 BUCKET_CHECKPOINT_SAVE = "checkpoint_save"
 BUCKET_CHECKPOINT_RESTORE = "checkpoint_restore"
 BUCKET_RESTART_REPLAY = "restart_replay"
+# elastic re-mesh coordination: the step-loop pause while the trainer
+# re-meshes across slices (train/elastic.py), NET of the restore and
+# compile seconds booked to their own buckets.  First-class so the
+# recovered wall time of elasticity reads directly against what a
+# restart-everything job books as restart_replay.
+BUCKET_ELASTIC_REMESH = "elastic_remesh"
 BUCKET_SLOT_IDLE = "slot_idle"
 BUCKET_IDLE = "idle"
 
@@ -59,6 +65,7 @@ BUCKETS = (
     BUCKET_CHECKPOINT_SAVE,
     BUCKET_CHECKPOINT_RESTORE,
     BUCKET_RESTART_REPLAY,
+    BUCKET_ELASTIC_REMESH,
     BUCKET_SLOT_IDLE,
     BUCKET_IDLE,
 )

@@ -83,6 +83,11 @@ SERVE_SPEC_ACCEPTANCE: Gauge = _build("tik_serve_spec_acceptance_rate")
 SERVE_SPEC_TOKENS_PER_VERIFY: Gauge = _build(
     "tik_serve_spec_tokens_per_verify")
 
+# elastic multislice training (train/elastic.py re-mesh loop)
+ELASTIC_SLICES: Gauge = _build("tik_elastic_slices")
+ELASTIC_REMESHES: Counter = _build("tik_elastic_remesh_total")
+ELASTIC_REMESH_SECONDS: Histogram = _build("tik_elastic_remesh_seconds")
+
 # goodput ledger / step profiler
 GOODPUT_SECONDS: Counter = _build("tik_goodput_seconds_total")
 GOODPUT_WALL: Gauge = _build("tik_goodput_wall_seconds")

@@ -161,6 +161,18 @@ _ALL = [
     _m("tik_serve_spec_tokens_per_verify", "gauge",
        "Mean tokens emitted per target verify step (accepted + 1; "
        "upper bound spec.k + 1).", "serve"),
+    # -- elastic multislice training (train/elastic.py) ------------------
+    _m("tik_elastic_slices", "gauge",
+       "Data-parallel slices the elastic trainer is currently meshed "
+       "over.", "train"),
+    _m("tik_elastic_remesh_total", "counter",
+       "Elastic re-mesh transitions, by direction (shrink after a "
+       "slice loss, expand when capacity returns).", "train",
+       ("direction",)),
+    _m("tik_elastic_remesh_seconds", "histogram",
+       "Wall time of one elastic re-mesh (step-loop pause to resume: "
+       "checkpoint drain, mesh + sharding rebuild, state restore or "
+       "live reshard).", "train", (), SLOW_BUCKETS),
     # -- goodput ledger / step profiler ----------------------------------
     _m("tik_goodput_seconds_total", "counter",
        "Job wall time attributed to a goodput bucket "
@@ -283,6 +295,13 @@ _EVENT_LIST = [
     ("tik_train_resume",
      "a trainer resumed from a checkpoint; replay_until marks the "
      "last step already run before the restart (goodput replay)."),
+    ("tik_elastic_remesh",
+     "the elastic trainer re-meshed across slices, with its why "
+     "(reason=slice_lost|capacity_returned, from/to slice sets, the "
+     "step resumed from)."),
+    ("tik_checkpoint_wait_timeout",
+     "an async checkpoint wait/close hit its deadline with saves "
+     "still in flight (wedged save thread; teardown proceeded)."),
     ("tik_alert_fired",
      "an alert rule crossed into firing (collector alert engine)."),
     ("tik_alert_resolved",
@@ -321,4 +340,5 @@ SPANS: Dict[str, str] = {
     "serve.decode_step":      "one engine decode step over all slots",
     "serve.decode":           "per-request decode window (first->last token)",
     "train.window":           "one log_every window of training steps",
+    "train.remesh":           "one elastic re-mesh (pause -> resume)",
 }

@@ -46,6 +46,12 @@ class CheckpointConfig:
     # Keep one checkpoint every N steps forever (0 = disabled), on top of
     # the rolling max_to_keep window — for post-hoc eval sweeps.
     keep_period: int = 0
+    # Default deadline for wait()/close() (0 = block forever, the
+    # pre-elastic behavior).  A wedged async-save thread must never be
+    # able to hang elastic teardown or normal shutdown: past the
+    # deadline the wait gives up, journals tik_checkpoint_wait_timeout,
+    # and teardown proceeds without it.
+    wait_deadline_s: float = 0.0
 
 
 class Checkpointer:
@@ -144,9 +150,36 @@ class Checkpointer:
         logger.warning("torn-write fault: truncated %s (%d -> %d bytes)",
                        largest, largest_size, max(largest_size // 2, 1))
 
-    def wait(self) -> None:
-        """Block until all in-flight async saves are durable."""
-        self._manager.wait_until_finished()
+    def wait(self, deadline_s: Optional[float] = None) -> bool:
+        """Block until all in-flight async saves are durable.
+
+        ``deadline_s`` (falling back to the config's
+        ``wait_deadline_s``; 0/None = unbounded) caps the wait: orbax's
+        ``wait_until_finished`` takes no timeout of its own, so it runs
+        under :func:`utils.retry.run_with_deadline` and a wedged save
+        thread past the deadline journals a
+        ``tik_checkpoint_wait_timeout`` event instead of blocking
+        forever.  Returns True when all saves are durable, False on
+        deadline.
+        """
+        return self._bounded(self._manager.wait_until_finished,
+                             deadline_s, op="wait")
+
+    def _bounded(self, fn, deadline_s: Optional[float], op: str) -> bool:
+        from cloudtik_tpu.utils.retry import run_with_deadline
+        deadline_s = self.config.wait_deadline_s \
+            if deadline_s is None else deadline_s
+        finished, _result = run_with_deadline(
+            fn, deadline_s or 0.0, name=f"tik-checkpoint-{op}")
+        if not finished:
+            logger.warning(
+                "checkpoint %s still running after %.1fs deadline; "
+                "proceeding without it (wedged async save thread?)",
+                op, deadline_s)
+            events.emit("tik_checkpoint_wait_timeout", op=op,
+                        deadline_s=deadline_s,
+                        directory=self.config.directory)
+        return finished
 
     # -- restore -----------------------------------------------------------
     def latest_step(self) -> Optional[int]:
@@ -178,27 +211,36 @@ class Checkpointer:
         abstract = jax.tree.map(_as_abstract, state_like)
         t0 = time.perf_counter()
         compile_marker = goodput.LEDGER.total(goodput.BUCKET_COMPILE)
-        with telemetry.span("checkpoint.restore", step=step,
-                            partial=partial):
-            if partial:
-                restored_state = self._restore_partial(abstract, step)
-            else:
-                restored_state = self._manager.restore(
-                    step,
-                    args=self._ocp.args.Composite(
-                        state=self._ocp.args.StandardRestore(abstract)),
-                )["state"]
-        dt = time.perf_counter() - t0
-        ti.CHECKPOINT_RESTORE_SECONDS.observe(dt)
-        # restore compiles device programs (resharding/device_put); the
-        # stepprof listener already booked those seconds to the compile
-        # bucket, so book only the remainder here — the same
-        # double-count guard the save window applies, keeping the
-        # ledger's sum-to-wall invariant honest
-        compiled = max(goodput.LEDGER.total(goodput.BUCKET_COMPILE)
-                       - compile_marker, 0.0)
-        goodput.attribute(goodput.BUCKET_CHECKPOINT_RESTORE,
-                          max(dt - compiled, 0.0))
+        try:
+            with telemetry.span("checkpoint.restore", step=step,
+                                partial=partial):
+                if partial:
+                    restored_state = self._restore_partial(abstract,
+                                                           step)
+                else:
+                    restored_state = self._manager.restore(
+                        step,
+                        args=self._ocp.args.Composite(
+                            state=self._ocp.args.StandardRestore(
+                                abstract)),
+                    )["state"]
+        finally:
+            # booked in a finally so a FAILED attempt (a torn step
+            # restore_latest_good walks past) still lands here — its
+            # wall is restore work, not the caller's (the elastic
+            # re-mesh would otherwise absorb it into elastic_remesh)
+            dt = time.perf_counter() - t0
+            ti.CHECKPOINT_RESTORE_SECONDS.observe(dt)
+            # restore compiles device programs (resharding/
+            # device_put); the stepprof listener already booked those
+            # seconds to the compile bucket, so book only the
+            # remainder here — the same double-count guard the save
+            # window applies, keeping the ledger's sum-to-wall
+            # invariant honest
+            compiled = max(goodput.LEDGER.total(goodput.BUCKET_COMPILE)
+                           - compile_marker, 0.0)
+            goodput.attribute(goodput.BUCKET_CHECKPOINT_RESTORE,
+                              max(dt - compiled, 0.0))
         return restored_state
 
     def _restore_partial(self, abstract: Any, step: int) -> Any:
@@ -236,7 +278,9 @@ class Checkpointer:
             ckptr.close()
 
     def restore_latest_good(self, state_like: Any,
-                            partial: bool = False) -> Optional[tuple]:
+                            partial: bool = False,
+                            remove_unreadable: bool = False
+                            ) -> Optional[tuple]:
         """Restore the newest checkpoint that actually reads back.
 
         A step directory can be committed yet unreadable (torn write: the
@@ -246,28 +290,54 @@ class Checkpointer:
         when checkpoints exist but none restores, the failure is systemic
         (storage outage, sharding mismatch), not a torn write — raise it
         rather than let the caller silently restart from step 0 and age
-        good checkpoints out of the retention window."""
+        good checkpoints out of the retention window.
+
+        ``remove_unreadable=True`` deletes each skipped step once a
+        GOOD older step proves the failure was that step's data, not
+        the storage (the elastic re-mesh path uses this: the re-run
+        from the good step will re-reach the torn step and must be able
+        to re-commit it — a garbage directory squatting on the step id
+        would wedge every future save there)."""
         steps = sorted(self.all_steps(), reverse=True)
         if not steps:
             return None
         last_error: Optional[Exception] = None
+        unreadable: list = []
         for step in steps:
             try:
-                return self.restore(state_like, step=step,
-                                    partial=partial), step
+                restored = self.restore(state_like, step=step,
+                                        partial=partial)
             except Exception as e:
                 last_error = e
+                unreadable.append(step)
                 logger.warning(
                     "checkpoint step %d unreadable (torn write?); "
                     "falling back to the previous committed step",
                     step, exc_info=True)
+                continue
+            if remove_unreadable:
+                for bad in unreadable:
+                    try:
+                        self._manager.delete(bad)
+                        logger.warning(
+                            "removed unreadable checkpoint step %d so "
+                            "the re-run can re-commit it", bad)
+                    except Exception:
+                        logger.warning(
+                            "could not remove unreadable checkpoint "
+                            "step %d", bad, exc_info=True)
+            return restored, step
         raise RuntimeError(
             f"none of the {len(steps)} checkpoints under "
             f"{self.config.directory} could be restored; refusing to "
             "silently restart from scratch") from last_error
 
-    def close(self) -> None:
-        self._manager.close()
+    def close(self, deadline_s: Optional[float] = None) -> bool:
+        """Close the manager (drains async saves).  Same deadline
+        discipline as :meth:`wait`: a wedged save thread cannot hang
+        shutdown past ``deadline_s``.  Returns True when the close
+        completed, False on deadline."""
+        return self._bounded(self._manager.close, deadline_s, op="close")
 
 
 def _as_abstract(x):

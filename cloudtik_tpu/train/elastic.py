@@ -1,0 +1,230 @@
+"""Elastic multislice training: survive slice preemption by re-meshing.
+
+The data-parallel world size is a RUNTIME variable, not a compile-time
+constant (the Varuna-style job-morphing bar from PAPERS.md, on GSPMD's
+"same code, bigger mesh" substrate): a job trains across K pod slices —
+GSPMD within each slice over ICI, data-parallel over DCN — and when a
+slice is preempted it does NOT restart.  The
+:class:`ElasticCoordinator` watches slice membership (heartbeats through
+the head state path, control/membership.py) and, at the next step
+boundary, tells the trainer to:
+
+  * **shrink** (``slice_lost``): rebuild the hybrid mesh at K-1 over
+    the surviving slices, restore train state from the last committed
+    checkpoint into the NEW shardings (the lost slice's shards are
+    gone; ``Checkpointer`` restores into arbitrary abstract shardings),
+    keep the global batch constant (each surviving slice's share
+    grows), and resume — surviving host processes never restart;
+  * **expand** (``capacity_returned``): when the scaler recycles the
+    slice and its heartbeats return, rebuild the mesh at K and reshard
+    the LIVE state onto it (nothing was lost, so no checkpoint rewind).
+
+The re-mesh pause is booked to the goodput ledger's ``elastic_remesh``
+bucket (net of the restore/compile seconds booked to their own
+buckets), so "what elasticity costs" reads directly against what a
+restart-everything job books as ``restart_replay``.  Two fault seams
+make the whole path drillable: ``elastic.slice_lost`` (a ``drop``
+directive marks a slice lost for the poll — deterministic simulated
+preemption) and ``elastic.remesh`` (fired at the boundary before any
+mutation; ``raise`` aborts the re-mesh).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Callable, Dict, Iterable, Optional, Sequence, Set, Tuple, Union
+
+import jax
+
+from cloudtik_tpu.faults import seams
+from cloudtik_tpu.faults.plan import DIRECTIVE_DROP
+from cloudtik_tpu.parallel.mesh import (
+    MeshConfig, build_elastic_mesh, slice_device_groups)
+from cloudtik_tpu.telemetry import core as tcore
+from cloudtik_tpu.telemetry import instruments as ti
+
+logger = logging.getLogger(__name__)
+
+REASON_SLICE_LOST = "slice_lost"
+REASON_CAPACITY_RETURNED = "capacity_returned"
+
+DIRECTION_SHRINK = "shrink"
+DIRECTION_EXPAND = "expand"
+
+# Membership sources the coordinator accepts: a SliceMembership-like
+# object (alive_slices() -> iterable of slice ids) or a bare callable.
+MembershipLike = Union[Callable[[], Iterable[int]], object]
+
+
+@dataclasses.dataclass(frozen=True)
+class RemeshDecision:
+    """One boundary decision: change the live slice set, and why."""
+
+    from_slices: Tuple[int, ...]
+    to_slices: Tuple[int, ...]
+    reason: str              # REASON_SLICE_LOST | REASON_CAPACITY_RETURNED
+
+    @property
+    def direction(self) -> str:
+        # tied to the reason, not the set sizes: an equal-size swap
+        # (one slice dies as another returns) takes the slice_lost
+        # restore path and must count as a shrink-shaped event
+        return (DIRECTION_SHRINK if self.reason == REASON_SLICE_LOST
+                else DIRECTION_EXPAND)
+
+
+def fire_slice_lost_seam(slice_id: int, step: int) -> Optional[str]:
+    """The membership-poll injection point: an armed ``drop`` marks
+    this slice lost for this poll (simulated preemption)."""
+    return seams.fire("elastic.slice_lost", slice=slice_id, step=step)
+
+
+def fire_remesh_seam(from_slices: Tuple[int, ...],
+                     to_slices: Tuple[int, ...],
+                     reason: str) -> Optional[str]:
+    """Fired at the re-mesh boundary before any state mutation; an
+    armed ``raise`` aborts the re-mesh (the step loop fails loudly)."""
+    return seams.fire("elastic.remesh", from_slices=from_slices,
+                      to_slices=to_slices, reason=reason)
+
+
+def _note_remesh(direction: str, seconds: float, slices: int) -> None:
+    """Instrument one re-mesh.  Single attribute check when telemetry
+    is off (the elastic path must stay free on TIK_TELEMETRY=off)."""
+    if not tcore.STATE.enabled:
+        return
+    ti.ELASTIC_REMESHES.inc(direction=direction)
+    ti.ELASTIC_REMESH_SECONDS.observe(seconds)
+    ti.ELASTIC_SLICES.set(slices)
+
+
+class ElasticCoordinator:
+    """Decides, at step boundaries, which slices the job runs on.
+
+    ``membership`` answers "which slices are alive right now"
+    (control/membership.py's heartbeat-backed view, or any callable);
+    the coordinator holds the slice→devices map and the per-slice mesh
+    layout, turns membership changes into :class:`RemeshDecision`s, and
+    builds the mesh for any live slice set.  It never mutates trainer
+    state itself — the trainer applies decisions at its own boundary
+    (`Trainer.fit_elastic`).
+    """
+
+    def __init__(
+        self,
+        membership: MembershipLike,
+        mesh_config: Optional[MeshConfig] = None,
+        num_slices: Optional[int] = None,
+        slice_devices: Optional[Dict[int, Sequence[jax.Device]]] = None,
+        min_slices: int = 1,
+        check_every: int = 1,
+        checkpoint_wait_s: float = 60.0,
+        min_slices_grace_s: float = 60.0,
+        remesh_dwell_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        """``mesh_config`` describes ONE slice's layout (its ``data``
+        axis must be explicit); ``slice_devices`` maps slice id to that
+        slice's devices (default: ``slice_device_groups`` over all
+        devices and ``num_slices``)."""
+        self.membership = membership
+        self.mesh_config = mesh_config or MeshConfig(data=1, fsdp=-1)
+        if slice_devices is None:
+            if num_slices is None:
+                raise ValueError(
+                    "pass num_slices or an explicit slice_devices map")
+            slice_devices = slice_device_groups(num_slices=num_slices)
+        self.slice_devices = {int(s): list(d)
+                              for s, d in slice_devices.items()}
+        self.all_slices: Tuple[int, ...] = tuple(sorted(self.slice_devices))
+        if min_slices < 1:
+            raise ValueError(f"min_slices must be >= 1, got {min_slices}")
+        self.min_slices = int(min_slices)
+        self.check_every = max(int(check_every), 1)
+        self.checkpoint_wait_s = float(checkpoint_wait_s)
+        # a membership blackout (head state-server restart, every beat
+        # stale at once) must not kill the job instantly: below-min
+        # polls HOLD the current mesh for this long before escalating
+        self.min_slices_grace_s = float(min_slices_grace_s)
+        # minimum time between re-meshes: a flapping slice (GC-pausing
+        # host, lossy DCN) repeatedly crossing the heartbeat deadline
+        # must not thrash shrink/restore/expand cycles — each shrink
+        # rewinds to the last commit, so unbounded flapping would stall
+        # forward progress entirely.  During the dwell, membership
+        # changes HOLD; the below-min grace path still applies.
+        self.remesh_dwell_s = float(remesh_dwell_s)
+        self._clock = clock
+        self._below_min_since: Optional[float] = None
+        self._last_remesh_at: Optional[float] = None
+        self.current: Tuple[int, ...] = self.all_slices
+
+    # -- membership --------------------------------------------------------
+    def _alive(self) -> Set[int]:
+        source = self.membership
+        alive = (source() if callable(source)
+                 else source.alive_slices())
+        return {int(s) for s in alive} & set(self.all_slices)
+
+    def poll(self, step: int) -> Optional[RemeshDecision]:
+        """One boundary check: compare live slices to the working set.
+
+        Returns a decision when they differ, None to keep stepping.
+        Fires ``elastic.slice_lost`` once per known slice so a chaos
+        plan can deterministically mark slices lost (``drop``).
+        """
+        alive = self._alive()
+        for slice_id in self.all_slices:
+            if fire_slice_lost_seam(slice_id, step) == DIRECTIVE_DROP:
+                alive.discard(slice_id)
+        target = tuple(sorted(alive))
+        if target == self.current:
+            self._below_min_since = None
+            return None
+        if len(target) < self.min_slices:
+            # possibly a transient membership blackout (head state
+            # restart emptied the heartbeat table) rather than a real
+            # total loss: hold the current mesh for a grace window —
+            # the slices re-register within a heartbeat period if
+            # they are healthy — and only then fail loudly
+            now = self._clock()
+            if self._below_min_since is None:
+                self._below_min_since = now
+                logger.warning(
+                    "only %d slice(s) alive (%s) — below min_slices="
+                    "%d; holding the current mesh for up to %.0fs",
+                    len(target), list(target), self.min_slices,
+                    self.min_slices_grace_s)
+            if now - self._below_min_since < self.min_slices_grace_s:
+                return None
+            raise RuntimeError(
+                f"only {len(target)} slice(s) alive "
+                f"({list(target)}) — below min_slices="
+                f"{self.min_slices} for more than "
+                f"{self.min_slices_grace_s:.0f}s; cannot re-mesh")
+        self._below_min_since = None
+        if self._last_remesh_at is not None and \
+                self._clock() - self._last_remesh_at < \
+                self.remesh_dwell_s:
+            # dwell: too soon after the last re-mesh — hold the
+            # current mesh so a flapping slice costs at most one
+            # re-mesh per dwell window
+            return None
+        lost = set(self.current) - set(target)
+        reason = REASON_SLICE_LOST if lost else REASON_CAPACITY_RETURNED
+        return RemeshDecision(from_slices=self.current,
+                              to_slices=target, reason=reason)
+
+    def commit(self, decision: RemeshDecision) -> None:
+        """The trainer applied the decision; make it the working set."""
+        self.current = tuple(sorted(decision.to_slices))
+        self._last_remesh_at = self._clock()
+
+    # -- meshes ------------------------------------------------------------
+    def build_mesh(self,
+                   slices: Optional[Sequence[int]] = None):
+        """Mesh over the given (default: current) slice set."""
+        return build_elastic_mesh(
+            self.mesh_config, self.slice_devices,
+            self.current if slices is None else slices)

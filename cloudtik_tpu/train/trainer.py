@@ -21,7 +21,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cloudtik_tpu import telemetry
-from cloudtik_tpu.parallel.mesh import MeshConfig, build_mesh
+from cloudtik_tpu.parallel.mesh import (
+    MeshConfig, build_mesh, local_batch_slice)
 from cloudtik_tpu.telemetry import events, goodput, stepprof
 from cloudtik_tpu.telemetry import instruments as ti
 from cloudtik_tpu.parallel.sharding import (
@@ -207,9 +208,17 @@ class Trainer:
         ensure_compile_cache()
         self.mesh = mesh if mesh is not None else build_mesh(config.mesh)
         self.optimizer = make_optimizer(config.optimizer)
-        params_shape = jax.eval_shape(spec.init, jax.random.PRNGKey(0))
+        # abstract shapes are mesh-independent: computed ONCE so an
+        # elastic re-mesh (which rebuilds shardings for a new mesh)
+        # costs tree maps, not a re-trace of model + optimizer init
+        self._params_shape = jax.eval_shape(
+            spec.init, jax.random.PRNGKey(0))
+        self._opt_shape = jax.eval_shape(
+            self.optimizer.init, self._params_shape)
+        self._opt_shardings = None        # per-mesh cache
         self.param_shardings = tree_to_shardings_safe(
-            self.mesh, spec.logical_axes, params_shape, config.rules)
+            self.mesh, spec.logical_axes, self._params_shape,
+            config.rules)
         self.data_sharding = batch_sharding(self.mesh, config.rules)
         self.step_fn = self._build_step()
         self.state = None
@@ -243,19 +252,11 @@ class Trainer:
 
     def _opt_state_shardings(self):
         """Optimizer slots that mirror param shapes get param shardings;
-        scalars (step counts) are replicated."""
-        params_shape = jax.eval_shape(self.spec.init, jax.random.PRNGKey(0))
-        opt_shape = jax.eval_shape(self.optimizer.init, params_shape)
-        flat_param_shardings = {}
-
-        def record(path, shard):
-            flat_param_shardings[tuple(str(p) for p in path)] = shard
-
-        jax.tree_util.tree_map_with_path(
-            record, self.param_shardings,
-            is_leaf=lambda x: isinstance(x, NamedSharding))
-
-        param_leaves = jax.tree.leaves(params_shape)
+        scalars (step counts) are replicated.  Cached per mesh (the
+        cache invalidates on remesh)."""
+        if self._opt_shardings is not None:
+            return self._opt_shardings
+        param_leaves = jax.tree.leaves(self._params_shape)
         shapes_to_shard = {}
         for leaf, shard in zip(param_leaves,
                                jax.tree.leaves(self.param_shardings)):
@@ -266,7 +267,8 @@ class Trainer:
         def pick(leaf):
             return shapes_to_shard.get(leaf.shape, replicated)
 
-        return jax.tree.map(pick, opt_shape)
+        self._opt_shardings = jax.tree.map(pick, self._opt_shape)
+        return self._opt_shardings
 
     # -- checkpoint --------------------------------------------------------
     def save_checkpoint(self, force: bool = False) -> bool:
@@ -308,12 +310,8 @@ class Trainer:
 
     def _abstract_state(self):
         """ShapeDtypeStructs with shardings for {params, opt_state}."""
-        def _init(rng):
-            params = self.spec.init(rng)
-            return {"params": params,
-                    "opt_state": self.optimizer.init(params)}
-
-        shapes = jax.eval_shape(_init, jax.random.PRNGKey(0))
+        shapes = {"params": self._params_shape,
+                  "opt_state": self._opt_shape}
         shardings = {"params": self.param_shardings,
                      "opt_state": self._opt_state_shardings()}
         return jax.tree.map(
@@ -340,6 +338,195 @@ class Trainer:
         self.step = int(step)
         self._note_resume()
         return self.step
+
+    # -- elastic -----------------------------------------------------------
+    def remesh(self, mesh: Mesh) -> None:
+        """Rebind to a new device mesh: shardings and the jitted step
+        are rebuilt; state is NOT moved (callers restore or reshard it
+        explicitly — see `_apply_remesh`)."""
+        self.mesh = mesh
+        self.param_shardings = tree_to_shardings_safe(
+            mesh, self.spec.logical_axes, self._params_shape,
+            self.config.rules)
+        self.data_sharding = batch_sharding(mesh, self.config.rules)
+        self._opt_shardings = None
+        self._jitted_step = None
+
+    def fit_elastic(
+        self,
+        data_factory: Callable[[int], Iterator[Dict[str, np.ndarray]]],
+        num_steps: int,
+        coordinator,
+        rng: Optional[jax.Array] = None,
+        callbacks: Optional[list] = None,
+    ) -> Dict[str, Any]:
+        """Elastic multislice fit: train to ``self.step + num_steps``,
+        re-meshing across slices at step boundaries as the coordinator
+        (train/elastic.py `ElasticCoordinator`) observes membership
+        change.
+
+        ``data_factory(step)`` returns an iterator of the batches for
+        steps ``step+1, step+2, ...`` — a re-mesh that resumes from an
+        older committed step rewinds the data stream with it, which is
+        what makes the post-shrink loss trajectory bit-identical to a
+        fresh K-1 run from the same committed step.  Each entry in the
+        returned history carries a ``slices`` count.
+        """
+        if self.checkpointer is None:
+            raise RuntimeError(
+                "elastic training requires checkpointing "
+                "(set checkpoint_dir + checkpoint_every): a lost "
+                "slice resumes from the last committed step")
+        goodput.LEDGER.start_job()
+        stepprof.install_compile_tracking()
+        if self.state is None:
+            self.init_state(rng if rng is not None
+                            else jax.random.PRNGKey(0))
+        ti.ELASTIC_SLICES.set(len(coordinator.current))
+        end_step = self.step + num_steps
+        history = []
+        data_iter = None
+        prefetcher = None
+
+        def rebind_input():
+            # the input pipeline binds to a mesh era: built once, kept
+            # across boundary polls, and rebuilt ONLY after a re-mesh
+            # (the data stream rewinds with the step and device_put
+            # must target the new sharding) — not per segment, which
+            # would nullify the async pipeline and make islice-style
+            # factories quadratic in re-skips
+            nonlocal data_iter, prefetcher
+            if prefetcher is not None:
+                prefetcher.close()
+            data_iter = data_factory(self.step)
+            prefetcher = None
+            if (self.config.prefetch_depth > 0
+                    and not isinstance(data_iter, Prefetcher)):
+                prefetcher = Prefetcher(
+                    data_iter, sharding=self.data_sharding,
+                    depth=self.config.prefetch_depth,
+                    threads=self.config.prefetch_threads,
+                    max_items=end_step - self.step)
+                data_iter = prefetcher
+
+        try:
+            rebind_input()
+            while self.step < end_step:
+                decision = coordinator.poll(self.step)
+                if decision is not None:
+                    # drain the old era's prefetcher before pausing —
+                    # its producers hold the OLD sharding
+                    if prefetcher is not None:
+                        prefetcher.close()
+                        prefetcher = None
+                    self._apply_remesh(decision, coordinator)
+                    rebind_input()
+                segment = min(coordinator.check_every,
+                              end_step - self.step)
+                out = self._fit_loop(data_iter, segment,
+                                     self.compile_step(),
+                                     callbacks or [])
+                slices = len(coordinator.current)
+                for entry in out["history"]:
+                    entry["slices"] = slices
+                history.extend(out["history"])
+        finally:
+            if prefetcher is not None:
+                prefetcher.close()
+            goodput.LEDGER.tick()
+            goodput.maybe_write_snapshot()
+        return {"history": history, "final_step": self.step}
+
+    def _apply_remesh(self, decision, coordinator) -> None:
+        """Apply one re-mesh decision at a step boundary.
+
+        Shrink (slice lost): the dead slice's state shards are gone —
+        restore the last committed checkpoint into the NEW shardings
+        and rewind the step (the re-run books as restart_replay).
+        Expand (capacity returned): nothing was lost — reshard the
+        live state onto the wider mesh, no rewind.  The pause's wall
+        time books to the ``elastic_remesh`` goodput bucket net of the
+        restore/compile seconds booked to their own buckets.
+        """
+        from cloudtik_tpu.train.elastic import (
+            REASON_SLICE_LOST, fire_remesh_seam, _note_remesh)
+
+        t0 = time.perf_counter()
+        compile_mark = goodput.LEDGER.total(goodput.BUCKET_COMPILE)
+        restore_mark = goodput.LEDGER.total(
+            goodput.BUCKET_CHECKPOINT_RESTORE)
+        pre_step = self.step
+        with telemetry.span("train.remesh", reason=decision.reason,
+                            from_slices=len(decision.from_slices),
+                            to_slices=len(decision.to_slices)):
+            fire_remesh_seam(decision.from_slices, decision.to_slices,
+                             decision.reason)
+            new_mesh = coordinator.build_mesh(decision.to_slices)
+            # batch rescale check up front: the global batch is
+            # preserved, so it must split over the new data-parallel
+            # size — refuse the re-mesh loudly before any mutation
+            local_batch_slice(new_mesh, self.config.global_batch_size)
+            # a wedged async save must not hang the re-mesh; the
+            # deadline journals tik_checkpoint_wait_timeout and the
+            # restore below reads whatever IS committed.  The drain is
+            # checkpoint work (the async save's durability turned
+            # foreground), so it books to checkpoint_save, keeping
+            # elastic_remesh the pure coordination cost
+            t_wait = time.perf_counter()
+            self.checkpointer.wait(
+                deadline_s=coordinator.checkpoint_wait_s)
+            wait_s = time.perf_counter() - t_wait
+            goodput.attribute(goodput.BUCKET_CHECKPOINT_SAVE, wait_s)
+            self.remesh(new_mesh)
+            if decision.reason == REASON_SLICE_LOST:
+                restored = self.checkpointer.restore_latest_good(
+                    self._abstract_state(), remove_unreadable=True)
+                if restored is None:
+                    raise RuntimeError(
+                        "elastic shrink needs a committed checkpoint "
+                        f"under {self.checkpointer.config.directory}; "
+                        "none found")
+                self.state, step = restored
+                self.step = int(step)
+                # steps up to where the wider mesh had reached are
+                # re-runs: replay, not progress.  The journal horizon
+                # can only see committed steps, the coordinator saw the
+                # actual boundary — take the max.
+                horizon = max(pre_step, goodput.replay_horizon(
+                    self.step,
+                    directory=self.checkpointer.config.directory))
+                self._replay_until = horizon if horizon > self.step \
+                    else 0
+                events.emit("tik_train_resume", step=self.step,
+                            replay_until=self._replay_until)
+            else:
+                # live reshard: every shard still exists on the
+                # surviving slices; device_put lays the same global
+                # arrays out over the wider mesh
+                self.state = jax.device_put(
+                    self.state,
+                    {"params": self.param_shardings,
+                     "opt_state": self._opt_state_shardings()})
+            dt = time.perf_counter() - t0
+            booked = wait_s + \
+                (goodput.LEDGER.total(goodput.BUCKET_COMPILE)
+                 - compile_mark) + \
+                (goodput.LEDGER.total(
+                    goodput.BUCKET_CHECKPOINT_RESTORE)
+                 - restore_mark)
+            goodput.attribute(goodput.BUCKET_ELASTIC_REMESH,
+                              max(dt - booked, 0.0))
+            _note_remesh(decision.direction, dt,
+                         len(decision.to_slices))
+            # emitted inside the span so the journal record carries
+            # its traceparent — `tik events dump --trace-id` replays
+            # the re-mesh next to the scaler's decisions
+            events.emit("tik_elastic_remesh", reason=decision.reason,
+                        from_slices=list(decision.from_slices),
+                        to_slices=list(decision.to_slices),
+                        step=self.step, replayed_to=pre_step,
+                        duration_s=round(dt, 4))
+        coordinator.commit(decision)
 
     # -- step --------------------------------------------------------------
     def _build_step(self):

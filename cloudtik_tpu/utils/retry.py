@@ -24,8 +24,9 @@ from __future__ import annotations
 import dataclasses
 import functools
 import random
+import threading
 import time
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Tuple
 
 from cloudtik_tpu.faults import seams
 
@@ -135,6 +136,40 @@ def call_with_retry(
                        attempt=attempt)
             sleep(delay)
             attempt += 1
+
+
+def run_with_deadline(fn: Callable[[], Any], deadline_s: float,
+                      name: str = "deadline-call"
+                      ) -> Tuple[bool, Any]:
+    """Run ``fn()`` but wait at most ``deadline_s`` for it to return.
+
+    The deadline half of the retry policy's timeout discipline, for
+    calls that take no timeout themselves (orbax ``wait_until_finished``
+    / ``close``): the call runs on a daemon helper thread and the
+    caller blocks up to the deadline.  Returns ``(True, result)`` when
+    the call finished (exceptions re-raise in the caller), or
+    ``(False, None)`` on timeout — the helper thread is left to finish
+    (or stay wedged) in the background; it can no longer block the
+    caller's teardown.
+    """
+    if deadline_s <= 0:
+        return True, fn()
+    box: dict = {}
+
+    def _run():
+        try:
+            box["result"] = fn()
+        except BaseException as e:     # noqa: BLE001 - re-raised below
+            box["error"] = e
+
+    thread = threading.Thread(target=_run, name=name, daemon=True)
+    thread.start()
+    thread.join(timeout=deadline_s)
+    if thread.is_alive():
+        return False, None
+    if "error" in box:
+        raise box["error"]
+    return True, box.get("result")
 
 
 def retry(policy: RetryPolicy = RetryPolicy(), **call_kw):

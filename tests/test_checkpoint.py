@@ -101,3 +101,64 @@ def test_partial_restore_params_only(tmp_path):
     with pytest.raises(Exception):
         ckpt2.restore({"params": template})
     ckpt2.close()
+
+
+def test_restore_latest_good_races_in_flight_async_save(tmp_path):
+    """The elastic shrink path scans for the latest committed step
+    while the async save thread may be mid-write: the scan must land
+    on a committed, readable step without waiting on the writer."""
+    cfg, trainer = tiny_trainer(tmp_path)
+    data = synthetic_lm_batches(8, 32, cfg.vocab_size)
+    trainer.fit(data, num_steps=4)     # async saves at 2, 4 — NO wait
+
+    _, reader = tiny_trainer(tmp_path)
+    restored = reader.checkpointer.restore_latest_good(
+        reader._abstract_state())
+    assert restored is not None
+    state, step = restored
+    assert step in (2, 4)              # whatever was committed by now
+    assert jax.tree.leaves(state["params"])
+
+    # once the writer drains, a fresh scan restores the newest step
+    # (orbax managers cache their step listing at construction)
+    trainer.checkpointer.wait()
+    _, reader2 = tiny_trainer(tmp_path)
+    restored = reader2.checkpointer.restore_latest_good(
+        reader2._abstract_state())
+    assert restored[1] == 4
+    trainer.checkpointer.close()
+
+
+def test_restore_latest_good_skips_and_optionally_removes_mid_write(
+        tmp_path):
+    """The deterministic mid-write shape: a step directory that LOOKS
+    committed (listed) but whose data is incomplete.  The scan skips
+    it; remove_unreadable=True (the elastic re-mesh path) deletes the
+    garbage so the re-run can re-commit that step id."""
+    import shutil
+
+    cfg, trainer = tiny_trainer(tmp_path)
+    data = synthetic_lm_batches(8, 32, cfg.vocab_size)
+    trainer.fit(data, num_steps=4)
+    trainer.checkpointer.wait()
+    trainer.checkpointer.close()
+
+    # manufacture step 6 as a half-written copy of step 4
+    root = tmp_path / "ckpt"
+    shutil.copytree(root / "4", root / "6")
+    ckpt = Checkpointer(CheckpointConfig(directory=str(root)))
+    ckpt._tear_step(6)
+    assert 6 in ckpt.all_steps()       # it LOOKS committed
+
+    abstract = trainer._abstract_state()
+    # default: skipped but preserved (a storage blip must not nuke it)
+    restored, step = ckpt.restore_latest_good(abstract)
+    assert step == 4
+    assert 6 in ckpt.all_steps()
+    # elastic path: proven-garbage newer step is removed once an older
+    # GOOD step restores
+    restored, step = ckpt.restore_latest_good(abstract,
+                                              remove_unreadable=True)
+    assert step == 4
+    assert 6 not in ckpt.all_steps()
+    ckpt.close()

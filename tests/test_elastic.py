@@ -1,0 +1,499 @@
+"""Elastic multislice units: slice grouping, elastic meshes, the
+coordinator's decisions, heartbeat-backed slice membership, the
+launcher's backoff on failed asks, and the bounded checkpoint drain.
+
+The end-to-end story (preempt -> re-mesh K-1 -> recycle -> re-expand)
+is chaos drill (f) in tests/test_chaos_drills.py; these are the parts.
+"""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from cloudtik_tpu.faults import seams
+from cloudtik_tpu.faults.plan import FaultPlan, FaultPoint
+from cloudtik_tpu.parallel.mesh import (
+    MeshConfig, build_elastic_mesh, data_axis_size, elastic_mesh_config,
+    slice_device_groups)
+from cloudtik_tpu.train.elastic import (
+    DIRECTION_EXPAND, DIRECTION_SHRINK, ElasticCoordinator,
+    REASON_CAPACITY_RETURNED, REASON_SLICE_LOST)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    seams.disarm()
+    yield
+    seams.disarm()
+
+
+class _FakeDevice:
+    def __init__(self, i, slice_index=None):
+        self.id = i
+        if slice_index is not None:
+            self.slice_index = slice_index
+
+    def __repr__(self):
+        return f"dev{self.id}"
+
+
+# ------------------------------------------------------ device groups --
+
+class TestSliceDeviceGroups:
+    def test_contiguous_split_without_slice_attrs(self):
+        devices = [_FakeDevice(i) for i in range(8)]
+        groups = slice_device_groups(devices, num_slices=2)
+        assert sorted(groups) == [0, 1]
+        assert groups[0] == devices[:4] and groups[1] == devices[4:]
+
+    def test_real_slice_indices_win_over_num_slices(self):
+        devices = [_FakeDevice(i, slice_index=i % 2) for i in range(8)]
+        groups = slice_device_groups(devices, num_slices=4)
+        assert sorted(groups) == [0, 1]
+        assert all(d.slice_index == 0 for d in groups[0])
+        assert all(d.slice_index == 1 for d in groups[1])
+
+    def test_indivisible_refused(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            slice_device_groups([_FakeDevice(i) for i in range(8)],
+                                num_slices=3)
+
+
+# ------------------------------------------------------ elastic meshes --
+
+class TestElasticMesh:
+    def test_data_axis_scales_with_live_slices(self):
+        groups = slice_device_groups(num_slices=2)   # 8 CPU devices
+        per_slice = MeshConfig(data=1, fsdp=-1)
+        m2 = build_elastic_mesh(per_slice, groups, [0, 1])
+        m1 = build_elastic_mesh(per_slice, groups, [1])
+        assert m2.shape["data"] == 2 and m1.shape["data"] == 1
+        # the intra-slice layout is invariant while K varies
+        assert m2.shape["fsdp"] == m1.shape["fsdp"] == 4
+        assert data_axis_size(m2) == 8 and data_axis_size(m1) == 4
+
+    def test_device_order_is_slice_major(self):
+        groups = slice_device_groups(num_slices=2)
+        m2 = build_elastic_mesh(MeshConfig(data=1, fsdp=-1), groups,
+                                [0, 1])
+        flat = list(m2.devices.flatten())
+        assert flat[:4] == groups[0] and flat[4:] == groups[1]
+
+    def test_fill_data_axis_refused(self):
+        with pytest.raises(ValueError, match="explicit per-slice data"):
+            elastic_mesh_config(MeshConfig(data=-1, fsdp=1), 2)
+
+    def test_unknown_and_empty_slice_sets_refused(self):
+        groups = slice_device_groups(num_slices=2)
+        per_slice = MeshConfig(data=1, fsdp=-1)
+        with pytest.raises(ValueError, match="unknown slice"):
+            build_elastic_mesh(per_slice, groups, [0, 7])
+        with pytest.raises(ValueError, match="zero live slices"):
+            build_elastic_mesh(per_slice, groups, [])
+
+
+# -------------------------------------------------------- coordinator --
+
+def _coordinator(alive, **kw):
+    kw.setdefault("mesh_config", MeshConfig(data=1, fsdp=-1))
+    kw.setdefault("num_slices", 2)
+    # most tests poll back-to-back; the anti-flap dwell is exercised
+    # explicitly in test_dwell_rate_limits_remeshes
+    kw.setdefault("remesh_dwell_s", 0.0)
+    return ElasticCoordinator(lambda: alive["s"], **kw)
+
+
+class TestElasticCoordinator:
+    def test_stable_membership_is_no_decision(self):
+        coord = _coordinator({"s": {0, 1}})
+        assert coord.poll(3) is None
+        assert coord.current == (0, 1)
+
+    def test_shrink_then_expand_decisions(self):
+        alive = {"s": {0, 1}}
+        coord = _coordinator(alive)
+        alive["s"] = {0}
+        decision = coord.poll(5)
+        assert decision.reason == REASON_SLICE_LOST
+        assert decision.direction == DIRECTION_SHRINK
+        assert decision.from_slices == (0, 1)
+        assert decision.to_slices == (0,)
+        coord.commit(decision)
+        assert coord.current == (0,)
+        alive["s"] = {0, 1}
+        decision = coord.poll(9)
+        assert decision.reason == REASON_CAPACITY_RETURNED
+        assert decision.direction == DIRECTION_EXPAND
+        coord.commit(decision)
+        assert coord.current == (0, 1)
+
+    def test_membership_object_with_alive_slices_method(self):
+        class View:
+            def alive_slices(self):
+                return [1]
+
+        coord = ElasticCoordinator(
+            View(), mesh_config=MeshConfig(data=1, fsdp=-1),
+            num_slices=2)
+        decision = coord.poll(0)
+        assert decision.to_slices == (1,)
+
+    def test_unknown_slices_from_membership_ignored(self):
+        coord = _coordinator({"s": {0, 1, 9}})
+        assert coord.poll(0) is None
+
+    def test_below_min_slices_holds_through_grace_then_raises(self):
+        """A total membership blackout (head state restart) must not
+        kill the job instantly: below-min polls HOLD the current mesh
+        for the grace window, then fail loudly."""
+        clock = {"t": 0.0}
+        alive = {"s": set()}
+        coord = _coordinator(alive, min_slices=1,
+                             min_slices_grace_s=30.0,
+                             clock=lambda: clock["t"])
+        assert coord.poll(0) is None          # hold, don't die
+        clock["t"] = 10.0
+        assert coord.poll(1) is None          # still inside grace
+        # membership recovers inside the grace: business as usual
+        alive["s"] = {0, 1}
+        assert coord.poll(2) is None
+        # a NEW blackout starts its own grace window
+        alive["s"] = set()
+        clock["t"] = 40.0
+        assert coord.poll(3) is None
+        clock["t"] = 75.0                     # 35s into the new window
+        with pytest.raises(RuntimeError, match="below min_slices"):
+            coord.poll(4)
+
+    def test_slice_lost_seam_drop_marks_slice_lost(self):
+        """An armed drop at elastic.slice_lost is a deterministic
+        simulated preemption, bounded by `times` — the slice comes
+        back when the window ends."""
+        coord = _coordinator({"s": {0, 1}})
+        plan = FaultPlan([FaultPoint("elastic.slice_lost", "drop",
+                                     times=2, match={"slice": 1})],
+                         seed=3)
+        with seams.armed(plan):
+            decision = coord.poll(1)
+            assert decision.reason == REASON_SLICE_LOST
+            assert decision.to_slices == (0,)
+            coord.commit(decision)
+            # second poll still inside the drop window: no change
+            assert coord.poll(2) is None
+            # window over: capacity returns
+            decision = coord.poll(3)
+            assert decision.reason == REASON_CAPACITY_RETURNED
+        assert plan.points[0].fired == 2
+
+    def test_dwell_rate_limits_remeshes(self):
+        """A flapping slice costs at most one re-mesh per dwell
+        window — otherwise every flap would rewind to the last commit
+        and forward progress could stall entirely."""
+        clock = {"t": 0.0}
+        alive = {"s": {0, 1}}
+        coord = _coordinator(alive, remesh_dwell_s=30.0,
+                             clock=lambda: clock["t"])
+        alive["s"] = {0}
+        coord.commit(coord.poll(1))          # shrink at t=0
+        # the slice flaps straight back: held by the dwell
+        alive["s"] = {0, 1}
+        clock["t"] = 5.0
+        assert coord.poll(2) is None
+        clock["t"] = 29.0
+        assert coord.poll(3) is None
+        # dwell over: the expand goes through
+        clock["t"] = 31.0
+        decision = coord.poll(4)
+        assert decision is not None
+        assert decision.reason == REASON_CAPACITY_RETURNED
+
+    def test_equal_size_swap_counts_as_shrink(self):
+        """Slice 1 dies as slice 2 returns: same K, but the restore
+        path runs — direction must follow the reason, not set sizes."""
+        coord = ElasticCoordinator(
+            lambda: {0, 2},
+            mesh_config=MeshConfig(data=1, fsdp=-1),
+            slice_devices={0: [_FakeDevice(0)], 1: [_FakeDevice(1)],
+                           2: [_FakeDevice(2)]},
+            remesh_dwell_s=0.0)
+        coord.current = (0, 1)
+        decision = coord.poll(0)
+        assert decision.reason == REASON_SLICE_LOST
+        assert decision.direction == DIRECTION_SHRINK
+        assert decision.to_slices == (0, 2)
+
+    def test_build_mesh_uses_current_set(self):
+        alive = {"s": {0, 1}}
+        coord = _coordinator(alive)
+        assert data_axis_size(coord.build_mesh()) == 8
+        alive["s"] = {1}
+        coord.commit(coord.poll(0))
+        assert data_axis_size(coord.build_mesh()) == 4
+
+
+# ---------------------------------------------------- slice membership --
+
+class TestSliceMembership:
+    def _setup(self, deadline_s=10.0):
+        from cloudtik_tpu.control.membership import SliceMembership
+        from cloudtik_tpu.control.state import (
+            InMemoryStateBackend, StateClient)
+        state = StateClient(InMemoryStateBackend())
+        return state, SliceMembership(state, num_slices=2,
+                                      deadline_s=deadline_s)
+
+    def _agent(self, state, node_id, slice_id):
+        from cloudtik_tpu.control.node_agent import NodeAgent
+        return NodeAgent(state, node_id, node_ip="127.0.0.1",
+                         total_resources={"CPU": 1}, slice_id=slice_id)
+
+    def test_heartbeats_carry_slice_id_and_age_out(self):
+        state, membership = self._setup()
+        self._agent(state, "a0", 0).heartbeat_once()
+        self._agent(state, "b0", 1).heartbeat_once()
+        assert membership.alive_slices() == {0, 1}
+        # slice 1 goes dark: its beat ages past the deadline
+        assert membership.alive_slices(
+            now=time.time() + 60.0) == set()
+        beats = membership.last_beat_by_slice()
+        assert sorted(beats) == [0, 1]
+
+    def test_any_member_keeps_the_slice_alive(self):
+        state, membership = self._setup()
+        self._agent(state, "a0", 0).heartbeat_once()
+        self._agent(state, "a1", 0).heartbeat_once()
+        from cloudtik_tpu.control.state import TABLE_HEARTBEAT
+        state.table_delete(TABLE_HEARTBEAT, "a0")
+        assert membership.alive_slices() == {0}
+
+    def test_sliceless_and_out_of_range_beats_ignored(self):
+        state, membership = self._setup()
+        self._agent(state, "plain", None).heartbeat_once()
+        self._agent(state, "weird", 7).heartbeat_once()
+        assert membership.alive_slices() == set()
+
+    def test_agent_reads_slice_id_from_env(self, monkeypatch):
+        monkeypatch.setenv("TIK_SLICE_INDEX", "1")
+        state, membership = self._setup()
+        self._agent(state, "envd", None)   # constructor reads env...
+        agent = self._agent(state, "envd2", None)
+        assert agent.slice_id == 1
+        agent.heartbeat_once()
+        assert membership.alive_slices() == {1}
+
+
+# ------------------------------------------------------ launcher backoff --
+
+class TestLauncherBackoff:
+    def _launcher(self, provider, policy):
+        from cloudtik_tpu.control.launcher import (
+            NodeLauncher, PendingLaunches)
+        from tests.test_scaler import base_config
+        return NodeLauncher(provider, "t", base_config(),
+                            queue.Queue(), PendingLaunches(), {},
+                            retry_policy=policy)
+
+    def test_failed_ask_retries_with_backoff_through_retry_seam(self):
+        """A launch_failed ask is retried under the unified policy —
+        each backoff fires the utils.retry seam — instead of being
+        immediately re-asked (drilled via the provider fault seam)."""
+        from cloudtik_tpu.utils.retry import RetryPolicy
+        from tests.mock_infra import MockProvider
+
+        provider = MockProvider()
+        launcher = self._launcher(provider, RetryPolicy(
+            max_attempts=3, base_delay_s=0.01, multiplier=2.0,
+            jitter=0.0))
+        plan = FaultPlan([
+            FaultPoint("provider.create_node", "raise", times=2),
+            FaultPoint("utils.retry", "latency", times=0,
+                       args={"seconds": 0.0}),
+        ], seed=1)
+        with seams.armed(plan):
+            launcher._launch_with_retry("worker", 1)
+        assert plan.points[0].fired == 2          # two injected failures
+        assert plan.points[1].calls == 2          # two backoff sleeps
+        assert len(provider.mock_nodes()) == 1    # third attempt landed
+
+    def test_retried_then_successful_ask_books_no_failures(self):
+        """Failure accounting is once per ASK, on terminal failure —
+        an ask that recovers on retry must book zero failed nodes
+        (launches + failures reconcile against nodes that exist)."""
+        from cloudtik_tpu.telemetry import instruments as ti
+        from cloudtik_tpu.utils.retry import RetryPolicy
+        from tests.mock_infra import MockProvider
+
+        provider = MockProvider()
+        launcher = self._launcher(provider, RetryPolicy(
+            max_attempts=3, base_delay_s=0.01, jitter=0.0))
+        before = ti.NODE_LAUNCH_FAILURES.value(node_type="worker")
+        plan = FaultPlan([FaultPoint("provider.create_node", "raise",
+                                     times=1)], seed=4)
+        with seams.armed(plan):
+            launcher._launch_with_retry("worker", 2)
+        assert ti.NODE_LAUNCH_FAILURES.value(
+            node_type="worker") == before
+        assert len(provider.mock_nodes()) == 2
+
+    def test_flapping_provider_exhausts_attempts_not_the_cpu(self):
+        from cloudtik_tpu.telemetry import instruments as ti
+        from cloudtik_tpu.utils.retry import RetriesExhausted, RetryPolicy
+        from tests.mock_infra import MockProvider
+
+        provider = MockProvider()
+        launcher = self._launcher(provider, RetryPolicy(
+            max_attempts=3, base_delay_s=0.01, jitter=0.0))
+        before = ti.NODE_LAUNCH_FAILURES.value(node_type="worker")
+        plan = FaultPlan([FaultPoint("provider.create_node", "raise",
+                                     times=0)], seed=2)
+        with seams.armed(plan):
+            with pytest.raises(RetriesExhausted):
+                launcher._launch_with_retry("worker", 1)
+        assert plan.points[0].fired == 3          # bounded, no hot loop
+        # ONE terminal failure record for the whole 3-attempt ask
+        assert ti.NODE_LAUNCH_FAILURES.value(
+            node_type="worker") == before + 1
+
+    def test_config_errors_are_not_retried(self):
+        """A bad node_type fails identically every attempt — the
+        default policy's retryable predicate rejects it, so the error
+        surfaces immediately instead of after 3-7s of backoff."""
+        from cloudtik_tpu.control.launcher import LAUNCH_RETRY_POLICY
+        from tests.mock_infra import MockProvider
+
+        provider = MockProvider()
+        launcher = self._launcher(provider, LAUNCH_RETRY_POLICY)
+        plan = FaultPlan([FaultPoint("utils.retry", "latency", times=0,
+                                     args={"seconds": 0.0})], seed=5)
+        with seams.armed(plan):
+            with pytest.raises(KeyError):
+                launcher._launch_with_retry("no_such_type", 1)
+        assert plan.points[0].calls == 0          # zero backoff sleeps
+
+    def test_stop_aborts_a_backoff_sleep(self):
+        from cloudtik_tpu.control.launcher import _LauncherStopped
+        from cloudtik_tpu.utils.retry import RetryPolicy
+        from tests.mock_infra import MockProvider
+
+        provider = MockProvider()
+        launcher = self._launcher(provider, RetryPolicy(
+            max_attempts=5, base_delay_s=30.0, jitter=0.0))
+        plan = FaultPlan([FaultPoint("provider.create_node", "raise",
+                                     times=0)], seed=3)
+        t0 = time.perf_counter()
+        timer = threading.Timer(0.1, launcher.stop)
+        timer.start()
+        try:
+            with seams.armed(plan):
+                with pytest.raises(_LauncherStopped):
+                    launcher._launch_with_retry("worker", 1)
+        finally:
+            timer.cancel()
+        assert time.perf_counter() - t0 < 5.0     # not the 30s backoff
+
+    def test_partial_group_success_reduces_the_retried_count(self):
+        """An atomic-group ask that half-landed retries only the
+        remainder — the exception carries how many came up."""
+        from cloudtik_tpu.utils.retry import RetryPolicy
+        from tests.mock_infra import MockProvider
+        from tests.test_scaler import base_config
+
+        class FlakyGroups(MockProvider):
+            def __init__(self):
+                super().__init__(with_groups=True)
+                self.group_calls = 0
+
+            def create_node_group(self, node_config, tags, group_size):
+                self.group_calls += 1
+                if self.group_calls == 2:
+                    raise RuntimeError("slice flapped")
+                return super().create_node_group(
+                    node_config, tags, group_size)
+
+        from cloudtik_tpu.control.launcher import (
+            NodeLauncher, PendingLaunches)
+        provider = FlakyGroups()
+        config = base_config(with_tpu_group=True)
+        launcher = NodeLauncher(
+            provider, "t", config, queue.Queue(), PendingLaunches(),
+            {}, retry_policy=RetryPolicy(max_attempts=3,
+                                         base_delay_s=0.01, jitter=0.0))
+        # ask for 2 groups of 4: group 1 lands, group 2 raises, the
+        # retry asks only for the missing 4
+        launcher._launch_with_retry("tpu", 8)
+        assert provider.group_calls == 3
+        assert len(provider.mock_nodes()) == 8
+
+
+# --------------------------------------------- bounded checkpoint drain --
+
+class TestCheckpointDeadline:
+    def test_wedged_wait_hits_deadline_and_journals(self, tmp_path,
+                                                    monkeypatch):
+        """A wedged async-save thread can never hang elastic teardown:
+        wait() gives up at the deadline, journals
+        tik_checkpoint_wait_timeout, and returns False."""
+        from cloudtik_tpu.telemetry import events
+        from cloudtik_tpu.train.checkpoint import (
+            CheckpointConfig, Checkpointer)
+
+        ckpt = Checkpointer(CheckpointConfig(
+            directory=str(tmp_path / "ckpt"), save_interval_steps=1))
+        release = threading.Event()
+        monkeypatch.setattr(
+            ckpt._manager, "wait_until_finished",
+            lambda: release.wait(30.0))
+        monkeypatch.setenv("TIK_EVENTS_PATH",
+                           str(tmp_path / "events.jsonl"))
+        events.install()
+        try:
+            t0 = time.perf_counter()
+            assert ckpt.wait(deadline_s=0.2) is False
+            assert time.perf_counter() - t0 < 5.0
+            timeouts = [e for e in events.read_events()
+                        if e["name"] == "tik_checkpoint_wait_timeout"]
+            assert timeouts and timeouts[-1]["op"] == "wait"
+        finally:
+            release.set()
+            events.uninstall()
+
+    def test_unbounded_wait_and_errors_passthrough(self, tmp_path,
+                                                   monkeypatch):
+        from cloudtik_tpu.train.checkpoint import (
+            CheckpointConfig, Checkpointer)
+
+        ckpt = Checkpointer(CheckpointConfig(
+            directory=str(tmp_path / "ckpt"), save_interval_steps=1))
+        # deadline 0 = the pre-elastic blocking behavior
+        assert ckpt.wait(deadline_s=0) is True
+        assert ckpt.close(deadline_s=5.0) is True
+
+        ckpt2 = Checkpointer(CheckpointConfig(
+            directory=str(tmp_path / "ckpt2"), save_interval_steps=1))
+
+        def boom():
+            raise OSError("storage gone")
+
+        monkeypatch.setattr(ckpt2._manager, "wait_until_finished", boom)
+        # helper-thread errors re-raise in the caller, not swallowed
+        with pytest.raises(OSError, match="storage gone"):
+            ckpt2.wait(deadline_s=5.0)
+
+    def test_config_default_deadline_applies(self, tmp_path,
+                                             monkeypatch):
+        from cloudtik_tpu.train.checkpoint import (
+            CheckpointConfig, Checkpointer)
+
+        ckpt = Checkpointer(CheckpointConfig(
+            directory=str(tmp_path / "ckpt"), save_interval_steps=1,
+            wait_deadline_s=0.2))
+        release = threading.Event()
+        monkeypatch.setattr(
+            ckpt._manager, "wait_until_finished",
+            lambda: release.wait(30.0))
+        try:
+            assert ckpt.wait() is False       # config deadline kicks in
+        finally:
+            release.set()

@@ -201,6 +201,14 @@ def test_seams_are_noops_without_a_plan(monkeypatch, tmp_path):
     finally:
         pf.close()
 
+    # elastic membership poll (elastic.slice_lost, fired once per
+    # known slice) + the re-mesh boundary seam (elastic.remesh)
+    from cloudtik_tpu.train.elastic import (
+        ElasticCoordinator, fire_remesh_seam)
+    coordinator = ElasticCoordinator(lambda: {0, 1}, num_slices=2)
+    assert coordinator.poll(0) is None
+    fire_remesh_seam((0, 1), (0,), "slice_lost")
+
     # local executor
     from cloudtik_tpu.control.executor.local import LocalCommandExecutor
 

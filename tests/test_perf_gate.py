@@ -215,6 +215,41 @@ class TestSpecTrajectoryIsolation:
         assert report["mode"] == "spec"
 
 
+class TestElasticityTrajectoryIsolation:
+    """Elasticity dryrun records (elasticity_bench.py) carry
+    mode="elasticity" and form their own trajectory, exactly like
+    spec/cpu_dryrun."""
+
+    def test_gate_excludes_elasticity_from_other_medians(
+            self, perf_gate, tmp_path):
+        _trajectory(tmp_path, [48.0, 47.0],
+                    metric="llama1b_train_mfu_bf16_seq2048")
+        mislabeled = tmp_path / "BENCH_r10.json"
+        mislabeled.write_text(json.dumps({"parsed": {
+            "metric": "llama1b_train_mfu_bf16_seq2048", "value": 0.9,
+            "mode": "elasticity"}}))
+        paths = [str(p) for p in tmp_path.glob("BENCH_*.json")]
+        history = perf_gate.load_history(
+            paths, metric="llama1b_train_mfu_bf16_seq2048")
+        assert sorted(v for _p, v in history) == [47.0, 48.0]
+
+    def test_elastic_metric_forms_its_own_trajectory(self, perf_gate,
+                                                     tmp_path):
+        record = {"parsed": {
+            "metric": "elastic_recovered_wall_fraction",
+            "value": 0.5, "mode": "elasticity"}}
+        (tmp_path / "BENCH_r10.json").write_text(json.dumps(record))
+        paths = [str(p) for p in tmp_path.glob("BENCH_*.json")]
+        history = perf_gate.load_history(
+            paths, metric="elastic_recovered_wall_fraction")
+        assert [v for _p, v in history] == [0.5]
+        code, report = perf_gate.gate(
+            {"metric": "elastic_recovered_wall_fraction",
+             "value": 0.48, "mode": "elasticity"}, history, 10.0)
+        assert code == 0
+        assert report["mode"] == "elasticity"
+
+
 class TestCpuDryrunFallback:
     """Open item 3 first step: a probe failure must never record 0.0
     again — bench.py falls back to a labeled CPU-dryrun measurement,

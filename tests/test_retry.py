@@ -159,3 +159,28 @@ def test_retry_sleep_is_fault_injectable():
                                    jitter=0.0),
                 sleep=lambda s: None)
     assert plan.trace and plan.trace[0]["seam"] == "utils.retry"
+
+
+def test_run_with_deadline_completes_times_out_and_reraises():
+    """The deadline primitive behind Checkpointer.wait/close: bounded
+    wait on calls that take no timeout of their own."""
+    import threading
+    import time as _time
+
+    from cloudtik_tpu.utils.retry import run_with_deadline
+
+    assert run_with_deadline(lambda: 42, 5.0) == (True, 42)
+    # deadline 0 = unbounded, runs inline
+    assert run_with_deadline(lambda: 7, 0) == (True, 7)
+
+    release = threading.Event()
+    t0 = _time.perf_counter()
+    finished, result = run_with_deadline(
+        lambda: release.wait(30.0), 0.1)
+    assert finished is False and result is None
+    assert _time.perf_counter() - t0 < 5.0
+    release.set()
+
+    # helper-thread exceptions re-raise in the caller
+    with pytest.raises(KeyError):
+        run_with_deadline(lambda: {}["missing"], 1.0)

@@ -31,7 +31,9 @@ cloudtik_tpu/telemetry/names.py:
      the source resolves against the registry in the
      cloudtik_tpu/faults/seams.py docstring AND the seam table in
      docs/fault-injection.md (a seam nobody documented cannot be
-     drilled);
+     drilled) — and BOTH directions: registry rows, docs rows, and
+     fire sites must agree exactly (a registered seam nobody fires or
+     documents is a drill surface that does not exist);
   10. the SLO catalog (telemetry/slo.py default_slos): SLO names are
      unique, every referenced metric resolves against the catalog, and
      docs/observability.md documents every SLO by name;
@@ -232,11 +234,13 @@ def run_checks() -> List[str]:
         for cell in re.findall(r"^\|([^|]*)\|", fault_doc,
                                re.MULTILINE)
         for name in re.findall(rf"`({_name})`", cell)}
+    fired_seams = set()
     for path, text in sources.items():
         if path.endswith(seams_path):
             continue
         for m in seam_re.finditer(text):
             seam = m.group(1)
+            fired_seams.add(seam)
             rel = os.path.relpath(path, REPO_ROOT)
             if seam not in registered_seams:
                 errors.append(f"{rel}: seam {seam!r} is not registered "
@@ -244,6 +248,21 @@ def run_checks() -> List[str]:
             if seam not in documented_seams:
                 errors.append(f"{rel}: seam {seam!r} is not documented "
                               "in docs/fault-injection.md")
+    # ... and BOTH directions: registry rows, doc rows, and fire sites
+    # must agree exactly — a registered seam nobody documents (or
+    # documents but never fires) is a drill surface that does not
+    # exist.
+    for seam in sorted(registered_seams - documented_seams):
+        errors.append(f"seam {seam!r} is registered in faults/seams.py "
+                      "but missing from docs/fault-injection.md's "
+                      "seam table")
+    for seam in sorted(documented_seams - registered_seams):
+        errors.append(f"seam {seam!r} is documented in docs/"
+                      "fault-injection.md but not registered in the "
+                      "faults/seams.py docstring")
+    for seam in sorted(registered_seams - fired_seams):
+        errors.append(f"registered seam {seam!r} has no seams.fire "
+                      "site in cloudtik_tpu source")
 
     # 5. grafana dashboards + prometheus alert rules resolve — against
     # METRICS only: an event is a journal record, never a Prometheus

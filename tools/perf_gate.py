@@ -87,6 +87,11 @@ def load_history(paths: List[str],
             # (serving_*_spec); they must never feed the spec-off
             # serving median even if mislabeled
             continue
+        if parsed.get("mode") == "elasticity" and \
+                "elastic" not in str(metric or ""):
+            # elasticity dryrun records (elasticity_bench.py) form
+            # their own trajectory (elastic_*); same isolation rule
+            continue
         out.append((path, float(parsed["value"])))
     return out
 
@@ -114,7 +119,7 @@ def gate(fresh: Dict[str, Any], history: List[Tuple[str, float]],
     value = float(parsed["value"])
     floor = baseline * (1.0 - threshold_pct / 100.0)
     report.update(metric=parsed.get("metric"), value=value, floor=floor)
-    if parsed.get("mode") in ("cpu_dryrun", "spec"):
+    if parsed.get("mode") in ("cpu_dryrun", "spec", "elasticity"):
         report["mode"] = parsed["mode"]   # labeled own-trajectory mode
     if value < floor:
         drop = (baseline - value) / baseline * 100.0
