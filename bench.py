@@ -233,7 +233,7 @@ SUITES = {
 }
 
 
-def run_suite(name: str) -> int:
+def run_suite(name: str, extra_args=()) -> int:
     repo_root = os.path.dirname(os.path.abspath(__file__))
     script = os.path.join(repo_root, "benchmarks", SUITES[name])
     # uninstalled checkouts: the child's sys.path[0] is benchmarks/,
@@ -241,7 +241,8 @@ def run_suite(name: str) -> int:
     env = dict(os.environ)
     env["PYTHONPATH"] = repo_root + (
         os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
-    return subprocess.call([sys.executable, script], env=env)
+    return subprocess.call([sys.executable, script, *extra_args],
+                           env=env)
 
 
 # --------------------------------------------------------- CPU dryrun --
@@ -321,13 +322,25 @@ def main(argv=None):
         help="which benchmark to run; non-flagship suites need no "
              "device probe (they run on CPU and TPU alike)")
     parser.add_argument(
+        "--workload", default=None,
+        help="forwarded to the serving suite (e.g. disagg — the "
+             "disaggregated prefill/decode comparison)")
+    parser.add_argument(
         "--cpu-dryrun", action="store_true",
         help=argparse.SUPPRESS)   # internal: the probe-failure child
     args = parser.parse_args(argv)
     if args.cpu_dryrun:
         return run_cpu_dryrun_child()
+    if args.workload and args.suite != "serving":
+        # also covers the default flagship suite: silently running the
+        # MFU bench while the user asked for a serving workload would
+        # be worse than refusing
+        parser.error("--workload only applies to --suite serving")
     if args.suite != "flagship":
-        return run_suite(args.suite)
+        extra = []
+        if args.workload:
+            extra += ["--workload", args.workload]
+        return run_suite(args.suite, extra)
 
     # Watchdog: a wedged device grant (the axon tunnel can stick for a
     # while after a killed TPU process) would otherwise hang forever with

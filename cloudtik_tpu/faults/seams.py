@@ -24,6 +24,7 @@ Seam registry (keep docs/fault-injection.md in sync):
   events.append                   flight recorder append {name, path}    supports torn_write
   serve.reqlog.append             request ledger append {name, path}     supports torn_write
   serve.kvcache.alloc             KV block pool alloc   {need, free, evictable}  raise -> pool exhausted
+  serve.kvcache.migrate           KV block export, per block chunk {request, seq, blocks}  raise -> transfer torn, request degrades to re-prefill
   serve.spec.verify               speculative verify    {request, width}  raise -> request degrades to plain decode
   train.prefetch.next             prefetcher hand-off   {qsize}         latency -> data_wait
   elastic.slice_lost              coordinator membership poll {slice, step}  drop -> slice treated as lost

@@ -271,6 +271,31 @@ def copy_block(kp: jax.Array, vp: jax.Array, src, dst
     return kp, vp
 
 
+def gather_block_planes(kp: jax.Array, vp: jax.Array, table: jax.Array
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """Pull a table's raw block planes out of the pool (KV-block
+    export: migration serializes these at block granularity).
+
+    kp/vp [L, N, bs, Hkv, Dh], table [M] int32 -> k/v [L, M, bs, Hkv,
+    Dh].  Callers pad `table` to a fixed width with the null block so
+    the program compiles once; null-block rows carry garbage the
+    caller slices off on the host."""
+    return kp[:, table], vp[:, table]
+
+
+def scatter_block_planes(kp: jax.Array, vp: jax.Array, table: jax.Array,
+                         k: jax.Array, v: jax.Array
+                         ) -> Tuple[jax.Array, jax.Array]:
+    """Write exported block planes into a (different) pool — the KV
+    import half of migration.  table [M] int32, k/v [L, M, bs, Hkv,
+    Dh].  Padding entries point at the null block, whose contents are
+    garbage by construction, so one fixed-width program covers every
+    import."""
+    kp = kp.at[:, table].set(k.astype(kp.dtype))
+    vp = vp.at[:, table].set(v.astype(vp.dtype))
+    return kp, vp
+
+
 def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
             top_k: int) -> jax.Array:
     """logits [B, V] -> token ids [B]."""

@@ -168,6 +168,22 @@ def ai_workload_dashboard() -> Dict[str, Any]:
         _panel(32, "Verify rounds",
                "rate(tik_serve_spec_verify_steps_total[5m])",
                "ops", 12, 108),
+        # -- KV migration row: disaggregated roles + preemption salvage ---
+        {"id": 33, "type": "row", "title": "KV-block migration",
+         "collapsed": False,
+         "gridPos": {"h": 1, "w": 24, "x": 0, "y": 116}, "panels": []},
+        _panel(34, "Migrations by direction",
+               "rate(tik_serve_kv_migrations_total[5m])", "ops",
+               0, 117),
+        _panel(35, "Migrated tokens (KV moved, not recomputed)",
+               "rate(tik_serve_kv_migrated_tokens_total[5m])",
+               "short", 12, 117),
+        _panel(36, "Migration failures (degraded to re-prefill)",
+               "rate(tik_serve_kv_migration_failures_total[5m])",
+               "ops", 0, 125),
+        _panel(37, "Preempted tokens (prefill work at stake)",
+               "rate(tik_serve_preempted_tokens_total[5m])",
+               "short", 12, 125),
     ]
     return {
         "uid": "tik-ai-workloads",

@@ -1399,6 +1399,11 @@ def serve_requests(path, tail_n, since_s, finish_filter, as_stats,
             click.echo(f"{label:<12} {entry['count']:>7} "
                        f"{_ms(entry['p50'])} {_ms(entry['p95'])} "
                        f"{_ms(entry['p99'])}")
+        if stats.get("migrations"):
+            click.echo(
+                f"migration: imports {stats['migrations']}  "
+                f"tokens {stats['migrated_tokens']}  "
+                f"(KV moved between engines instead of recomputed)")
         if stats.get("spec_steps"):
             rate = stats.get("spec_acceptance_rate")
             rate_s = f"{rate * 100:.1f}%" if rate is not None else "-"

@@ -1,0 +1,131 @@
+"""Disaggregated prefill/decode serving: two engines, one request path.
+
+The DistServe/Splitwise architecture on this repo's paged engine:
+chunked prefill and paged decode are already separate code paths in
+`serve/engine.py` — this module splits them across *engines* so
+prompt-heavy and decode-heavy load scale independently:
+
+  * the **prefill role** is a `DecodeEngine` handed a
+    :class:`~cloudtik_tpu.serve.migration.BlockMigrator`: it runs
+    chunked prefill only (its loop never sees a decoding slot) and, at
+    prompt completion, exports the request's KV blocks + first token
+    through the migration transport, freeing the lane for the next
+    prompt immediately;
+  * the **decode role** is a plain `DecodeEngine` fed through
+    `import_blocks()`: imported planes scatter into its own pool,
+    full prompt blocks register in its prefix map, and the slot starts
+    decoding from the first token — no prefill work competes with its
+    decode steps.
+
+:class:`DisaggServing` wires the pair with an in-process
+:class:`~cloudtik_tpu.serve.migration.LoopbackTransport`; because the
+transport is dumb bytes, a DCN socket transport later moves the decode
+role to another host without changing either engine.  Requests submit
+to the prefill role; a mid-transfer `serve.kvcache.migrate` fault
+degrades the request to a plain submit on the decode role (re-prefill
+there — the decode engine keeps full prefill capability exactly for
+this fallback), so a torn transfer costs recompute, never the request.
+
+Budgeting rule of thumb (docs/operations.md): prefill-role slots and
+blocks turn over per-prompt (held for one prefill, then exported and
+freed), so the decode role should hold most of the block budget; a
+deep prefill queue with idle decode slots means the roles are
+mis-split — scale them independently, that is the point.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from cloudtik_tpu.serve import migration
+from cloudtik_tpu.serve.engine import DecodeEngine, Request
+
+
+class DisaggServing:
+    """One prefill-role + one decode-role engine behind a submit().
+
+    Drop-in for a `DecodeEngine` where callers only submit/generate:
+    `submit()` routes to the prefill role, completion (and the request
+    ledger record) happens on the decode role.  `transport_factory`
+    builds the sender-side transport from the receiver callable —
+    defaults to the in-process loopback; a DCN socket factory is the
+    one thing a cross-host deployment swaps."""
+
+    def __init__(self, params, cfg, prefill_config, decode_config,
+                 transport_factory=None, rng=None):
+        self._inbox = migration.MigrationInbox(self._deliver)
+        factory = transport_factory or migration.LoopbackTransport
+        transport = factory(self._inbox.feed)
+        migrator = migration.BlockMigrator(transport,
+                                           fallback=self._fallback)
+        self.prefill = DecodeEngine(params, cfg, prefill_config,
+                                    rng=rng, migrator=migrator)
+        self.decode = DecodeEngine(params, cfg, decode_config, rng=rng,
+                                   role="decode")
+        # requests in flight between export and import, by id — the
+        # loopback's out-of-band handoff of the live Request object (a
+        # cross-host receiver would instead build a Request from the
+        # migration header and wire its own completion)
+        self._pending: Dict[int, Request] = {}
+        self._lock = threading.Lock()
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        self.decode.start()
+        self.prefill.start()
+
+    def stop(self) -> None:
+        self.prefill.stop()
+        self.decode.stop()
+        with self._lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for req in pending:
+            req.cancel()
+
+    # -- request path -----------------------------------------------------
+    def submit(self, request: Request) -> Request:
+        # the prefill role only charges the PROMPT footprint (its
+        # blocks are exported and freed at prompt completion), so the
+        # decode role's worst case is checked here, up front — before
+        # any prefill work is spent on a request that could never
+        # finish (and so the client still gets the 413-mapped reject)
+        rejected = self.decode._submit_check(request,
+                                             prompt_only=False)
+        if rejected is not None:
+            self.decode._finish_request(request, "rejected", rejected)
+            return request
+        with self._lock:
+            # purge entries whose request already finished on the
+            # prefill role (rejected/cancelled before migration)
+            for rid in [r for r, q in self._pending.items()
+                        if q._done.is_set()]:
+                del self._pending[rid]
+            self._pending[request.request_id] = request
+        return self.prefill.submit(request)
+
+    def generate(self, prompt, **kw):
+        """Convenience: submit + wait (mirrors DecodeEngine)."""
+        return self.submit(Request(prompt, **kw)).wait(timeout=600)
+
+    # -- migration plumbing (runs on the prefill engine's loop thread) ----
+    def _claim(self, request_id: int) -> Optional[Request]:
+        with self._lock:
+            return self._pending.pop(request_id, None)
+
+    def _deliver(self, header: Dict[str, Any], k: np.ndarray,
+                 v: np.ndarray) -> None:
+        req = self._claim(int(header["request_id"]))
+        if req is None:
+            return          # finished/cancelled while in flight
+        self.decode.import_blocks(req, header, k, v)
+
+    def _fallback(self, req: Request) -> None:
+        """Degrade path for a torn transfer: plain re-prefill submit on
+        the decode role (it keeps full prefill capability for exactly
+        this)."""
+        self._claim(req.request_id)
+        self.decode.submit(req)

@@ -34,9 +34,22 @@ Memory model (PagedAttention, Kwon et al., SOSP'23 — serve/kvcache.py):
   (`pool.needs_copy` + a device block copy) guards any shared block an
   append would mutate.
 * EXHAUSTION: a full pool queues new admissions and preempts/requeues
-  the NEWEST in-flight request (recompute-style preemption) — the
-  oldest request always progresses, and the loop never crashes.  The
-  `serve.kvcache.alloc` fault seam injects exhaustion for drills.
+  the NEWEST in-flight request — the victim's computed prompt blocks
+  are SALVAGED into the evictable prefix LRU first (a move, not a
+  throw-away: re-admission is a prefix-cache hit, only the prompt tail
+  re-prefills) — the oldest request always progresses, and the loop
+  never crashes.  The `serve.kvcache.alloc` fault seam injects
+  exhaustion for drills.
+* MIGRATION / DISAGGREGATION (serve/migration.py + serve/disagg.py):
+  a prefill-role engine (`DecodeEngine(migrator=...)`) exports a
+  finished prompt's KV blocks — serialized at block granularity —
+  through a pluggable transport instead of decoding; a decode-role
+  engine imports them (`import_blocks`) into its own pool and decodes
+  from the header's first token.  TTFT stamps at import, imported full
+  prompt blocks register in the decode-side prefix map, and greedy
+  output is bit-identical to one monolithic engine.  A fault at the
+  `serve.kvcache.migrate` seam mid-transfer degrades that request to
+  a plain re-prefill submit on the decode role — never lost.
 * SPECULATIVE DECODING (Leviathan et al., ICML'23 — EngineConfig.spec
   + DecodeEngine(draft=...)): a small draft transformer proposes k
   greedy tokens per round (one fused `lax.scan` dispatch against a
@@ -74,7 +87,7 @@ import numpy as np
 from cloudtik_tpu import telemetry
 from cloudtik_tpu.faults import seams
 from cloudtik_tpu.faults.plan import FaultInjected
-from cloudtik_tpu.serve import kvcache, reqlog
+from cloudtik_tpu.serve import kvcache, migration, reqlog
 from cloudtik_tpu.serve.kvcache import BlockPool, BlockPoolExhausted
 from cloudtik_tpu.telemetry import events, goodput
 from cloudtik_tpu.telemetry import instruments as ti
@@ -204,6 +217,9 @@ class Request:
         self.prefix_tokens: int = 0           # prompt tokens not recomputed
         self.prefill_chunks: int = 0          # chunks the prompt took
         self.preemptions: int = 0             # pool-exhaustion requeues
+        # KV-block migration accounting (serve/migration.py)
+        self.migrations: int = 0              # completed imports
+        self.migrated_tokens: int = 0         # tokens whose KV moved
         # speculative decoding accounting (request-ledger fields)
         self.draft_tokens: int = 0            # proposals verified
         self.accepted_tokens: int = 0         # proposals the target kept
@@ -383,7 +399,9 @@ class DecodeEngine:
                  engine_config: Optional[EngineConfig] = None,
                  rng: Optional[jax.Array] = None,
                  draft: Optional[Tuple[Params, TransformerConfig]]
-                 = None):
+                 = None,
+                 migrator: Optional[migration.BlockMigrator] = None,
+                 role: Optional[str] = None):
         self.params = params
         self.cfg = cfg
         self.ec = engine_config or EngineConfig()
@@ -394,10 +412,16 @@ class DecodeEngine:
         # table covers max_len even when block_size doesn't divide it)
         self._blocks_per_req = kvcache.blocks_for(T, bs)
         self._capacity_tokens = self._blocks_per_req * bs
+        # serve gauges carry a `role` label so two engines in one
+        # process (a disaggregated prefill/decode pair) never
+        # overwrite each other's series — monolithic engines report
+        # role="engine"
+        self._role = role if role is not None else (
+            "prefill" if migrator is not None else "engine")
         num_blocks = self.ec.num_blocks
         if num_blocks is None:
             num_blocks = B * self._blocks_per_req + 1   # + null block
-        self.pool = BlockPool(num_blocks, bs)
+        self.pool = BlockPool(num_blocks, bs, role=self._role)
         # bucket ladder = chunk-size ladder: it must cover the largest
         # prefill chunk, so extend the configured rungs by doubling
         buckets = sorted({b for b in self.ec.prefill_buckets if b <= T})
@@ -440,8 +464,28 @@ class DecodeEngine:
         self._prefill_chunk = jax.jit(_prefill_chunk)
         self._copy_block = jax.jit(G.copy_block)
 
+        # -- KV-block migration (serve/migration.py) -------------------
+        # prefill role: `migrator` set — a finished prefill exports its
+        # blocks through the transport instead of decoding here.
+        # decode role: `import_blocks()` feeds `_imports`; the loop
+        # scatters arrived planes into this pool and decodes from the
+        # first token.  Gather/scatter tables are padded to the fixed
+        # per-request width (null-block entries move only garbage), so
+        # each program compiles exactly once.
+        self._migrator = migrator
+        self._imports: "queue.Queue[Tuple[Request, Dict[str, Any], Any, Any]]" \
+            = queue.Queue()
+        self._pending_imports: "collections.deque" = collections.deque()
+        self._gather_blocks = jax.jit(G.gather_block_planes)
+        self._scatter_blocks = jax.jit(G.scatter_block_planes)
+
         # -- draft-model speculative decoding (EngineConfig.spec) ------
         self._spec = self.ec.spec
+        if self._spec is not None and migrator is not None:
+            raise ValueError(
+                "a prefill-role engine (migrator=...) never decodes, "
+                "so EngineConfig.spec would only waste draft prefills "
+                "— configure spec on the decode role instead")
         if self._spec is not None:
             if draft is None:
                 raise ValueError(
@@ -489,30 +533,44 @@ class DecodeEngine:
             self._spec_verifies = 0
 
     # -- public ----------------------------------------------------------
-    def submit(self, request: Request) -> Request:
+    def _submit_check(self, request: Request,
+                      prompt_only: Optional[bool] = None
+                      ) -> Optional[RequestRejected]:
+        """Submit-time feasibility in KV-pool-capacity terms; None
+        when schedulable.  A PREFILL-ROLE engine (migrator set) only
+        ever holds the prompt blocks — prefill → export → free — so
+        it charges the prompt-only footprint; the decode side's worst
+        case is the composer's to check against the decode engine
+        (`DisaggServing.submit` does, with ``prompt_only=False``)."""
         if not request.prompt:
-            self._finish_request(
-                request, "rejected",
-                RequestRejected("empty prompt", reason="empty_prompt"))
-            return request
+            return RequestRejected("empty prompt",
+                                   reason="empty_prompt")
+        if prompt_only is None:
+            prompt_only = self._migrator is not None
         bs = self.ec.block_size
-        total = len(request.prompt) + request.max_new_tokens
+        total = len(request.prompt) + (
+            0 if prompt_only else request.max_new_tokens)
+        what = "prompt" if prompt_only else "prompt+max_new"
         need = kvcache.blocks_for(total, bs)
         if total > self._capacity_tokens:
-            self._finish_request(request, "rejected", RequestRejected(
-                f"prompt+max_new ({len(request.prompt)} + "
-                f"{request.max_new_tokens} = {total} tokens) needs "
-                f"{need} KV blocks of {bs} tokens; per-request "
-                f"block-table capacity is {self._blocks_per_req} "
-                f"blocks ({self._capacity_tokens} tokens)"))
-            return request
+            return RequestRejected(
+                f"{what} ({total} tokens) needs {need} KV blocks of "
+                f"{bs} tokens; per-request block-table capacity is "
+                f"{self._blocks_per_req} blocks "
+                f"({self._capacity_tokens} tokens)")
         if need > self.pool.usable_blocks:
-            self._finish_request(request, "rejected", RequestRejected(
-                f"prompt+max_new ({total} tokens) needs {need} KV "
-                f"blocks of {bs} tokens, but the engine's whole pool "
-                f"holds {self.pool.usable_blocks} usable blocks "
+            return RequestRejected(
+                f"{what} ({total} tokens) needs {need} KV blocks of "
+                f"{bs} tokens, but the engine's whole pool holds "
+                f"{self.pool.usable_blocks} usable blocks "
                 f"({self.pool.usable_blocks * bs} tokens) — the "
-                "request can never be scheduled"))
+                "request can never be scheduled")
+        return None
+
+    def submit(self, request: Request) -> Request:
+        rejected = self._submit_check(request)
+        if rejected is not None:
+            self._finish_request(request, "rejected", rejected)
             return request
         request._engine = self
         with telemetry.span("serve.enqueue",
@@ -521,7 +579,8 @@ class DecodeEngine:
             request.traceparent = getattr(span, "traceparent", None)
             self._queue.put(request)
         ti.SERVE_QUEUE_DEPTH.set(self._queue.qsize()
-                                 + len(self._waiting))
+                                 + len(self._waiting),
+                                 role=self._role)
         self._wake.set()
         return request
 
@@ -624,7 +683,23 @@ class DecodeEngine:
                 break
             self._finish_request(req, "error", RuntimeError(reason),
                                  finish=reqlog.FINISH_DRAINED)
-        ti.SERVE_QUEUE_DEPTH.set(0)
+        # migrated-in requests waiting for import (absent on partially
+        # constructed engines, e.g. tests driving a bare __new__)
+        pending = getattr(self, "_pending_imports", None)
+        while pending:
+            self._finish_request(pending.popleft()[0], "error",
+                                 RuntimeError(reason),
+                                 finish=reqlog.FINISH_DRAINED)
+        imports = getattr(self, "_imports", None)
+        while imports is not None:
+            try:
+                req = imports.get_nowait()[0]
+            except queue.Empty:
+                break
+            self._finish_request(req, "error", RuntimeError(reason),
+                                 finish=reqlog.FINISH_DRAINED)
+        ti.SERVE_QUEUE_DEPTH.set(0, role=getattr(self, "_role",
+                                                 "engine"))
 
     def _teardown(self, reason: str = "engine stopped") -> None:
         """Fail everything still queued or mid-decode — callers must not
@@ -650,14 +725,37 @@ class DecodeEngine:
             row[:len(slot.table)] = slot.table
 
     def _release_slot(self, slot_id: int) -> None:
-        """Return a slot's blocks to the pool and clear its lane."""
+        """Return a slot's blocks to the pool and clear its lane.
+
+        Released in REVERSE table order: prefix-registered blocks park
+        on the evictable LRU in release order, and chain keys only
+        match behind an intact head — parking the chain TAIL as the
+        eviction-first entry means partial eviction leaves a usable
+        prefix instead of a headless chain."""
         slot = self._slots[slot_id]
         if slot is None:
             return
         self._slots[slot_id] = None
-        self.pool.release(slot.table)
+        self.pool.release(list(reversed(slot.table)))
         slot.table = []
         self._sync_table(slot_id)
+
+    def _stamp_first_token(self, slot_id: int, slot: _Slot,
+                           first_tok: int) -> None:
+        """The first generated token becomes visible: append it, stamp
+        TTFT, seed the device-side token/length lanes.  ONE
+        implementation for the monolithic prefill-completion path and
+        the migration import path — the two must never diverge on
+        TTFT/ledger parity (imported requests stamp at IMPORT)."""
+        req = slot.request
+        req.tokens.append(first_tok)
+        req.first_token_time = time.time()
+        req.first_token_mono = time.monotonic()
+        ti.SERVE_TTFT.observe(req.first_token_time - req.created)
+        ti.SERVE_TOKENS.inc()
+        slot.length = slot.true_len
+        self._tokens = self._tokens.at[slot_id].set(first_tok)
+        self._lengths = self._lengths.at[slot_id].set(slot.true_len)
 
     def _newest_slot(self) -> Optional[int]:
         """The most recently admitted occupied slot (preemption victim
@@ -673,9 +771,21 @@ class DecodeEngine:
 
     def _preempt(self, slot_id: int) -> None:
         """Pool exhausted: evict this slot's request and requeue it at
-        the admission front (recompute-on-readmit, vLLM-style)."""
+        the admission front.  The victim's computed prompt blocks are
+        SALVAGED, not thrown away: registering them in the prefix map
+        before release parks them on the evictable prefix LRU (a move
+        — same blocks, new owner), so re-admission is a prefix-cache
+        hit and only the prompt tail re-prefills.  Under real pressure
+        the allocator may still evict them — then re-admission pays
+        the full re-prefill, exactly the old behavior."""
         slot = self._slots[slot_id]
         req = slot.request
+        # prompt tokens whose prefill work is at stake right now
+        at_stake = min(slot.prefill_pos, slot.true_len)
+        salvaged = 0
+        if self.ec.prefix_cache and at_stake >= self.ec.block_size:
+            salvaged = self.pool.register_prefix(
+                req.prompt[:at_stake], slot.table)
         self._release_slot(slot_id)
         req.tokens.clear()
         req.admitted = None
@@ -684,12 +794,17 @@ class DecodeEngine:
         req.first_token_mono = None
         req.preemptions += 1
         ti.SERVE_PREEMPTIONS.inc()
+        if at_stake:
+            ti.SERVE_PREEMPTED_TOKENS.inc(at_stake)
         with telemetry.trace_context(req.traceparent):
             events.emit("tik_serve_preemption", request=req.request_id,
-                        slot=slot_id, preemptions=req.preemptions)
+                        slot=slot_id, preemptions=req.preemptions,
+                        tokens_at_stake=at_stake,
+                        blocks_salvaged=salvaged)
         self._waiting.appendleft(req)
         ti.SERVE_QUEUE_DEPTH.set(self._queue.qsize()
-                                 + len(self._waiting))
+                                 + len(self._waiting),
+                                 role=self._role)
 
     def _alloc_blocks(self, slot_id: int, n: int) -> Optional[List[int]]:
         """Allocate n blocks for the slot, preempting the newest other
@@ -735,6 +850,205 @@ class DecodeEngine:
         slot.table[j] = fresh[0]
         self._sync_table(slot_id)
         return True
+
+    # -- KV-block migration (serve/migration.py) --------------------------
+    def _migrate_out(self, slot_id: int, slot: _Slot,
+                     first_tok: int) -> None:
+        """Prefill role: export this slot's prompt KV blocks through
+        the migrator and free the lane — the request lives on wherever
+        the transport delivered it.  A fault mid-transfer degrades the
+        request to the migrator's fallback (re-prefill on the decode
+        role, stamps reset like a preemption); it is never lost."""
+        req = slot.request
+        bs = self.ec.block_size
+        covered = kvcache.blocks_for(slot.true_len, bs)
+        table = list(slot.table[:covered])
+        with telemetry.trace_context(req.traceparent):
+            with telemetry.span("serve.kvcache.migrate",
+                                request=req.request_id,
+                                tokens=slot.true_len, blocks=covered):
+                padded = np.full((self._blocks_per_req,),
+                                 kvcache.NULL_BLOCK, np.int32)
+                padded[:covered] = table
+                k, v = self._gather_blocks(self._kp, self._vp,
+                                           jnp.asarray(padded))
+                k = np.asarray(k)[:, :covered]
+                v = np.asarray(v)[:, :covered]
+                try:
+                    self._migrator.export(
+                        req, first_token=first_tok,
+                        length=slot.true_len, k=k, v=v, block_size=bs)
+                except (FaultInjected, migration.MigrationError,
+                        OSError) as e:
+                    ti.SERVE_KV_MIGRATION_FAILURES.inc()
+                    events.emit("tik_serve_migration",
+                                request=req.request_id,
+                                direction="out", result="failed",
+                                tokens=slot.true_len, error=str(e))
+                    self._release_slot(slot_id)
+                    req.admitted = None
+                    req.admitted_mono = None
+                    fallback = self._migrator.fallback
+                    if fallback is not None:
+                        fallback(req)
+                    else:
+                        self._finish_request(req, "error", e)
+                    return
+            ti.SERVE_KV_MIGRATIONS.inc(direction="out")
+            ti.SERVE_KV_MIGRATED_TOKENS.inc(slot.true_len,
+                                            direction="out")
+            events.emit("tik_serve_migration", request=req.request_id,
+                        direction="out", result="ok",
+                        tokens=slot.true_len, blocks=covered)
+        # release AFTER the export: registered full prompt blocks park
+        # on the evictable LRU, keeping this role's prefix cache warm
+        self._release_slot(slot_id)
+
+    def import_blocks(self, request: Request, header: Dict[str, Any],
+                      k: np.ndarray, v: np.ndarray) -> Request:
+        """Thread-safe: queue a migrated-in request for the loop thread
+        to import (decode role).  `k`/`v` are the exported planes
+        ``[L, M, bs, Hkv, Dh]`` in table order; `request` is the live
+        Request the caller owns (loopback hands the original object
+        over; a cross-host receiver constructs one from the header)."""
+        request._engine = self
+        self._imports.put((request, header, k, v))
+        self._wake.set()
+        return request
+
+    def _import_tick(self) -> None:
+        """Decode role: admit migrated-in requests.  Imported planes
+        scatter into this pool at block granularity, full prompt
+        blocks register in the prefix map (shared prefixes keep
+        hitting across roles), and the slot starts DECODING from the
+        header's first token — no prefill here; that is the point of
+        the split.  Exhaustion leaves imports queued FIFO, exactly
+        like `_admit`; the oldest import lands first."""
+        while True:
+            try:
+                self._pending_imports.append(
+                    self._imports.get_nowait())
+            except queue.Empty:
+                break
+        while self._pending_imports:
+            req, header, k, v = self._pending_imports[0]
+            if req._done.is_set():
+                self._pending_imports.popleft()
+                continue
+            if req._cancel:
+                self._pending_imports.popleft()
+                self._finish_request(
+                    req, "cancelled",
+                    RequestCancelled("request cancelled"))
+                continue
+            slot_id = next((i for i, s in enumerate(self._slots)
+                            if s is None), None)
+            if slot_id is None:
+                break
+            bs = self.ec.block_size
+            true_len = int(header["length"])
+            n_blocks = int(k.shape[1])
+            total = true_len + req.max_new_tokens
+            if int(header["block_size"]) != bs \
+                    or total > self._capacity_tokens \
+                    or kvcache.blocks_for(total, bs) \
+                    > self.pool.usable_blocks:
+                # never-schedulable HERE (geometry mismatch, or a
+                # worst case this pool can never hold): fail it now —
+                # a FIFO head waiting for blocks that cannot exist
+                # would wedge every later import behind it
+                self._pending_imports.popleft()
+                self._finish_request(req, "error", RequestRejected(
+                    f"migrated request carries {n_blocks} blocks of "
+                    f"{header['block_size']} tokens and needs {total} "
+                    f"tokens worst-case; this engine holds "
+                    f"{self.pool.usable_blocks} usable blocks of "
+                    f"{bs} tokens ({self._capacity_tokens} tokens "
+                    "per request)"))
+                continue
+            # identical prefix blocks already cached HERE are reused
+            # (a shared prompt imports once); only tail planes
+            # scatter.  count=False: these tokens arrived computed,
+            # so the reuse saves transfer, not prefill recompute —
+            # and the blocked-retry path re-matches every tick
+            reuse_blocks: List[int] = []
+            if self.ec.prefix_cache:
+                reuse_blocks, _ = self.pool.match_prefix(
+                    req.prompt, count=False)
+            start = len(reuse_blocks)
+            try:
+                fresh = self.pool.alloc(n_blocks - start)
+            except (BlockPoolExhausted, FaultInjected):
+                if reuse_blocks:
+                    self.pool.release(reuse_blocks)
+                break             # wait for blocks, FIFO
+            self._pending_imports.popleft()
+            try:
+                with telemetry.trace_context(req.traceparent):
+                    with telemetry.span("serve.kvcache.import",
+                                        request=req.request_id,
+                                        tokens=true_len,
+                                        blocks=n_blocks - start,
+                                        reused=start):
+                        self._scatter_imported(reuse_blocks + fresh,
+                                               start, k, v)
+                        first_tok = int(header["first_token"])
+                        slot = _Slot(
+                            request=req,
+                            table=reuse_blocks + fresh,
+                            true_len=true_len,
+                            prefill_pos=true_len,
+                            length=true_len,
+                            remaining=req.max_new_tokens - 1,
+                            decoding=True)
+                        if req.admitted is None:   # cross-host import
+                            req.admitted = time.time()
+                            req.admitted_mono = time.monotonic()
+                        req.migrations += 1
+                        req.migrated_tokens += true_len
+                        req.kv_blocks = max(req.kv_blocks,
+                                            len(slot.table))
+                        self._slots[slot_id] = slot
+                        self._sync_table(slot_id)
+                        self._stamp_first_token(slot_id, slot,
+                                                first_tok)
+                        if self.ec.prefix_cache:
+                            self.pool.register_prefix(
+                                req.prompt, slot.table,
+                                start_block=start)
+                    ti.SERVE_KV_MIGRATIONS.inc(direction="in")
+                    ti.SERVE_KV_MIGRATED_TOKENS.inc(true_len,
+                                                    direction="in")
+                    events.emit("tik_serve_migration",
+                                request=req.request_id,
+                                direction="in", result="ok",
+                                tokens=true_len, slot=slot_id,
+                                blocks=n_blocks - start)
+            except Exception as e:   # surface per-request failures
+                if self._slots[slot_id] is not None:
+                    self._release_slot(slot_id)
+                else:     # failed before the slot took ownership
+                    self.pool.release(reuse_blocks + fresh)
+                self._finish_request(req, "error", e)
+
+    def _scatter_imported(self, table: List[int], start: int,
+                          k: np.ndarray, v: np.ndarray) -> None:
+        """Scatter imported planes for `table[start:]` into the pool,
+        padded to the fixed per-request width so the program compiles
+        once (padding rows target the null block — garbage only)."""
+        Bp = self._blocks_per_req
+        bs = self.ec.block_size
+        L, _M, _bs, H, D = k.shape
+        n = len(table) - start
+        pt = np.full((Bp,), kvcache.NULL_BLOCK, np.int32)
+        pt[:n] = table[start:]
+        pk = np.zeros((L, Bp, bs, H, D), k.dtype)
+        pk[:, :n] = k[:, start:]
+        pv = np.zeros((L, Bp, bs, H, D), v.dtype)
+        pv[:, :n] = v[:, start:]
+        self._kp, self._vp = self._scatter_blocks(
+            self._kp, self._vp, jnp.asarray(pt), jnp.asarray(pk),
+            jnp.asarray(pv))
 
     # -- engine loop ------------------------------------------------------
     def _bucket(self, n: int) -> int:
@@ -829,7 +1143,8 @@ class DecodeEngine:
                     self.pool.release(reuse_blocks + fresh)
                 self._finish_request(req, "error", e)
         ti.SERVE_QUEUE_DEPTH.set(self._queue.qsize()
-                                 + len(self._waiting))
+                                 + len(self._waiting),
+                                 role=self._role)
 
     def _prefill_tick(self) -> None:
         """Run ONE prompt chunk for the oldest prefilling slot.  One
@@ -881,24 +1196,22 @@ class DecodeEngine:
                     # prompt complete: the final chunk's last logits
                     # ARE the first generated token
                     first_tok = int(tok)
-                    req.tokens.append(first_tok)
-                    req.first_token_time = time.time()
-                    req.first_token_mono = time.monotonic()
-                    ti.SERVE_TTFT.observe(
-                        req.first_token_time - req.created)
-                    ti.SERVE_TOKENS.inc()
                     if self.ec.prefix_cache:
                         self.pool.register_prefix(
                             req.prompt, slot.table,
                             start_block=req.prefix_blocks)
-                    self._tokens = self._tokens.at[slot_id].set(
-                        first_tok)
-                    self._lengths = self._lengths.at[slot_id].set(
-                        slot.true_len)
-                    slot.length = slot.true_len
-                    if (req.eos_id is not None
-                            and first_tok == req.eos_id) \
-                            or slot.remaining <= 0:
+                    done_now = (req.eos_id is not None
+                                and first_tok == req.eos_id) \
+                        or slot.remaining <= 0
+                    if self._migrator is not None and not done_now:
+                        # prefill role: stream the finished blocks to
+                        # the decode role and free the lane — the
+                        # request's TTFT is stamped at IMPORT, and its
+                        # first token rides the migration header
+                        self._migrate_out(slot_id, slot, first_tok)
+                        return
+                    self._stamp_first_token(slot_id, slot, first_tok)
+                    if done_now:
                         self._release_slot(slot_id)
                         self._finish_request(req, "ok")
                         return
@@ -1151,7 +1464,8 @@ class DecodeEngine:
         # lanes now — count only the ones still occupied as active
         n_spec = sum(1 for i in spec_done
                      if self._slots[i] is not None)
-        ti.SERVE_ACTIVE_SLOTS.set(n_active + n_spec)
+        ti.SERVE_ACTIVE_SLOTS.set(n_active + n_spec,
+                                  role=self._role)
         if n_active == 0:
             return
         seams.fire("serve.decode_step", active=n_active)
@@ -1179,7 +1493,7 @@ class DecodeEngine:
             self._ledger.attribute(goodput.BUCKET_STEP_COMPUTE, busy)
             self._ledger.attribute(goodput.BUCKET_SLOT_IDLE, dt - busy)
             ti.SERVE_SLOT_IDLE_FRACTION.set(
-                1.0 - n_active / self.ec.slots)
+                1.0 - n_active / self.ec.slots, role=self._role)
             # refresh wall/fraction while BUSY too — a saturated
             # engine must not serve stale goodput gauges
             self._ledger.tick()
@@ -1204,6 +1518,7 @@ class DecodeEngine:
             while not self._stop.is_set():
                 try:
                     self._reap_cancelled()
+                    self._import_tick()
                     self._admit()
                     prefilling = any(
                         s is not None and not s.decoding
@@ -1214,13 +1529,16 @@ class DecodeEngine:
                         ti.SERVE_PREFILL_PENDING.set(sum(
                             s.true_len - s.prefill_pos
                             for s in self._slots
-                            if s is not None and not s.decoding))
+                            if s is not None and not s.decoding),
+                            role=self._role)
                     if any(s is not None and s.decoding
                            for s in self._slots):
                         self._step()
                     elif not prefilling \
                             and all(s is None for s in self._slots) \
-                            and self._queue.empty():
+                            and self._queue.empty() \
+                            and not self._pending_imports \
+                            and self._imports.empty():
                         self._wake.wait(timeout=0.5)
                         self._wake.clear()
                         # waiting with no work: fold the gap into idle

@@ -78,7 +78,13 @@ class BlockPool:
     loop thread (the same single-owner rule the device arrays follow).
     """
 
-    def __init__(self, num_blocks: int, block_size: int):
+    def __init__(self, num_blocks: int, block_size: int,
+                 role: str = "engine"):
+        # gauge label: which serving role this pool belongs to
+        # ("engine" monolithic, "prefill"/"decode" disaggregated) —
+        # two pools in one process must not overwrite each other's
+        # utilization series
+        self.role = role
         if num_blocks < 2:
             raise ValueError("num_blocks must be >= 2 (block 0 is the "
                              "reserved null block)")
@@ -199,7 +205,7 @@ class BlockPool:
             parent = key
         return keys
 
-    def match_prefix(self, prompt: Sequence[int]
+    def match_prefix(self, prompt: Sequence[int], count: bool = True
                      ) -> Tuple[List[int], int]:
         """Longest cached full-block prefix of `prompt`.
 
@@ -207,6 +213,11 @@ class BlockPool:
         already incref'd for the caller.  Reuse is capped BELOW the full
         prompt (at least one trailing token is always recomputed) so the
         final prefill chunk can produce the first-token logits.
+
+        ``count=False`` skips the hit/tokens-saved accounting: for
+        callers whose reuse avoids no prefill recompute (the migration
+        import path — those tokens arrived computed) and whose retry
+        loops would otherwise book the same match every engine tick.
         """
         bs = self.block_size
         matched: List[int] = []
@@ -224,7 +235,7 @@ class BlockPool:
             else:
                 self._ref[block] += 1
         reuse_tokens = len(matched) * bs
-        if matched:
+        if matched and count:
             self.prefix_hits += 1
             self.prefix_tokens_saved += reuse_tokens
             ti.SERVE_PREFIX_HITS.inc()
@@ -255,5 +266,6 @@ class BlockPool:
 
     # -- telemetry --------------------------------------------------------
     def _emit_gauges(self) -> None:
-        ti.SERVE_KV_BLOCKS_IN_USE.set(self.used())
-        ti.SERVE_KV_POOL_UTILIZATION.set(self.utilization())
+        ti.SERVE_KV_BLOCKS_IN_USE.set(self.used(), role=self.role)
+        ti.SERVE_KV_POOL_UTILIZATION.set(self.utilization(),
+                                         role=self.role)

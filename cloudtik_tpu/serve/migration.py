@@ -1,0 +1,257 @@
+"""KV-block migration: serialize a request's paged-cache state and
+stream it between engines (DistServe/Splitwise lineage — the transport
+half of disaggregated prefill/decode serving, and the machinery that
+turns preemption into a move instead of a recompute).
+
+A migration is a short message stream over a byte-oriented
+:class:`KVTransport`:
+
+    header  — JSON request metadata (prompt, first token, lengths,
+              plane geometry) framed as ``KVH1``
+    block×M — one raw K/V plane pair per KV block (``KVB1``): the
+              pool's natural ``block_size``-token granularity IS the
+              transfer chunking, so a long prompt streams instead of
+              materializing one giant buffer
+    commit  — ``KVC1``: the stream is complete; only now may the
+              receiver act on it (a torn stream is dropped, never
+              half-imported)
+    abort   — ``KVA1``: the sender failed mid-transfer; the receiver
+              discards the partial stream
+
+The transport is deliberately dumb bytes: :class:`LoopbackTransport`
+delivers in-process today, and a DCN socket later implements the same
+two-method surface (``send``/``close``) with length-prefixed frames —
+nothing above it changes when migration goes cross-host.
+
+The exporter fires the ``serve.kvcache.migrate`` fault seam before
+every block message, so a chaos plan can tear a transfer at any chunk
+(``kind: raise``) — the engine's contract is to degrade that request
+to the re-prefill path, never to lose it (docs/fault-injection.md).
+
+:class:`MigrationInbox` reassembles streams per request id and hands a
+complete ``(header, k, v)`` to its callback at commit;
+:class:`BlockMigrator` is the engine-side sender (serialize + seam +
+transport + the re-prefill fallback hook).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from cloudtik_tpu.faults import seams
+
+MSG_HEADER = b"KVH1"
+MSG_BLOCK = b"KVB1"
+MSG_COMMIT = b"KVC1"
+MSG_ABORT = b"KVA1"
+
+# one fixed little-endian frame layout per message kind:
+#   header/commit/abort:  tag + u32 json_len + json
+#   block:                tag + u32 json_len + json + u64 k_len + k
+#                         + u64 v_len + v
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+
+class MigrationError(RuntimeError):
+    """A malformed or out-of-order migration message."""
+
+
+def pack_header(meta: Dict[str, Any]) -> bytes:
+    blob = json.dumps(meta).encode()
+    return MSG_HEADER + _U32.pack(len(blob)) + blob
+
+
+def pack_block(request_id: int, seq: int, k: np.ndarray, v: np.ndarray
+               ) -> bytes:
+    """One KV block's planes, raw bytes after a tiny JSON envelope.
+    k/v are one block's [L, bs, Hkv, Dh] planes."""
+    kb, vb = k.tobytes(), v.tobytes()
+    meta = json.dumps({"request_id": request_id, "seq": seq}).encode()
+    return b"".join((MSG_BLOCK, _U32.pack(len(meta)), meta,
+                     _U64.pack(len(kb)), kb, _U64.pack(len(vb)), vb))
+
+
+def pack_commit(request_id: int, blocks: int) -> bytes:
+    blob = json.dumps({"request_id": request_id,
+                       "blocks": blocks}).encode()
+    return MSG_COMMIT + _U32.pack(len(blob)) + blob
+
+
+def pack_abort(request_id: int) -> bytes:
+    blob = json.dumps({"request_id": request_id}).encode()
+    return MSG_ABORT + _U32.pack(len(blob)) + blob
+
+
+def unpack(msg: bytes) -> Tuple[bytes, Dict[str, Any],
+                                Optional[np.ndarray],
+                                Optional[np.ndarray]]:
+    """(kind, meta, k_bytes_or_None, v_bytes_or_None); planes come back
+    as flat uint8 — the inbox reshapes them from the header geometry."""
+    if len(msg) < 8:
+        raise MigrationError("truncated migration message")
+    kind = msg[:4]
+    if kind not in (MSG_HEADER, MSG_BLOCK, MSG_COMMIT, MSG_ABORT):
+        raise MigrationError(f"unknown migration tag {kind!r}")
+    (meta_len,) = _U32.unpack_from(msg, 4)
+    off = 8
+    meta = json.loads(msg[off:off + meta_len].decode())
+    off += meta_len
+    if kind != MSG_BLOCK:
+        return kind, meta, None, None
+    (k_len,) = _U64.unpack_from(msg, off)
+    off += 8
+    k = np.frombuffer(msg[off:off + k_len], np.uint8)
+    off += k_len
+    (v_len,) = _U64.unpack_from(msg, off)
+    off += 8
+    v = np.frombuffer(msg[off:off + v_len], np.uint8)
+    if len(k) != k_len or len(v) != v_len:
+        raise MigrationError("block message shorter than its framing")
+    return kind, meta, k, v
+
+
+# ------------------------------------------------------------ transport --
+
+class KVTransport:
+    """The pluggable byte pipe a migration streams through.
+
+    This two-method surface is the whole cross-host seam: a DCN socket
+    transport implements ``send`` as a length-prefixed write (each
+    ``msg`` is already a self-describing frame) and everything above —
+    serialization, seams, fallback, import — is unchanged."""
+
+    def send(self, msg: bytes) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class LoopbackTransport(KVTransport):
+    """In-process delivery: hands every message straight to a receiver
+    callable (typically ``MigrationInbox.feed``)."""
+
+    def __init__(self, deliver: Callable[[bytes], None]):
+        self._deliver = deliver
+
+    def send(self, msg: bytes) -> None:
+        self._deliver(msg)
+
+
+# ---------------------------------------------------------------- inbox --
+
+class MigrationInbox:
+    """Reassembles migration streams and delivers complete ones.
+
+    ``on_migration(header, k, v)`` fires at commit with the block
+    planes stacked ``[L, M, bs, Hkv, Dh]`` in table order.  Torn
+    streams (abort, missing blocks, bad framing) are dropped whole —
+    a half-imported cache would be silent corruption."""
+
+    def __init__(self, on_migration: Callable[
+            [Dict[str, Any], np.ndarray, np.ndarray], None]):
+        self._on_migration = on_migration
+        self._partial: Dict[int, Dict[str, Any]] = {}
+
+    def feed(self, msg: bytes) -> None:
+        kind, meta, k, v = unpack(msg)
+        if kind == MSG_HEADER:
+            self._partial[meta["request_id"]] = {
+                "header": meta, "blocks": {}}
+            return
+        rid = meta.get("request_id")
+        state = self._partial.get(rid)
+        if kind == MSG_ABORT:
+            self._partial.pop(rid, None)
+            return
+        if state is None:
+            raise MigrationError(
+                f"migration message for request {rid} with no header")
+        if kind == MSG_BLOCK:
+            state["blocks"][meta["seq"]] = (k, v)
+            return
+        # commit: every announced block must have arrived, in-range
+        self._partial.pop(rid, None)
+        header = state["header"]
+        n = int(meta["blocks"])
+        if sorted(state["blocks"]) != list(range(n)):
+            raise MigrationError(
+                f"migration for request {rid} committed with "
+                f"{sorted(state['blocks'])} of {n} blocks")
+        dtype = np.dtype(header["dtype"])
+        shape = (int(header["n_layers"]), int(header["block_size"]),
+                 int(header["n_kv_heads"]), int(header["head_dim"]))
+        ks: List[np.ndarray] = []
+        vs: List[np.ndarray] = []
+        for seq in range(n):
+            kb, vb = state["blocks"][seq]
+            ks.append(kb.view(dtype).reshape(shape))
+            vs.append(vb.view(dtype).reshape(shape))
+        k_planes = np.stack(ks, axis=1)       # [L, M, bs, Hkv, Dh]
+        v_planes = np.stack(vs, axis=1)
+        self._on_migration(header, k_planes, v_planes)
+
+
+# -------------------------------------------------------------- exporter --
+
+class BlockMigrator:
+    """Engine-side sender: serialize a finished prefill's KV state and
+    stream it, one message per block, through the transport.
+
+    ``fallback(request)`` is the degrade path a mid-transfer fault
+    takes — the engine hands the request over with its KV discarded
+    and the receiver re-prefills it from the prompt (in disaggregated
+    mode: a plain submit to the decode-role engine)."""
+
+    def __init__(self, transport: KVTransport,
+                 fallback: Optional[Callable[[Any], None]] = None):
+        self.transport = transport
+        self.fallback = fallback
+
+    def export(self, request, *, first_token: int, length: int,
+               k: np.ndarray, v: np.ndarray, block_size: int) -> None:
+        """Stream one request's KV state.  k/v are the host planes
+        ``[L, M, bs, Hkv, Dh]`` for the request's covered blocks, in
+        table order.  Raises whatever the ``serve.kvcache.migrate``
+        seam (fired before every block) or the transport raises — the
+        caller owns the degrade."""
+        n_blocks = int(k.shape[1])
+        header = {
+            "request_id": request.request_id,
+            "prompt": list(request.prompt),
+            "first_token": int(first_token),
+            "length": int(length),
+            "max_new_tokens": request.max_new_tokens,
+            "temperature": request.temperature,
+            "eos_id": request.eos_id,
+            "traceparent": request.traceparent,
+            "block_size": int(block_size),
+            "n_layers": int(k.shape[0]),
+            "n_kv_heads": int(k.shape[3]),
+            "head_dim": int(k.shape[4]),
+            "dtype": np.dtype(k.dtype).name,
+            "blocks": n_blocks,
+        }
+        try:
+            self.transport.send(pack_header(header))
+            for seq in range(n_blocks):
+                seams.fire("serve.kvcache.migrate",
+                           request=request.request_id, seq=seq,
+                           blocks=n_blocks)
+                self.transport.send(pack_block(
+                    request.request_id, seq, k[:, seq], v[:, seq]))
+            self.transport.send(pack_commit(request.request_id,
+                                            n_blocks))
+        except BaseException:
+            # best-effort abort so the receiver drops the torn stream;
+            # the original failure is the one that must surface
+            try:
+                self.transport.send(pack_abort(request.request_id))
+            except Exception:
+                pass
+            raise

@@ -11,6 +11,7 @@ appends one record per completed request:
      bucket, prompt_tokens, output_tokens,
      kv_blocks, prefix_blocks, prefix_tokens, prefill_chunks,
      preemptions                                  (paged KV cache),
+     migrations, migrated_tokens                  (KV-block migration),
      draft_tokens, accepted_tokens, spec_steps    (speculative decode),
      arrival_ts/admitted_ts/first_token_ts/done_ts           (epoch),
      arrival_mono/admitted_mono/first_token_mono/done_mono   (monotonic),
@@ -56,6 +57,7 @@ RECORD_FIELDS = (
     "request_id", "finish", "bucket", "prompt_tokens", "output_tokens",
     "kv_blocks", "prefix_blocks", "prefix_tokens", "prefill_chunks",
     "preemptions",
+    "migrations", "migrated_tokens",
     "draft_tokens", "accepted_tokens", "spec_steps",
     "arrival_ts", "admitted_ts", "first_token_ts", "done_ts",
     "arrival_mono", "admitted_mono", "first_token_mono", "done_mono",
@@ -137,6 +139,10 @@ def record(req, finish: str) -> None:
         "prefix_tokens": getattr(req, "prefix_tokens", None),
         "prefill_chunks": getattr(req, "prefill_chunks", None),
         "preemptions": getattr(req, "preemptions", None),
+        # KV-block migration (serve/migration.py — disaggregated
+        # prefill/decode: tokens whose KV was imported, not recomputed)
+        "migrations": getattr(req, "migrations", None),
+        "migrated_tokens": getattr(req, "migrated_tokens", None),
         # speculative decoding (EngineConfig.spec draft/verify loop)
         "draft_tokens": getattr(req, "draft_tokens", None),
         "accepted_tokens": getattr(req, "accepted_tokens", None),
@@ -245,7 +251,8 @@ def compute_stats(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     # how many chunks prefill took, and how much preemption churn the
     # population survived (zeros when the records predate the fields)
     for field in ("prompt_tokens", "prefix_tokens", "prefill_chunks",
-                  "preemptions", "draft_tokens", "accepted_tokens",
+                  "preemptions", "migrations", "migrated_tokens",
+                  "draft_tokens", "accepted_tokens",
                   "spec_steps"):
         stats[field] = sum(
             rec[field] for rec in records

@@ -116,6 +116,9 @@ def engine_backend(model: str = "tiny",
                    spec_model: Optional[str] = None,
                    spec_checkpoint_dir: Optional[str] = None,
                    spec_k: int = 4,
+                   disagg: bool = False,
+                   prefill_slots: int = 2,
+                   prefill_blocks: Optional[int] = None,
                    **config_overrides) -> ModelBackend:
     """Continuous-batching generation endpoint (serve/engine.py).
 
@@ -126,9 +129,15 @@ def engine_backend(model: str = "tiny",
     draft-model speculative decoding: the named preset (restored from
     `spec_checkpoint_dir` when given) proposes `spec_k` greedy tokens
     per round and ONE target verify accepts the matching prefix —
-    greedy output stays bit-identical to non-speculative decode."""
+    greedy output stays bit-identical to non-speculative decode.
+    `disagg` splits serving into a prefill-role engine
+    (`prefill_slots`/`prefill_blocks`) streaming finished KV blocks to
+    a decode-role engine (`slots`/`num_blocks`) over the in-process
+    migration transport (serve/disagg.py) — prompt-heavy and
+    decode-heavy load stop competing for the same loop."""
     import jax
 
+    from cloudtik_tpu.serve.disagg import DisaggServing
     from cloudtik_tpu.models import transformer as T
     from cloudtik_tpu.serve.engine import (
         DecodeEngine, EngineConfig, Request, RequestRejected,
@@ -156,11 +165,24 @@ def engine_backend(model: str = "tiny",
             draft_params = _restore(draft_params, spec_checkpoint_dir)
         draft = (draft_params, draft_cfg)
         spec = SpecConfig(k=spec_k)
-    engine = DecodeEngine(
-        params, cfg, EngineConfig(slots=slots, max_len=max_len,
-                                  block_size=block_size,
-                                  num_blocks=num_blocks, spec=spec),
-        draft=draft)
+    if disagg:
+        if spec is not None:
+            raise ValueError("--disagg and --spec-model are mutually "
+                             "exclusive (imported requests decode "
+                             "plain; run spec on a monolithic engine)")
+        engine = DisaggServing(
+            params, cfg,
+            EngineConfig(slots=prefill_slots, max_len=max_len,
+                         block_size=block_size,
+                         num_blocks=prefill_blocks),
+            EngineConfig(slots=slots, max_len=max_len,
+                         block_size=block_size, num_blocks=num_blocks))
+    else:
+        engine = DecodeEngine(
+            params, cfg, EngineConfig(slots=slots, max_len=max_len,
+                                      block_size=block_size,
+                                      num_blocks=num_blocks, spec=spec),
+            draft=draft)
     engine.start()
 
     def generate(payload: Dict[str, Any]):
@@ -195,8 +217,9 @@ def engine_backend(model: str = "tiny",
         return ({"tokens": [tokens],
                  "request_id": req.request_id}, headers)
 
-    backend = ModelBackend(f"transformer-engine:{model}",
-                           {"generate": generate})
+    name = f"transformer-engine-disagg:{model}" if disagg \
+        else f"transformer-engine:{model}"
+    backend = ModelBackend(name, {"generate": generate})
     backend.engine = engine          # exposes stop() for clean shutdown
     return backend
 
@@ -355,6 +378,17 @@ def main(argv=None) -> int:
                    help="checkpoint dir the draft model restores from")
     p.add_argument("--spec-k", type=int, default=4,
                    help="draft tokens proposed per verify round")
+    p.add_argument("--disagg", action="store_true",
+                   help="disaggregated serving (engine mode): a "
+                        "prefill-role engine streams finished KV "
+                        "blocks to the decode-role engine; --slots/"
+                        "--num-blocks size the decode role")
+    p.add_argument("--prefill-slots", type=int, default=2,
+                   help="prefill-role lanes (--disagg)")
+    p.add_argument("--prefill-blocks", type=int, default=None,
+                   help="prefill-role KV pool size in blocks "
+                        "(--disagg; default fully provisions "
+                        "prefill slots)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8200)
     args = p.parse_args(argv)
@@ -383,7 +417,9 @@ def main(argv=None) -> int:
             block_size=args.block_size, num_blocks=args.num_blocks,
             spec_model=args.spec_model,
             spec_checkpoint_dir=args.spec_checkpoint_dir,
-            spec_k=args.spec_k))
+            spec_k=args.spec_k, disagg=args.disagg,
+            prefill_slots=args.prefill_slots,
+            prefill_blocks=args.prefill_blocks))
     else:
         backends.append(transformer_backend(
             args.model, checkpoint_dir=args.checkpoint_dir))

@@ -72,6 +72,15 @@ SERVE_PREFIX_TOKENS_SAVED: Counter = _build(
 SERVE_PREFILL_CHUNKS: Counter = _build("tik_serve_prefill_chunks_total")
 SERVE_PREFILL_PENDING: Gauge = _build("tik_serve_prefill_pending_tokens")
 SERVE_PREEMPTIONS: Counter = _build("tik_serve_preemptions_total")
+SERVE_PREEMPTED_TOKENS: Counter = _build(
+    "tik_serve_preempted_tokens_total")
+
+# serve KV-block migration (serve/migration.py + disaggregated roles)
+SERVE_KV_MIGRATIONS: Counter = _build("tik_serve_kv_migrations_total")
+SERVE_KV_MIGRATED_TOKENS: Counter = _build(
+    "tik_serve_kv_migrated_tokens_total")
+SERVE_KV_MIGRATION_FAILURES: Counter = _build(
+    "tik_serve_kv_migration_failures_total")
 
 # serve speculative decoding (EngineConfig.spec draft/verify loop)
 SERVE_SPEC_DRAFT_TOKENS: Counter = _build(

@@ -123,15 +123,17 @@ _ALL = [
     _m("tik_serve_tokens_generated_total", "counter",
        "Tokens produced by the decode engine.", "serve"),
     _m("tik_serve_active_slots", "gauge",
-       "Decode slots occupied this step.", "serve"),
+       "Decode slots occupied this step.", "serve", ("role",)),
     _m("tik_serve_queue_depth", "gauge",
-       "Requests waiting for a slot.", "serve"),
+       "Requests waiting for a slot.", "serve", ("role",)),
     # -- serve paged KV cache (serve/kvcache.py) -------------------------
     _m("tik_serve_kv_pool_utilization", "gauge",
        "Fraction of usable KV blocks held by requests (cached-idle "
-       "prefix blocks count as reclaimable, not used).", "serve"),
+       "prefix blocks count as reclaimable, not used).  role = "
+       "engine (monolithic) | prefill | decode (disaggregated).",
+       "serve", ("role",)),
     _m("tik_serve_kv_blocks_in_use", "gauge",
-       "KV blocks held by in-flight requests.", "serve"),
+       "KV blocks held by in-flight requests.", "serve", ("role",)),
     _m("tik_serve_prefix_cache_hits_total", "counter",
        "Admissions whose prompt opened with cached prefix blocks.",
        "serve"),
@@ -142,10 +144,26 @@ _ALL = [
        "Prompt chunks run by the chunked-prefill scheduler.", "serve"),
     _m("tik_serve_prefill_pending_tokens", "gauge",
        "Prompt tokens admitted but not yet prefilled (the chunk "
-       "queue).", "serve"),
+       "queue).", "serve", ("role",)),
     _m("tik_serve_preemptions_total", "counter",
        "Requests preempted and requeued because the KV pool ran out "
        "of blocks.", "serve"),
+    _m("tik_serve_preempted_tokens_total", "counter",
+       "Prompt tokens whose prefill work was at stake when their "
+       "request was preempted (read the salvage win against it: "
+       "salvaged blocks make the re-admission a prefix-cache hit).",
+       "serve"),
+    # -- serve KV-block migration (serve/migration.py) --------------------
+    _m("tik_serve_kv_migrations_total", "counter",
+       "KV-block migrations completed, by direction (out = exported "
+       "to another engine, in = imported into this pool).", "serve",
+       ("direction",)),
+    _m("tik_serve_kv_migrated_tokens_total", "counter",
+       "Tokens whose KV state moved between engines instead of being "
+       "recomputed, by direction.", "serve", ("direction",)),
+    _m("tik_serve_kv_migration_failures_total", "counter",
+       "Migrations aborted mid-transfer; the request degraded to the "
+       "re-prefill path on the decode role.", "serve"),
     # -- serve speculative decoding (EngineConfig.spec) ------------------
     _m("tik_serve_spec_draft_tokens_total", "counter",
        "Draft-model tokens proposed and verified by speculative "
@@ -217,7 +235,7 @@ _ALL = [
     # -- serve goodput ----------------------------------------------------
     _m("tik_serve_slot_idle_fraction", "gauge",
        "Fraction of decode-step lanes idle this step (1 - active/slots).",
-       "serve"),
+       "serve", ("role",)),
     # -- telemetry self-accounting ---------------------------------------
     _m("tik_spans_dropped_total", "counter",
        "Finished spans overwritten in the ring before export.",
@@ -288,8 +306,13 @@ _EVENT_LIST = [
     ("tik_serve_cancel",
      "a serve request was cancelled."),
     ("tik_serve_preemption",
-     "a serve request was preempted (KV pool exhausted) and requeued "
-     "for recompute-on-readmit."),
+     "a serve request was preempted (KV pool exhausted) and requeued; "
+     "its computed prompt blocks are salvaged to the evictable prefix "
+     "LRU so re-admission is a cache hit."),
+    ("tik_serve_migration",
+     "a request's KV blocks migrated between engines (direction, "
+     "result, token/block counts; a failed out-migration degrades "
+     "the request to the re-prefill path)."),
     ("tik_fault_fired",
      "an armed fault plan fired at a seam (chaos drills)."),
     ("tik_train_resume",
@@ -336,6 +359,8 @@ SPANS: Dict[str, str] = {
     "discovery.render":       "registry -> targets/dns render pass",
     "serve.enqueue":          "request submit -> queued",
     "serve.prefill":          "one prompt prefill chunk against the paged pool",
+    "serve.kvcache.migrate":  "export a request's KV blocks through the migration transport",
+    "serve.kvcache.import":   "import migrated KV blocks into a decode-role pool",
     "serve.spec.verify":      "one speculative draft/verify round for a slot",
     "serve.decode_step":      "one engine decode step over all slots",
     "serve.decode":           "per-request decode window (first->last token)",

@@ -17,6 +17,11 @@ backend, bounded iterations):
   (e) a fault at the speculative verify seam (`serve.spec.verify`)
       degrades that request to non-speculative decode — output stays
       bit-identical, no error — and later requests speculate again;
+  (g) a `raise` at `serve.kvcache.migrate` mid-transfer (the second
+      block chunk) tears a disaggregated KV migration: the request
+      degrades to the re-prefill path on the decode role (ledger
+      `finish=done`, output bit-identical), the NEXT request migrates
+      normally, and both pools are fully free after stop;
   (f) elastic multislice: a slice preempted mid-fit (its in-flight
       save torn, its node group gone, its heartbeats dark) costs a
       re-mesh to K-1 — loss bit-identical to a fresh K-1 run from the
@@ -571,3 +576,71 @@ def test_drill_spec_verify_fault_degrades_to_plain_decode(tmp_path):
     assert by_id[faulted.request_id]["spec_steps"] == 0
     assert by_id[healthy.request_id]["spec_steps"] > 0
     assert engine.pool.used() == 0            # speculation blocks back
+
+
+def test_drill_torn_kv_migration_degrades_to_reprefill(tmp_path):
+    """Drill (g): a `raise` at `serve.kvcache.migrate` MID-TRANSFER
+    (the second block chunk) tears a disaggregated migration — the
+    receiver drops the partial stream, the request degrades to a
+    plain re-prefill submit on the decode role and still finishes
+    `done` with BIT-IDENTICAL output, the next request migrates
+    normally, and both pools end fully free."""
+    import jax
+    import numpy as np
+
+    from cloudtik_tpu.models import generate as G
+    from cloudtik_tpu.models import transformer as T
+    from cloudtik_tpu.serve import reqlog
+    from cloudtik_tpu.serve.disagg import DisaggServing
+    from cloudtik_tpu.serve.engine import EngineConfig, Request
+
+    cfg = T.config("tiny", dtype=jax.numpy.float32,
+                   attention_impl="reference", remat=False)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    pair = DisaggServing(
+        params, cfg,
+        EngineConfig(slots=2, max_len=64, prefill_buckets=(8, 16),
+                     block_size=8),
+        EngineConfig(slots=2, max_len=64, prefill_buckets=(8, 16),
+                     block_size=8))
+    pair.start()
+    reqlog.install(str(tmp_path / "req.jsonl"))
+    try:
+        def reference(prompt, n):
+            out = G.generate(params,
+                             jax.numpy.asarray([prompt], np.int32),
+                             cfg, max_new_tokens=n)
+            return np.asarray(out)[0].tolist()
+
+        # warm every program outside the drill (incl. one migration)
+        warm = pair.submit(Request([1, 2, 3, 4], max_new_tokens=4))
+        warm.wait(timeout=300)
+        # tear the SECOND block chunk of the next migration: the
+        # header and first block are already through the transport
+        plan = FaultPlan([FaultPoint("serve.kvcache.migrate", "raise",
+                                     at_call=2, times=1)], seed=7,
+                         name="torn-migration-drill")
+        prompt = [((i * 7) % 250) + 1 for i in range(20)]  # 3 blocks
+        with seams.armed(plan):
+            torn = pair.submit(Request(prompt, max_new_tokens=6))
+            out = torn.wait(timeout=300)
+        assert plan.points[0].fired == 1
+        assert out == reference(prompt, 6)    # degraded, not wrong
+        assert torn.error is None
+        assert torn.migrations == 0           # re-prefilled, not moved
+        # the degrade is per-transfer: the next request migrates again
+        healthy = pair.submit(Request(prompt[::-1], max_new_tokens=6))
+        assert healthy.wait(timeout=300) == reference(prompt[::-1], 6)
+        assert healthy.migrations == 1
+        assert healthy.migrated_tokens == len(prompt)
+    finally:
+        reqlog.uninstall()
+        pair.stop()
+    by_id = {r["request_id"]: r for r in reqlog.read_requests(
+        str(tmp_path / "req.jsonl"))}
+    assert by_id[torn.request_id]["finish"] == "done"
+    assert by_id[torn.request_id]["migrated_tokens"] == 0
+    assert by_id[healthy.request_id]["finish"] == "done"
+    assert by_id[healthy.request_id]["migrated_tokens"] == len(prompt)
+    assert pair.prefill.pool.used() == 0      # no leak through the tear
+    assert pair.decode.pool.used() == 0

@@ -193,6 +193,29 @@ def test_seams_are_noops_without_a_plan(monkeypatch, tmp_path):
     from cloudtik_tpu.serve.engine import fire_verify_seam
     fire_verify_seam(1, 4)
 
+    # KV-block migration export (serve.kvcache.migrate, fired per
+    # block chunk through the real BlockMigrator.export path)
+    import numpy as np
+
+    from cloudtik_tpu.serve import migration
+
+    class _Req:
+        request_id = 1
+        prompt = [1, 2]
+        max_new_tokens = 2
+        temperature = 0.0
+        eos_id = None
+        traceparent = None
+
+    sent = []
+    migrator = migration.BlockMigrator(
+        migration.LoopbackTransport(sent.append))
+    migrator.export(_Req(), first_token=3, length=2,
+                    k=np.zeros((1, 1, 2, 1, 1), np.float32),
+                    v=np.zeros((1, 1, 2, 1, 1), np.float32),
+                    block_size=2)
+    assert len(sent) == 3          # header + 1 block + commit
+
     # prefetcher consumer hand-off (train.prefetch.next)
     from cloudtik_tpu.train.prefetch import Prefetcher
     pf = Prefetcher(iter([{"x": 1}]), sharding=None)

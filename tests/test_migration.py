@@ -1,0 +1,283 @@
+"""KV-block migration: wire format, inbox reassembly, and the
+engine-level export/import round trip (serve/migration.py).
+
+The hard property: a request's KV state serialized out of one engine's
+pool and imported into another's is BIT-IDENTICAL — the decode role
+continues the sequence as if it had prefilled the prompt itself — and
+the pool bookkeeping (refcounts, prefix registration, the COW
+boundary on imported shared blocks) survives the crossing.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from cloudtik_tpu.models import generate as G
+from cloudtik_tpu.models import transformer as T
+from cloudtik_tpu.serve import kvcache, migration
+from cloudtik_tpu.serve.engine import DecodeEngine, EngineConfig, Request
+
+
+class TestWireFormat:
+    def test_header_commit_abort_round_trip(self):
+        meta = {"request_id": 7, "prompt": [1, 2, 3], "dtype":
+                "float32"}
+        kind, got, k, v = migration.unpack(migration.pack_header(meta))
+        assert kind == migration.MSG_HEADER
+        assert got == meta and k is None and v is None
+        kind, got, _k, _v = migration.unpack(
+            migration.pack_commit(7, blocks=3))
+        assert kind == migration.MSG_COMMIT
+        assert got == {"request_id": 7, "blocks": 3}
+        kind, got, _k, _v = migration.unpack(migration.pack_abort(7))
+        assert kind == migration.MSG_ABORT and got["request_id"] == 7
+
+    def test_block_round_trip_is_bit_identical(self):
+        rng = np.random.default_rng(0)
+        k = rng.standard_normal((2, 4, 3, 5), dtype=np.float32)
+        v = rng.standard_normal((2, 4, 3, 5), dtype=np.float32)
+        kind, meta, kb, vb = migration.unpack(
+            migration.pack_block(9, 2, k, v))
+        assert kind == migration.MSG_BLOCK
+        assert meta == {"request_id": 9, "seq": 2}
+        assert np.array_equal(kb.view(np.float32).reshape(k.shape), k)
+        assert np.array_equal(vb.view(np.float32).reshape(v.shape), v)
+
+    def test_malformed_messages_refuse(self):
+        with pytest.raises(migration.MigrationError):
+            migration.unpack(b"")
+        with pytest.raises(migration.MigrationError):
+            migration.unpack(b"XXXX\x00\x00\x00\x00")
+        # a block frame shorter than its framing claims
+        msg = migration.pack_block(
+            1, 0, np.zeros((1, 2), np.float32),
+            np.zeros((1, 2), np.float32))
+        with pytest.raises(migration.MigrationError):
+            migration.unpack(msg[:-4])
+
+
+class TestInbox:
+    def _stream(self, rid=1, n_blocks=2, shape=(2, 1, 4, 3, 5)):
+        """(header_msg, block_msgs, commit_msg, k_ref, v_ref)."""
+        rng = np.random.default_rng(rid)
+        L, _M, bs, H, D = shape
+        k = rng.standard_normal((L, n_blocks, bs, H, D),
+                                dtype=np.float32)
+        v = rng.standard_normal((L, n_blocks, bs, H, D),
+                                dtype=np.float32)
+        header = {"request_id": rid, "dtype": "float32",
+                  "n_layers": L, "block_size": bs, "n_kv_heads": H,
+                  "head_dim": D, "blocks": n_blocks}
+        blocks = [migration.pack_block(rid, j, k[:, j], v[:, j])
+                  for j in range(n_blocks)]
+        return (migration.pack_header(header), blocks,
+                migration.pack_commit(rid, n_blocks), k, v)
+
+    def test_commit_delivers_planes_bit_identical(self):
+        got = []
+        inbox = migration.MigrationInbox(
+            lambda h, k, v: got.append((h, k, v)))
+        header, blocks, commit, k_ref, v_ref = self._stream()
+        inbox.feed(header)
+        for msg in blocks:
+            inbox.feed(msg)
+        assert got == []                  # nothing until commit
+        inbox.feed(commit)
+        (h, k, v), = got
+        assert h["request_id"] == 1
+        assert np.array_equal(k, k_ref)
+        assert np.array_equal(v, v_ref)
+
+    def test_abort_drops_the_partial_stream(self):
+        got = []
+        inbox = migration.MigrationInbox(
+            lambda h, k, v: got.append(h))
+        header, blocks, commit, _k, _v = self._stream()
+        inbox.feed(header)
+        inbox.feed(blocks[0])
+        inbox.feed(migration.pack_abort(1))
+        # a commit for the dropped stream is torn, never half-imported
+        with pytest.raises(migration.MigrationError):
+            inbox.feed(commit)
+        assert got == []
+
+    def test_commit_with_missing_blocks_refuses(self):
+        inbox = migration.MigrationInbox(lambda h, k, v: None)
+        header, blocks, commit, _k, _v = self._stream(n_blocks=3)
+        inbox.feed(header)
+        inbox.feed(blocks[0])
+        inbox.feed(blocks[2])             # seq 1 never arrives
+        with pytest.raises(migration.MigrationError):
+            inbox.feed(commit)
+
+    def test_block_without_header_refuses(self):
+        inbox = migration.MigrationInbox(lambda h, k, v: None)
+        _header, blocks, _commit, _k, _v = self._stream()
+        with pytest.raises(migration.MigrationError):
+            inbox.feed(blocks[0])
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = T.config("tiny", dtype=jax.numpy.float32,
+                   attention_impl="reference", remat=False)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _reference(params, cfg, prompt, max_new):
+    out = G.generate(params, jax.numpy.asarray([prompt], np.int32),
+                     cfg, max_new_tokens=max_new)
+    return np.asarray(out)[0].tolist()
+
+
+def _engine_pair(cfg, params):
+    """(prefill_engine, decode_engine, delivered) — UNSTARTED engines
+    wired by a loopback transport, driven on the test thread (which
+    therefore owns slot state, the TestCowFork pattern)."""
+    delivered = []
+    inbox = migration.MigrationInbox(
+        lambda h, k, v: delivered.append((h, k, v)))
+    migrator = migration.BlockMigrator(
+        migration.LoopbackTransport(inbox.feed))
+    ec = dict(slots=2, max_len=32, prefill_buckets=(8,), block_size=4)
+    prefill = DecodeEngine(params, cfg, EngineConfig(**ec),
+                           migrator=migrator)
+    decode = DecodeEngine(params, cfg, EngineConfig(**ec))
+    return prefill, decode, delivered
+
+
+class TestExportImportRoundTrip:
+    def test_planes_refcounts_and_pool_reconcile(self, tiny):
+        """Satellite: serialize a block table + planes through
+        export/import and assert bit-identical planes on the decode
+        side, correct refcounts, and `used() == 0` once the importing
+        request finishes."""
+        cfg, params = tiny
+        prefill, decode, delivered = _engine_pair(cfg, params)
+        prompt = [((i * 13) % 250) + 1 for i in range(10)]  # 3 blocks
+        ref = _reference(params, cfg, prompt, 6)
+        req = Request(prompt, max_new_tokens=6)
+        prefill.submit(req)
+        prefill._admit()
+        table = list(prefill._slots[0].table)      # fixed at admission
+        for _ in range(10):
+            if prefill._slots[0] is None:
+                break
+            prefill._prefill_tick()
+        assert prefill._slots[0] is None           # exported + freed
+        assert prefill.pool.used() == 0            # lane turned over
+        (header, k, v), = delivered
+        # the exported planes are bit-identical to the prefill pool's
+        # (released blocks keep their contents until reused)
+        assert np.array_equal(
+            k, np.asarray(prefill._kp[:, np.asarray(table)]))
+        assert np.array_equal(
+            v, np.asarray(prefill._vp[:, np.asarray(table)]))
+        assert header["length"] == len(prompt)
+        assert header["blocks"] == 3
+
+        decode.import_blocks(req, header, k, v)
+        decode._import_tick()
+        slot = decode._slots[0]
+        assert slot is not None and slot.decoding
+        assert slot.length == len(prompt)
+        # planes landed bit-identical in the OTHER pool
+        imp = np.asarray(slot.table)
+        assert np.array_equal(np.asarray(decode._kp[:, imp]), k)
+        assert np.array_equal(np.asarray(decode._vp[:, imp]), v)
+        assert all(decode.pool.ref(b) == 1 for b in slot.table)
+        # TTFT stamped at import, first token rode the header
+        assert req.first_token_time is not None
+        assert req.tokens == [ref[0]]
+        assert req.migrations == 1
+        assert req.migrated_tokens == len(prompt)
+        # decode continues the sequence bit-identically
+        for _ in range(20):
+            if decode._slots[0] is None:
+                break
+            decode._step()
+        assert req.tokens == ref
+        assert decode.pool.used() == 0
+
+    def test_imported_shared_blocks_arm_the_cow_boundary(self, tiny):
+        """Two imports of the same prompt share the registered full
+        prompt blocks (second import scatters only its tail):
+        refcounts go to 2, `needs_copy` is True while both live —
+        the COW boundary — and everything reconciles after both
+        finish, shared planes bit-unchanged."""
+        cfg, params = tiny
+        prefill, decode, delivered = _engine_pair(cfg, params)
+        prompt = [((i * 7) % 250) + 1 for i in range(10)]
+        ref = _reference(params, cfg, prompt, 5)
+
+        reqs = []
+        for _ in range(2):
+            req = Request(prompt, max_new_tokens=5)
+            reqs.append(req)
+            prefill.submit(req)
+            prefill._admit()
+            for _ in range(10):
+                if all(s is None for s in prefill._slots):
+                    break
+                prefill._prefill_tick()
+        assert len(delivered) == 2
+        for req, (header, k, v) in zip(reqs, delivered):
+            decode.import_blocks(req, header, k, v)
+        decode._import_tick()
+        a, b = decode._slots[0], decode._slots[1]
+        assert a is not None and b is not None
+        # full prompt blocks (2 of 3) are shared via the prefix map;
+        # the partial tail block is private per importer
+        assert b.table[:2] == a.table[:2]
+        assert b.table[2] != a.table[2]
+        for blk in a.table[:2]:
+            assert decode.pool.ref(blk) == 2
+            assert decode.pool.needs_copy(blk)     # the COW boundary
+        assert not decode.pool.needs_copy(a.table[2])
+        shared = np.asarray(a.table[:2])   # tables clear on release
+        before_k = np.asarray(decode._kp[:, shared])
+        for _ in range(20):
+            if all(s is None for s in decode._slots):
+                break
+            decode._step()
+        assert reqs[0].tokens == ref
+        assert reqs[1].tokens == ref
+        # shared blocks were never written through (appends land in
+        # private tail blocks; a write would have COW'd first)
+        assert np.array_equal(np.asarray(decode._kp[:, shared]),
+                              before_k)
+        assert decode.pool.used() == 0
+        assert decode.pool.available() == decode.pool.usable_blocks
+
+    def test_incompatible_import_fails_the_request_not_the_pool(
+            self, tiny):
+        """A migrated request whose geometry this engine cannot hold
+        (block_size mismatch) finishes `error` and leaks nothing."""
+        cfg, params = tiny
+        _prefill, decode, _delivered = _engine_pair(cfg, params)
+        req = Request([1, 2, 3], max_new_tokens=4)
+        header = {"request_id": req.request_id, "length": 3,
+                  "first_token": 5, "block_size": 16, "blocks": 1}
+        decode.import_blocks(
+            req, header, np.zeros((2, 1, 16, 2, 4), np.float32),
+            np.zeros((2, 1, 16, 2, 4), np.float32))
+        decode._import_tick()
+        assert req._done.is_set()
+        assert req.error is not None
+        assert decode.pool.used() == 0
+
+
+class TestLedgerAggregates:
+    def test_stats_sum_migration_fields(self):
+        from cloudtik_tpu.serve import reqlog
+        records = [
+            {"finish": "done", "migrations": 1, "migrated_tokens": 40},
+            {"finish": "done", "migrations": 1, "migrated_tokens": 8},
+            {"finish": "done"},            # pre-migration record shape
+        ]
+        stats = reqlog.compute_stats(records)
+        assert stats["migrations"] == 2
+        assert stats["migrated_tokens"] == 48

@@ -250,6 +250,42 @@ class TestElasticityTrajectoryIsolation:
         assert report["mode"] == "elasticity"
 
 
+class TestDisaggTrajectoryIsolation:
+    """Disaggregated serving records (serving_bench.py --workload
+    disagg) carry mode="disagg" and form their own trajectory — the
+    committed monolithic serving_rps_at_slo median must never be
+    polluted by them, exactly like spec/cpu_dryrun/elasticity."""
+
+    def test_gate_excludes_disagg_from_monolithic_median(
+            self, perf_gate, tmp_path):
+        _trajectory(tmp_path, [64.0, 60.0], metric="serving_rps_at_slo")
+        mislabeled = tmp_path / "BENCH_r11.json"
+        # a disagg record mislabeled under the monolithic metric name
+        # must still be excluded from its median
+        mislabeled.write_text(json.dumps({"parsed": {
+            "metric": "serving_rps_at_slo", "value": 9000.0,
+            "mode": "disagg"}}))
+        paths = [str(p) for p in tmp_path.glob("BENCH_*.json")]
+        history = perf_gate.load_history(paths,
+                                         metric="serving_rps_at_slo")
+        assert sorted(v for _p, v in history) == [60.0, 64.0]
+
+    def test_disagg_metric_forms_its_own_trajectory(self, perf_gate,
+                                                    tmp_path):
+        record = {"parsed": {"metric": "serving_rps_at_slo_disagg",
+                             "value": 128.0, "mode": "disagg"}}
+        (tmp_path / "BENCH_r11.json").write_text(json.dumps(record))
+        paths = [str(p) for p in tmp_path.glob("BENCH_*.json")]
+        history = perf_gate.load_history(
+            paths, metric="serving_rps_at_slo_disagg")
+        assert [v for _p, v in history] == [128.0]
+        code, report = perf_gate.gate(
+            {"metric": "serving_rps_at_slo_disagg", "value": 125.0,
+             "mode": "disagg"}, history, 10.0)
+        assert code == 0
+        assert report["mode"] == "disagg"
+
+
 class TestCpuDryrunFallback:
     """Open item 3 first step: a probe failure must never record 0.0
     again — bench.py falls back to a labeled CPU-dryrun measurement,

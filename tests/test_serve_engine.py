@@ -565,6 +565,207 @@ class TestCowFork:
         assert all(engine.pool.ref(blk) == 0 for blk in shared)
 
 
+@pytest.fixture(scope="module")
+def disagg_setup():
+    """1 prefill-role + 1 decode-role engine over the in-process
+    loopback transport (serve/disagg.py)."""
+    from cloudtik_tpu.serve.disagg import DisaggServing
+    cfg = T.config("tiny", dtype=jax.numpy.float32,
+                   attention_impl="reference", remat=False)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    pair = DisaggServing(
+        params, cfg,
+        EngineConfig(slots=2, max_len=96, prefill_buckets=(8, 16),
+                     block_size=8),
+        EngineConfig(slots=2, max_len=96, prefill_buckets=(8, 16),
+                     block_size=8))
+    pair.start()
+    yield cfg, params, pair
+    pair.stop()
+
+
+class TestDisaggServing:
+    """Disaggregated prefill/decode: the decode role must continue an
+    imported sequence BIT-IDENTICALLY to a monolithic engine — the KV
+    crossing is invisible to the output."""
+
+    def test_single_request_matches_generate(self, disagg_setup):
+        cfg, params, pair = disagg_setup
+        prompt = [5, 17, 101, 9]
+        req = pair.submit(Request(prompt, max_new_tokens=8))
+        assert req.wait(timeout=300) == _reference(params, cfg,
+                                                   prompt, 8)
+        assert req.migrations == 1
+        assert req.migrated_tokens == len(prompt)
+        # TTFT stamped at import, on the decode side
+        assert req.first_token_time is not None
+
+    def test_multi_chunk_prompt_matches(self, disagg_setup):
+        """A prompt spanning several prefill chunks migrates once,
+        whole, and decodes bit-identically."""
+        cfg, params, pair = disagg_setup
+        prompt = [((i * 37) % 250) + 1 for i in range(40)]
+        req = pair.submit(Request(prompt, max_new_tokens=8))
+        assert req.wait(timeout=300) == _reference(params, cfg,
+                                                   prompt, 8)
+        assert req.prefill_chunks >= 2     # chunked on the prefill role
+        assert req.migrated_tokens == 40
+
+    def test_prefix_reused_prompts_match(self, disagg_setup):
+        """Identical and extended prompts stay bit-identical across
+        the split; the prefill role's prefix cache still hits (its
+        exported blocks park on its evictable LRU), and the decode
+        role reuses imported registered blocks."""
+        cfg, params, pair = disagg_setup
+        prompt = [((i * 13) % 250) + 1 for i in range(24)]
+        first = pair.submit(Request(prompt, max_new_tokens=6))
+        out1 = first.wait(timeout=300)
+        assert out1 == _reference(params, cfg, prompt, 6)
+        again = pair.submit(Request(prompt, max_new_tokens=6))
+        assert again.wait(timeout=300) == out1
+        ext = prompt + [7, 8, 9]
+        extended = pair.submit(Request(ext, max_new_tokens=6))
+        assert extended.wait(timeout=300) == _reference(
+            params, cfg, ext, 6)
+        # the second identical prompt hit the prefill role's cache
+        assert again.prefix_tokens > 0
+
+    def test_concurrent_mixed_lengths_match(self, disagg_setup):
+        cfg, params, pair = disagg_setup
+        prompts = [[1, 2, 3], [42, 7, 19, 23, 88, 4, 11],
+                   [((i * 11) % 250) + 1 for i in range(20)]]
+        reqs = [pair.submit(Request(p, max_new_tokens=10))
+                for p in prompts]
+        outs = [r.wait(timeout=300) for r in reqs]
+        for prompt, out in zip(prompts, outs):
+            assert out == _reference(params, cfg, prompt, 10)
+
+    def test_prefill_role_charges_prompt_only_footprint(self):
+        """The prefill role holds blocks only until export, so a
+        long-OUTPUT request must be admitted through a prefill pool
+        smaller than its worst case — while a request the DECODE role
+        can never hold still rejects up front (submit-time, so the
+        HTTP layer maps it to 413)."""
+        from cloudtik_tpu.serve.disagg import DisaggServing
+        from cloudtik_tpu.serve.engine import RequestRejected
+        cfg = T.config("tiny", dtype=jax.numpy.float32,
+                       attention_impl="reference", remat=False)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        pair = DisaggServing(
+            params, cfg,
+            EngineConfig(slots=2, max_len=64, prefill_buckets=(8, 16),
+                         block_size=8, num_blocks=5),   # 4 usable
+            EngineConfig(slots=2, max_len=64, prefill_buckets=(8, 16),
+                         block_size=8))
+        pair.start()
+        try:
+            # worst case 7 blocks > the prefill role's 4 usable, but
+            # its PROMPT is only 2 blocks — must serve, not reject
+            prompt = list(range(1, 17))
+            req = pair.submit(Request(prompt, max_new_tokens=40))
+            assert req.wait(timeout=300) == _reference(
+                params, cfg, prompt, 40)
+            # a worst case the decode role can never hold rejects at
+            # submit, before any prefill work is spent
+            bad = pair.submit(Request([1, 2, 3], max_new_tokens=500))
+            with pytest.raises(RequestRejected) as exc:
+                bad.wait(timeout=10)
+            assert exc.value.reason == "capacity"
+        finally:
+            pair.stop()
+        assert pair.prefill.pool.used() == 0
+        assert pair.decode.pool.used() == 0
+
+    def test_pools_fully_free_after_stop(self):
+        from cloudtik_tpu.serve.disagg import DisaggServing
+        cfg = T.config("tiny", dtype=jax.numpy.float32,
+                       attention_impl="reference", remat=False)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        pair = DisaggServing(
+            params, cfg,
+            EngineConfig(slots=2, max_len=64, prefill_buckets=(8,),
+                         block_size=8),
+            EngineConfig(slots=2, max_len=64, prefill_buckets=(8,),
+                         block_size=8))
+        pair.start()
+        reqs = [pair.submit(Request([i + 1] * 6, max_new_tokens=30))
+                for i in range(4)]
+        for _ in range(200):
+            if reqs[0].tokens:
+                break
+            threading.Event().wait(0.01)
+        reqs[0].cancel()
+        pair.stop()
+        for req in reqs:
+            assert req._done.is_set()
+        assert pair.prefill.pool.used() == 0
+        assert pair.decode.pool.used() == 0
+
+
+class TestPreemptionSalvage:
+    def test_mid_prefill_victim_readmits_as_prefix_hit(self):
+        """Preemption moves blocks instead of throwing them away: a
+        victim preempted MID-PREFILL parks its computed full prompt
+        blocks on the evictable prefix LRU, so re-admission reuses
+        them (prefix_tokens > 0) and only the tail re-prefills —
+        output still bit-identical.
+
+        Deterministic shape: a (oldest, 2 blocks + growth) exhausts
+        the pool while b (newest, 8 prefill chunks of 1 block) is
+        still prefilling."""
+        cfg = T.config("tiny", dtype=jax.numpy.float32,
+                       attention_impl="reference", remat=False)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        engine = DecodeEngine(params, cfg, EngineConfig(
+            slots=2, max_len=40, prefill_buckets=(4,), block_size=4,
+            num_blocks=12, prefix_cache=True))
+        engine.start()
+        try:
+            pa = [9, 8, 7, 6, 5, 4, 3]
+            pb = [((i * 11) % 250) + 1 for i in range(32)]
+            a = engine.submit(Request(pa, max_new_tokens=25))
+            b = engine.submit(Request(pb, max_new_tokens=8))
+            assert a.wait(timeout=300) == _reference(params, cfg,
+                                                     pa, 25)
+            assert b.wait(timeout=300) == _reference(params, cfg,
+                                                     pb, 8)
+            assert b.preemptions >= 1
+            # the salvage: re-admission was a prefix-cache hit
+            assert b.prefix_tokens > 0
+            assert b.prefix_tokens % engine.ec.block_size == 0
+            # the at-stake counter is visible in the exposition
+            from cloudtik_tpu import telemetry
+            assert "tik_serve_preempted_tokens_total" in \
+                telemetry.render_prometheus()
+        finally:
+            engine.stop()
+        assert engine.pool.used() == 0
+
+    def test_salvage_requires_prefix_cache(self):
+        """With the prefix cache off there is nowhere to park blocks:
+        preemption falls back to full recompute (the pre-salvage
+        behavior), still bit-correct."""
+        cfg = T.config("tiny", dtype=jax.numpy.float32,
+                       attention_impl="reference", remat=False)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        engine = DecodeEngine(params, cfg, EngineConfig(
+            slots=2, max_len=32, prefill_buckets=(8,), block_size=4,
+            num_blocks=9, prefix_cache=False))
+        engine.start()
+        try:
+            a = engine.submit(Request([9, 8, 7, 6], max_new_tokens=28))
+            b = engine.submit(Request([3, 1, 4, 1], max_new_tokens=28))
+            assert a.wait(timeout=300) == _reference(
+                params, cfg, [9, 8, 7, 6], 28)
+            assert b.wait(timeout=300) == _reference(
+                params, cfg, [3, 1, 4, 1], 28)
+            assert b.preemptions >= 1
+            assert b.prefix_tokens == 0
+        finally:
+            engine.stop()
+        assert engine.pool.used() == 0
+
+
 class TestEngineHTTP:
     def test_engine_backend_over_http(self, setup):
         """Concurrent HTTP posts ride the shared engine."""
@@ -610,6 +811,42 @@ class TestEngineHTTP:
             assert results["b"] == _reference(params, cfg, [9, 9], 4)
         finally:
             server.stop()
+
+    def test_disagg_backend_over_http(self):
+        """`tik-serve --engine --disagg` end to end: a request served
+        through the prefill→migrate→decode path over HTTP returns the
+        monolithic reference tokens and the request-id header."""
+        import json
+        import urllib.request
+
+        from cloudtik_tpu.serve.server import ServeServer, engine_backend
+
+        backend = engine_backend(slots=2, max_len=64, block_size=8,
+                                 disagg=True, prefill_slots=2,
+                                 dtype=jax.numpy.float32,
+                                 attention_impl="reference",
+                                 remat=False)
+        assert backend.name.startswith("transformer-engine-disagg")
+        cfg = T.config("tiny", dtype=jax.numpy.float32,
+                       attention_impl="reference", remat=False)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        server = ServeServer([backend], host="127.0.0.1")
+        server.start()
+        try:
+            body = json.dumps({"tokens": [[1, 2, 3]],
+                               "max_new_tokens": 4}).encode()
+            r = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/v1/generate",
+                data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(r, timeout=300) as resp:
+                payload = json.loads(resp.read())
+                assert resp.headers.get("x-tik-request-id")
+            assert payload["tokens"][0] == _reference(
+                params, cfg, [1, 2, 3], 4)
+        finally:
+            server.stop()
+            backend.engine.stop()
 
     def test_oversized_request_maps_to_413_with_reason(self):
         """A request the KV pool can never hold is a 413 whose body

@@ -234,7 +234,7 @@ class TestServeDrill:
         from cloudtik_tpu.telemetry import goodput
         serve_ledger = goodput.get_ledger("serve")
         assert serve_ledger.total(goodput.BUCKET_STEP_COMPUTE) > 0
-        assert ti.SERVE_SLOT_IDLE_FRACTION.value() is not None
+        assert ti.SERVE_SLOT_IDLE_FRACTION.value(role="engine") is not None
 
     def test_cancel_frees_slot(self, engine):
         from cloudtik_tpu.serve.engine import Request, RequestCancelled

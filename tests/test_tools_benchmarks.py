@@ -160,6 +160,54 @@ class TestServingBench:
             "--fresh", "-",
             "--history", str(tmp_path / "BENCH_none_*.json")]) == 0
 
+    def test_search_marks_capped_results(self, serving, tmp_path):
+        """Satellite: the doubling search has no silent rate ceiling.
+        An engine that meets the SLO at EVERY rate (instant stub)
+        keeps doubling until the arrival schedule is an instantaneous
+        burst vs the SLO — then stops and reports capped=True (the
+        value is a lower bound, not a knee).  A caller-pinned
+        --max-rate caps the same way; a bracketed knee is NOT capped."""
+        import time as _time
+
+        from cloudtik_tpu.serve import reqlog
+
+        class _InstantEngine:
+            """Completes every request at submit with ~zero TTFT."""
+
+            def submit(self, req):
+                req.admitted = _time.time()
+                req.admitted_mono = _time.monotonic()
+                req.first_token_time = _time.time()
+                req.first_token_mono = _time.monotonic()
+                req.tokens = [1] * req.max_new_tokens
+                req.done_time = _time.time()
+                req.done_mono = _time.monotonic()
+                reqlog.record(req, reqlog.FINISH_DONE)
+                req._done.set()
+                return req
+
+        slo = 0.5
+        best, stats, capped = serving.find_max_rate(
+            _InstantEngine(), slo, n_requests=4, seed=0,
+            ledger_dir=str(tmp_path), lo=64.0, iters=0)
+        # burst floor: doubling stopped once 4 requests spanned under
+        # slo/10 seconds of arrivals — NOT at any fixed rate ceiling
+        assert capped is True
+        assert best >= 4 / (slo * 0.1) / 2      # doubled past 80 req/s
+        assert stats["finish"]["done"] == 4
+        # caller-pinned ceiling still caps (and is marked)
+        best2, _stats2, capped2 = serving.find_max_rate(
+            _InstantEngine(), slo, n_requests=4, seed=0,
+            ledger_dir=str(tmp_path / "x"), lo=8.0, max_rate=16.0,
+            iters=0)
+        assert (best2, capped2) == (16.0, True)
+        # a zero budget stops after the first successful trial, capped
+        best3, _stats3, capped3 = serving.find_max_rate(
+            _InstantEngine(), slo, n_requests=4, seed=0,
+            ledger_dir=str(tmp_path / "y"), lo=8.0, iters=0,
+            budget_s=0.0)
+        assert (best3, capped3) == (8.0, True)
+
     def test_degraded_engine_lowers_rps_and_burns_slo(self, serving,
                                                       tmp_path,
                                                       monkeypatch):
@@ -188,7 +236,7 @@ class TestServingBench:
         try:
             serving.warm_engine(engine)
             slo_s = 1.0
-            healthy, _stats = serving.find_max_rate(
+            healthy, _stats, _capped = serving.find_max_rate(
                 engine, slo_s, n_requests=5, seed=0,
                 ledger_dir=str(tmp_path / "healthy"), lo=4.0,
                 max_rate=16.0, iters=1)
@@ -204,7 +252,7 @@ class TestServingBench:
                 seam="serve.decode_step", kind="latency", times=0,
                 args={"seconds": 1.0})])
             with seams.armed(plan):
-                degraded, _stats = serving.find_max_rate(
+                degraded, _stats, _capped = serving.find_max_rate(
                     engine, slo_s, n_requests=4, seed=0,
                     ledger_dir=str(tmp_path / "degraded"), lo=4.0,
                     max_rate=16.0, iters=1, min_rate=2.0)

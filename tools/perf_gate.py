@@ -40,6 +40,19 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_HISTORY_GLOB = "BENCH_*.json"
 DEFAULT_THRESHOLD_PCT = 10.0
 
+# Labeled own-trajectory modes: records carrying one of these "mode"
+# values form their own metric trajectories (the tag names the
+# substring their metric names carry) and must never feed another
+# metric's median even if mislabeled — e.g. a cpu_dryrun fallback can
+# not poison the flagship MFU, nor a mode:"disagg" serving line the
+# monolithic serving_rps_at_slo.
+MODE_METRIC_TAGS = {
+    "cpu_dryrun": "cpu_dryrun",    # bench.py probe-failure fallback
+    "spec": "spec",                # serving_bench.py --spec lines
+    "elasticity": "elastic",       # elasticity_bench.py dryrun lines
+    "disagg": "disagg",            # serving_bench.py --workload disagg
+}
+
 
 def extract_result(record: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     """Normalize a bench line / trajectory entry to its parsed result
@@ -74,23 +87,8 @@ def load_history(paths: List[str],
         if metric is not None and parsed.get("metric") not in (None,
                                                                metric):
             continue
-        if parsed.get("mode") == "cpu_dryrun" and \
-                "cpu_dryrun" not in str(metric or ""):
-            # probe-failure fallback records (bench.py run_cpu_dryrun)
-            # form their own trajectory; they must never feed a real
-            # device metric's median even if mislabeled
-            continue
-        if parsed.get("mode") == "spec" and \
-                "spec" not in str(metric or ""):
-            # speculative-decoding serving records
-            # (serving_bench.py --spec) form their own trajectory
-            # (serving_*_spec); they must never feed the spec-off
-            # serving median even if mislabeled
-            continue
-        if parsed.get("mode") == "elasticity" and \
-                "elastic" not in str(metric or ""):
-            # elasticity dryrun records (elasticity_bench.py) form
-            # their own trajectory (elastic_*); same isolation rule
+        tag = MODE_METRIC_TAGS.get(parsed.get("mode"))
+        if tag is not None and tag not in str(metric or ""):
             continue
         out.append((path, float(parsed["value"])))
     return out
@@ -119,7 +117,7 @@ def gate(fresh: Dict[str, Any], history: List[Tuple[str, float]],
     value = float(parsed["value"])
     floor = baseline * (1.0 - threshold_pct / 100.0)
     report.update(metric=parsed.get("metric"), value=value, floor=floor)
-    if parsed.get("mode") in ("cpu_dryrun", "spec", "elasticity"):
+    if parsed.get("mode") in MODE_METRIC_TAGS:
         report["mode"] = parsed["mode"]   # labeled own-trajectory mode
     if value < floor:
         drop = (baseline - value) / baseline * 100.0
