@@ -83,6 +83,7 @@ METRIC_SHARED_PREFIX = "serving_rps_at_slo_shared_prefix"
 METRIC_SPEC = "serving_rps_at_slo_spec"
 METRIC_SPEC_TPOT = "serving_tpot_ms_spec"
 METRIC_DISAGG = "serving_rps_at_slo_disagg"
+METRIC_REPLICATED = "serving_rps_at_slo_replicated"
 
 PROMPT_LENGTHS = (4, 6, 8, 12)
 OUTPUT_LENGTHS = (4, 8, 12)
@@ -111,6 +112,28 @@ DISAGG_HEAVY_PROMPT_LENGTHS = (40, 48, 56)
 DISAGG_HEAVY_OUTPUT_LENGTHS = (2, 4)
 DISAGG_DECODE_PROMPT_LENGTHS = (4, 6, 8)
 DISAGG_DECODE_OUTPUT_LENGTHS = (32, 48, 64)
+# multi-replica workload: G distinct 48-token block-aligned system
+# prompts (tenants) + short suffixes + SHORT outputs — prefill-heavy,
+# like shared_prefix, but the PREFIX WORKING SET (G x 6 blocks at
+# block_size 8 = 108 blocks) deliberately exceeds what ONE replica's
+# pool (60 usable blocks) can keep warm: a round-robin front door
+# makes every replica chase all G prefixes and thrash its LRU, while
+# chain-key affinity pins each group to one replica whose ~G/3 share
+# (36 blocks) fits — the capacity gap IS the routing win being
+# measured
+MULTI_REPLICA_GROUPS = 18
+MULTI_REPLICA_REPLICAS = 3
+# per-replica pool: sized so one replica's ~G/3 affinity share stays
+# warm but the full G-group set cannot (num_blocks includes the
+# reserved null block)
+MULTI_REPLICA_BLOCKS = 61
+# tighter than the router default: tenant placement over 3 replicas is
+# lumpy (consistent hashing of a few dozen keys), and the bounded-load
+# walk is what keeps the hot replica's queue from eating the affinity
+# win — a spilled group lands deterministically on its ring-NEXT
+# replica, so hot prefixes replicate to exactly as many pools as their
+# load needs
+MULTI_REPLICA_LOAD_FACTOR = 1.25
 
 
 def shared_prefix_tokens(seed: int):
@@ -118,6 +141,13 @@ def shared_prefix_tokens(seed: int):
     so the cache stays warm through the whole rate search (steady
     state, not cold start)."""
     rng = random.Random(seed + 104729)
+    return [rng.randrange(1, 100) for _ in range(SHARED_PREFIX_LEN)]
+
+
+def multi_replica_prefix_tokens(seed: int, group: int):
+    """Group `group`'s system prompt — fixed per (seed, group) across
+    trials, distinct across groups."""
+    rng = random.Random(seed * 1000003 + group + 15485863)
     return [rng.randrange(1, 100) for _ in range(SHARED_PREFIX_LEN)]
 
 
@@ -181,9 +211,19 @@ def run_trial(engine, rate: float, n_requests: int, seed: int,
         t += rng.expovariate(rate)
         arrivals.append(t)
     prefix = []
+    prefixes = picks = None
     suffix_lengths, output_lengths = PROMPT_LENGTHS, OUTPUT_LENGTHS
     if workload == "shared_prefix":
         prefix = shared_prefix_tokens(seed)
+        suffix_lengths = SUFFIX_LENGTHS
+        output_lengths = SHARED_OUTPUT_LENGTHS
+    elif workload == "multi_replica":
+        # G tenant system prompts, seeded per-request group choice —
+        # the affinity router should pin each group to one replica
+        prefixes = [multi_replica_prefix_tokens(seed, g)
+                    for g in range(MULTI_REPLICA_GROUPS)]
+        picks = [rng.randrange(MULTI_REPLICA_GROUPS)
+                 for _ in range(n_requests)]
         suffix_lengths = SUFFIX_LENGTHS
         output_lengths = SHARED_OUTPUT_LENGTHS
     elif workload == "spec":
@@ -215,12 +255,15 @@ def run_trial(engine, rate: float, n_requests: int, seed: int,
     try:
         requests = []
         t0 = time.monotonic()
-        for due, (prompt_len, max_new) in zip(arrivals, shapes):
+        for i, (due, (prompt_len, max_new)) in enumerate(
+                zip(arrivals, shapes)):
             delay = t0 + due - time.monotonic()
             if delay > 0:
                 time.sleep(delay)
-            req = Request(prefix + [rng.randrange(1, 100)
-                                    for _ in range(prompt_len)],
+            base = prefixes[picks[i]] if prefixes is not None \
+                else prefix
+            req = Request(base + [rng.randrange(1, 100)
+                                  for _ in range(prompt_len)],
                           max_new_tokens=max_new)
             engine.submit(req)
             requests.append(req)
@@ -388,6 +431,11 @@ def run(slo_ttft_p95_s: float = 0.75, n_requests: int = 24,
                           n_requests=n_requests, seed=seed, lo=lo,
                           max_rate=max_rate, iters=iters,
                           budget_s=budget_s)
+    if workload == "multi_replica":
+        return run_multi_replica(
+            slo_ttft_p95_s=slo_ttft_p95_s, n_requests=n_requests,
+            seed=seed, lo=lo, max_rate=max_rate, iters=iters,
+            budget_s=budget_s)
     if workload in ("shared_prefix", "both"):
         # the knee only shows if a trial can build enough backlog to
         # break the SLO: 4x the requests, open at 8x the rate — the
@@ -651,6 +699,114 @@ def run_disagg(slo_ttft_p95_s: float = 0.75, n_requests: int = 32,
     return [record]
 
 
+def build_replica_router(policy: str):
+    """(router, replicas): 3 tiny-model engine replicas behind the
+    affinity (or round-robin baseline) router, registered in an
+    in-memory registry.  Each engine's pool is MULTI_REPLICA_BLOCKS
+    (60 usable blocks at block_size 8 / max_len 64): one replica can
+    keep its ~6-tenant affinity share (36 prefix blocks) warm, the
+    full 18-tenant working set (108 blocks) cannot fit — exactly the
+    regime where placement decides capacity."""
+    from cloudtik_tpu.control.state import (
+        InMemoryStateBackend, StateClient)
+    from cloudtik_tpu.serve.replicas import ReplicaRegistry
+    from cloudtik_tpu.serve.router import (
+        EngineReplica, Router, RouterConfig)
+
+    registry = ReplicaRegistry(StateClient(InMemoryStateBackend()),
+                               deadline_s=10 ** 9)   # no beaters here
+    router = Router(registry, RouterConfig(
+        block_size=8, policy=policy, request_deadline_s=300.0,
+        load_factor=MULTI_REPLICA_LOAD_FACTOR))
+    replicas = []
+    for i in range(MULTI_REPLICA_REPLICAS):
+        replica = EngineReplica(
+            f"r{i}", build_engine(slots=4,
+                                  num_blocks=MULTI_REPLICA_BLOCKS))
+        replicas.append(replica)
+        router.add_client(replica, slots=4)
+    return router, replicas
+
+
+def run_multi_replica(slo_ttft_p95_s: float = 0.75,
+                      n_requests: int = 24, seed: int = 0,
+                      lo: float = 4.0,
+                      max_rate: Optional[float] = None, iters: int = 4,
+                      budget_s: Optional[float] = 240.0):
+    """Multi-replica serving fabric trajectory (--workload
+    multi_replica).
+
+    18 tenant system prompts over 3 replicas: the chain-key affinity
+    router (each tenant pinned to the replica whose prefix blocks are
+    warm) vs the SAME 3 replicas behind round-robin (every replica
+    chases all 18 prefixes and the LRU thrashes).  Emits the flagship
+    ``serving_rps_at_slo_replicated`` LAST, ``mode: "multi_replica"``
+    (its own perf_gate trajectory), with the round-robin baseline and
+    the ledgers' prefix-cache savings in detail — the affinity win
+    must be attributable to cache locality, not vibes."""
+    from cloudtik_tpu.telemetry import instruments as ti
+
+    # like shared_prefix: 4x requests at 8x the opening rate, a third
+    # of the SLO — the knee must land where prompt work queues
+    n_requests = n_requests * 4
+    slo_ttft_p95_s = slo_ttft_p95_s / 3.0
+    lo = lo * 8
+    if max_rate is not None:
+        max_rate = max_rate * 8
+    results = {}
+    for policy in ("affinity", "round_robin"):
+        router, replicas = build_replica_router(policy)
+        try:
+            for replica in replicas:
+                warm_engine(replica.engine)
+            hits0 = ti.SERVE_ROUTER_AFFINITY_HITS.value()
+            spills0 = ti.SERVE_ROUTER_SPILLS.value(reason="load")
+            with tempfile.TemporaryDirectory() as ledger_dir:
+                best, stats, capped = find_max_rate(
+                    router, slo_ttft_p95_s, n_requests, seed,
+                    ledger_dir, lo=lo, max_rate=max_rate, iters=iters,
+                    workload="multi_replica", budget_s=budget_s)
+            results[policy] = {
+                "best": best, "stats": stats, "capped": capped,
+                "affinity_hits":
+                    ti.SERVE_ROUTER_AFFINITY_HITS.value() - hits0,
+                "load_spills":
+                    ti.SERVE_ROUTER_SPILLS.value(reason="load")
+                    - spills0,
+            }
+        finally:
+            for replica in replicas:
+                replica.engine.stop()
+    aff, base = results["affinity"], results["round_robin"]
+    detail = _detail(aff["stats"], slo_ttft_p95_s, n_requests,
+                     MULTI_REPLICA_REPLICAS * 4, seed)
+    detail.update({
+        "replicas": MULTI_REPLICA_REPLICAS,
+        "prefix_groups": MULTI_REPLICA_GROUPS,
+        "shared_prefix_len": SHARED_PREFIX_LEN,
+        "search_capped": aff["capped"],
+        "affinity_hits": aff["affinity_hits"],
+        "load_spills": aff["load_spills"],
+        "baseline_rps_round_robin": round(base["best"], 3),
+        "baseline_search_capped": base["capped"],
+        "affinity_speedup_vs_round_robin":
+            round(aff["best"] / base["best"], 3)
+            if base["best"] else None,
+    })
+    if base["stats"] is not None:
+        detail["baseline_ttft_p95_s"] = base["stats"]["ttft_s"]["p95"]
+        detail["baseline_prefix_tokens_saved"] = \
+            base["stats"].get("prefix_tokens")
+        detail["baseline_prefill_chunks"] = \
+            base["stats"].get("prefill_chunks")
+    record = {"metric": METRIC_REPLICATED,
+              "value": round(aff["best"], 3), "unit": "req/s",
+              "mode": "multi_replica", "detail": detail}
+    if aff["best"] <= 0.0:
+        record["error"] = "no request rate met the TTFT SLO"
+    return [record]
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="requests/sec at a TTFT SLO (perf_gate line)")
@@ -679,14 +835,17 @@ def main(argv=None) -> int:
                         help="bisection rounds after the bracket")
     parser.add_argument("--workload",
                         choices=["mixed", "shared_prefix", "both",
-                                 "disagg"],
+                                 "disagg", "multi_replica"],
                         default="both",
                         help="which workload(s) to search; 'both' "
                              "prints shared_prefix first and the "
                              "flagship mixed line last; 'disagg' "
                              "compares 1 prefill-role + 1 decode-role "
                              "engine against 2 monolithic replicas at "
-                             "the same budget")
+                             "the same budget; 'multi_replica' "
+                             "compares 3 replicas behind the chain-key "
+                             "affinity router against the same 3 "
+                             "behind round-robin")
     parser.add_argument("--spec", action="store_true",
                         help="speculative-decoding mode: decode-heavy "
                              "workload on a spec-on engine (self-draft "

@@ -124,6 +124,63 @@ class ScalingWithTime(ScalingPolicy):
         return state
 
 
+class ServeDemandPolicy(ScalingPolicy):
+    """Serving-fabric demand: size the replica fleet from serve load.
+
+    Wraps :class:`~cloudtik_tpu.serve.replicas.ReplicaAutoscaler` —
+    queue depth and slot-idle fraction from the replica registry's
+    heartbeat stats, serve-ttft fast/slow burn rates from an injectable
+    ``burn_source`` — and publishes ``target_replicas x
+    resource_per_replica`` as resource demands, so the cluster scaler
+    launches and retires serving nodes through the same demand path as
+    every other signal.  Each add/remove/replace decision is
+    WHY-labeled (``serve_demand`` / ``serve_idle`` / ``lost_node``)
+    and journaled by the autoscaler itself.
+
+    scaling_config: ``{"resource_per_replica": {"TPU": 4},
+    "min_replicas": 1, "max_replicas": 8, "burn_threshold": 1.0,
+    "sustain_cycles": 3, "idle_cycles": 5, "slo_url":
+    "http://head:9090"}`` — ``slo_url`` points at the collector whose
+    `/api/v1/slos` carries the serve-ttft fast/slow burn rates; without
+    it (and no explicit ``burn_source``) demand adds are disabled and
+    only lost-replica replacement / idle removal fire.
+    """
+
+    def __init__(self, config: Dict[str, Any], head_host: str,
+                 state_client: StateClient,
+                 scaling_config: Optional[Dict[str, Any]] = None,
+                 burn_source=None):
+        super().__init__(config, head_host)
+        from cloudtik_tpu.serve.replicas import (
+            AutoscalerConfig, ReplicaAutoscaler, ReplicaRegistry,
+            slo_burn_source)
+        sc = scaling_config or {}
+        if burn_source is None and sc.get("slo_url"):
+            burn_source = slo_burn_source(sc["slo_url"])
+        self.resource_per_replica = sc.get("resource_per_replica",
+                                           {"TPU": 4})
+        self.registry = ReplicaRegistry(state_client)
+        self.autoscaler = ReplicaAutoscaler(
+            self.registry,
+            config=AutoscalerConfig(
+                min_replicas=sc.get("min_replicas", 1),
+                max_replicas=sc.get("max_replicas", 8),
+                burn_threshold=sc.get("burn_threshold", 1.0),
+                sustain_cycles=sc.get("sustain_cycles", 3),
+                idle_cycles=sc.get("idle_cycles", 5)),
+            burn_source=burn_source)
+
+    def name(self) -> str:
+        return "serve-demand"
+
+    def get_scaling_state(self) -> Optional[ScalingState]:
+        self.autoscaler.evaluate()
+        state = ScalingState()
+        state.set_autoscaling_instructions(make_autoscaling_instructions(
+            [dict(self.resource_per_replica)] * self.autoscaler.target))
+        return state
+
+
 class ScalingByNodeType(ScalingPolicy):
     """Direct per-node-type worker-count asks (e.g. 'tpu_v5p_32: 2')."""
 
@@ -169,4 +226,7 @@ def create_scaling_policy(
     if name == "scaling-by-node-type":
         counts = (scaling_config or {}).get("node_type_counts")
         return ScalingByNodeType(config, head_host, counts)
+    if name == "serve-demand":
+        return ServeDemandPolicy(config, head_host, state_client,
+                                 scaling_config)
     raise ValueError(f"Unknown scaling policy {name!r}")

@@ -36,6 +36,7 @@ TABLE_METRICS = "metrics"
 TABLE_HEARTBEAT = "heartbeat"
 TABLE_SCALING = "scaling"
 TABLE_SERVICES = "services"
+TABLE_SERVE_REPLICAS = "serve_replicas"
 TABLE_USER = "user"
 
 

@@ -26,6 +26,7 @@ Seam registry (keep docs/fault-injection.md in sync):
   serve.kvcache.alloc             KV block pool alloc   {need, free, evictable}  raise -> pool exhausted
   serve.kvcache.migrate           KV block export, per block chunk {request, seq, blocks}  raise -> transfer torn, request degrades to re-prefill
   serve.spec.verify               speculative verify    {request, width}  raise -> request degrades to plain decode
+  serve.router.forward            router forward attempt {replica, request}  raise -> attempt fails over to the next ring replica
   train.prefetch.next             prefetcher hand-off   {qsize}         latency -> data_wait
   elastic.slice_lost              coordinator membership poll {slice, step}  drop -> slice treated as lost
   elastic.remesh                  elastic re-mesh boundary {from_slices, to_slices, reason}  raise aborts the re-mesh

@@ -184,6 +184,26 @@ def ai_workload_dashboard() -> Dict[str, Any]:
         _panel(37, "Preempted tokens (prefill work at stake)",
                "rate(tik_serve_preempted_tokens_total[5m])",
                "short", 12, 125),
+        # -- Multi-replica router row: affinity, failover, fleet size -----
+        {"id": 38, "type": "row", "title": "Multi-replica router",
+         "collapsed": False,
+         "gridPos": {"h": 1, "w": 24, "x": 0, "y": 133}, "panels": []},
+        _panel(39, "Routed requests by result",
+               "rate(tik_serve_router_requests_total[5m])", "ops",
+               0, 134),
+        _panel(40, "Affinity hits (ring-primary placements)",
+               "rate(tik_serve_router_affinity_hits_total[5m])",
+               "ops", 12, 134),
+        _panel(41, "Spills by reason (load / drain)",
+               "rate(tik_serve_router_spills_total[5m])", "ops",
+               0, 142),
+        _panel(42, "Failovers (retried on a survivor)",
+               "rate(tik_serve_router_failovers_total[5m])", "ops",
+               12, 142),
+        _panel(43, "Replicas by state",
+               "tik_serve_router_replicas", "short", 0, 150),
+        _panel(44, "Autoscaler target replicas",
+               "tik_serve_replica_target", "short", 12, 150),
     ]
     return {
         "uid": "tik-ai-workloads",

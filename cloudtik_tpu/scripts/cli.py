@@ -1442,6 +1442,49 @@ def serve_requests(path, tail_n, since_s, finish_filter, as_stats,
             f"ttft={_fmt_ms('ttft_s')} tpot={_fmt_ms('tpot_s')}")
 
 
+@serve_group.command(name="replicas")
+@click.option("--url", required=True,
+              help="Router base URL (e.g. http://head:8210) — reads "
+                   "GET /v1/replicas.")
+@click.option("--json", "as_json", is_flag=True,
+              help="Emit the raw registry view as JSON.")
+def serve_replicas(url, as_json):
+    """The serving fabric's replica registry + live router load:
+    who is routable, who is draining/condemned, per-replica in-flight
+    counts, and the autoscaler's current target."""
+    import urllib.request
+    with urllib.request.urlopen(
+            url.rstrip("/") + "/v1/replicas", timeout=10) as resp:
+        view = json.loads(resp.read().decode())
+    if as_json:
+        click.echo(json.dumps(view, indent=1))
+        return
+    target = view.get("target_replicas")
+    click.echo(f"policy: {view.get('policy', '?')}"
+               + (f"   target replicas: {target}"
+                  if target is not None else ""))
+    click.echo(f"{'replica':<14} {'role':<8} {'state':<22} "
+               f"{'beat age':>9} {'inflight':>9} {'queue':>6} "
+               f"{'slots':>6}")
+    for rep in view.get("replicas", []):
+        if rep.get("condemned"):
+            state = f"condemned:{rep['condemned']}"
+        elif rep.get("draining"):
+            state = "draining"
+        elif rep.get("routable"):
+            state = "routable"
+        else:
+            state = "dead (beat aged out)"
+        stats = rep.get("stats") or {}
+        click.echo(
+            f"{rep.get('replica_id', '?'):<14} "
+            f"{rep.get('role', '?'):<8} {state:<22} "
+            f"{rep.get('beat_age_s', '?'):>8}s "
+            f"{rep.get('inflight', 0):>9} "
+            f"{stats.get('queue_depth', '-'):>6} "
+            f"{rep.get('slots', '-'):>6}")
+
+
 # ------------------------------------------------------------------ chaos --
 
 @cli.group()

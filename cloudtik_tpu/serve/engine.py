@@ -588,6 +588,19 @@ class DecodeEngine:
         """Convenience: submit + wait."""
         return self.submit(Request(prompt, **kw)).wait(timeout=600)
 
+    def stats(self) -> Dict[str, Any]:
+        """Load snapshot for the replica registry's heartbeat payload
+        (serve/replicas.py): queue depth, occupied slots, slot-idle
+        fraction.  Read-only and loop-thread-free — a racy glance at
+        the slot list is fine for a scaling signal."""
+        occupied = sum(1 for s in self._slots if s is not None)
+        return {
+            "queue_depth": self._queue.qsize() + len(self._waiting),
+            "active_slots": occupied,
+            "slots": self.ec.slots,
+            "slot_idle_fraction": 1.0 - occupied / self.ec.slots,
+        }
+
     def start(self) -> None:
         self._ledger.start_job()
         self._thread = threading.Thread(

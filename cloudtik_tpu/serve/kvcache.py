@@ -71,6 +71,23 @@ def blocks_for(tokens: int, block_size: int) -> int:
     return max(0, -(-tokens // block_size))
 
 
+def chain_keys(prompt: Sequence[int], block_size: int
+               ) -> List[PrefixKey]:
+    """Chain keys for every FULL block of `prompt`, in order.
+
+    ONE implementation shared by the pool's prefix map
+    (`BlockPool.prefix_keys`) and the router's affinity hashing
+    (serve/router.py) — the two must agree on key structure or
+    affinity routing silently degrades to random placement."""
+    keys: List[PrefixKey] = []
+    parent: PrefixKey = ("root",)
+    for start in range(0, len(prompt) - block_size + 1, block_size):
+        key = (parent, tuple(prompt[start:start + block_size]))
+        keys.append(key)
+        parent = key
+    return keys
+
+
 class BlockPool:
     """Free-list allocator + refcounts + prefix map over the KV pool.
 
@@ -196,14 +213,7 @@ class BlockPool:
     # -- prefix map -------------------------------------------------------
     def prefix_keys(self, prompt: Sequence[int]) -> List[PrefixKey]:
         """Chain keys for every FULL block of `prompt`, in order."""
-        keys: List[PrefixKey] = []
-        parent: PrefixKey = ("root",)
-        bs = self.block_size
-        for start in range(0, len(prompt) - bs + 1, bs):
-            key = (parent, tuple(prompt[start:start + bs]))
-            keys.append(key)
-            parent = key
-        return keys
+        return chain_keys(prompt, self.block_size)
 
     def match_prefix(self, prompt: Sequence[int], count: bool = True
                      ) -> Tuple[List[int], int]:

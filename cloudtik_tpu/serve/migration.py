@@ -37,12 +37,18 @@ transport + the re-prefill fallback hook).
 from __future__ import annotations
 
 import json
+import logging
+import socket
+import socketserver
 import struct
+import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from cloudtik_tpu.faults import seams
+
+logger = logging.getLogger(__name__)
 
 MSG_HEADER = b"KVH1"
 MSG_BLOCK = b"KVB1"
@@ -141,6 +147,167 @@ class LoopbackTransport(KVTransport):
 
     def send(self, msg: bytes) -> None:
         self._deliver(msg)
+
+
+class SocketKVTransport(KVTransport):
+    """The DCN half of the seam: length-prefixed frames over TCP.
+
+    Each ``send`` writes one ``u32 frame_length`` prefix plus the
+    already-self-describing message bytes — the receiver
+    (:class:`MigrationReceiver`) reframes and feeds its inbox, so
+    everything above the two-method surface is byte-identical to the
+    loopback.  Failure discipline:
+
+    * ``connect_timeout_s`` bounds the TCP connect;
+      ``send_timeout_s`` bounds every write — a stalled decode host
+      cannot wedge the prefill engine's loop;
+    * ANY send failure tears the connection down immediately
+      (abort-on-tear): the receiver sees EOF mid-stream and drops the
+      partial migration whole, and the engine's existing degrade path
+      (re-prefill on the decode role) owns the request.  A torn
+      transport is never reused — the caller builds a fresh one per
+      migration attempt or connection epoch.
+    """
+
+    def __init__(self, host: str, port: int,
+                 connect_timeout_s: float = 5.0,
+                 send_timeout_s: float = 10.0):
+        self.address = (host, int(port))
+        self._sock: Optional[socket.socket] = socket.create_connection(
+            self.address, timeout=connect_timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(send_timeout_s)
+
+    def send(self, msg: bytes) -> None:
+        if self._sock is None:
+            raise OSError("socket KV transport already torn down")
+        try:
+            self._sock.sendall(_U32.pack(len(msg)) + msg)
+        except (OSError, ValueError):
+            self.close()              # abort-on-tear: EOF > half frame
+            raise
+
+    def close(self) -> None:
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+def request_from_header(header: Dict[str, Any]):
+    """Construct a live engine ``Request`` from a migration header —
+    the cross-host receiver's replacement for the loopback's live-object
+    handoff.  The header already carries everything the decode side
+    needs (prompt, first token, sampling knobs, traceparent); lifecycle
+    stamps start fresh HERE, which is correct — queue wait and TTFT on
+    the decode side start when the migrated state arrives."""
+    from cloudtik_tpu.serve.engine import Request
+
+    request = Request(
+        [int(t) for t in header["prompt"]],
+        max_new_tokens=int(header.get("max_new_tokens", 16)),
+        temperature=float(header.get("temperature", 0.0)),
+        eos_id=header.get("eos_id"))
+    request.traceparent = header.get("traceparent")
+    return request
+
+
+class MigrationReceiver:
+    """TCP server side of :class:`SocketKVTransport`: reframe
+    length-prefixed messages, reassemble per-request streams, and at
+    commit construct a ``Request`` FROM THE HEADER and import it into
+    the decode-role engine — no live object crosses the wire.
+
+    ``on_finish(request)`` (optional) observes each imported request's
+    completion from a watcher thread — the hook a cross-host response
+    path (or a test) attaches to.  A connection that dies mid-stream
+    drops every migration it had in flight (torn streams never
+    half-import — the inbox only acts at commit, and partials die with
+    the connection's inbox)."""
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
+                 on_finish: Optional[Callable[[Any], None]] = None):
+        self.engine = engine
+        self.on_finish = on_finish
+        receiver = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                # one inbox per connection: a torn connection takes
+                # exactly its own partial streams down with it
+                inbox = MigrationInbox(receiver._import)
+                sock = self.request
+                try:
+                    while True:
+                        prefix = _recv_exact(sock, 4)
+                        if prefix is None:
+                            return
+                        (length,) = _U32.unpack(prefix)
+                        frame = _recv_exact(sock, length)
+                        if frame is None:
+                            return            # torn mid-frame: drop
+                        try:
+                            inbox.feed(frame)
+                        except Exception:
+                            # one bad migration (malformed frame, bad
+                            # geometry, an import-side refusal) drops
+                            # THAT request; it must not tear the
+                            # connection down and take every other
+                            # in-flight stream with it
+                            logger.warning(
+                                "dropping failed migration frame",
+                                exc_info=True)
+                except OSError:
+                    return
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def _import(self, header: Dict[str, Any], k: np.ndarray,
+                v: np.ndarray) -> None:
+        request = request_from_header(header)
+        self.engine.import_blocks(request, header, k, v)
+        if self.on_finish is not None:
+            def _watch():
+                try:
+                    request.wait(timeout=600)
+                except Exception:
+                    pass
+                self.on_finish(request)
+            threading.Thread(target=_watch, daemon=True,
+                             name="tik-migration-finish").start()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="tik-migration-receiver", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly n bytes, or None on EOF (clean or mid-buffer —
+    either way the stream is over and partials are dropped)."""
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
 
 
 # ---------------------------------------------------------------- inbox --

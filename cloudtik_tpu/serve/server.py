@@ -22,6 +22,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional
 
@@ -270,7 +271,15 @@ def gbdt_backend(model_path: str) -> ModelBackend:
 
 
 class ServeServer:
-    """Threaded HTTP server over one or more backends."""
+    """Threaded HTTP server over one or more backends.
+
+    ``drain()`` begins graceful shutdown: new submits are REFUSED with
+    503 + a ``Retry-After`` hint (the affinity router treats that as a
+    spill, not an error), while requests already being handled finish
+    normally — their ledger records stay ``done``, never ``drained``.
+    A request must never be accepted-then-drained: admitting work we
+    already know will be torn down turns clean client retries into
+    availability-budget spend."""
 
     def __init__(self, backends, host: str = "0.0.0.0", port: int = 0):
         self.backends = list(backends)
@@ -279,6 +288,10 @@ class ServeServer:
             for suffix, fn in b.endpoints.items():
                 routes[f"/v1/{suffix}"] = fn
         models = [b.name for b in self.backends]
+        self._draining = threading.Event()
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+        server = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):
@@ -309,6 +322,15 @@ class ServeServer:
                 if fn is None:
                     self._send(404, {"error": "not found"})
                     return
+                # refuse BEFORE accepting: a submit admitted during
+                # drain would finish `drained` at engine stop and
+                # spend availability budget on shutdown churn; the 503
+                # + Retry-After lets a router/client spill cleanly
+                if not server._admit():
+                    self._send(503, {"error": "server is draining",
+                                     "reason": "draining"},
+                               {"Retry-After": "1"})
+                    return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(
@@ -336,6 +358,8 @@ class ServeServer:
                 except Exception as e:
                     logger.exception("serve request failed")
                     self._send(400, {"error": str(e)})
+                finally:
+                    server._done()
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.port = self._server.server_address[1]
@@ -346,6 +370,41 @@ class ServeServer:
             target=self._server.serve_forever, name="tik-serve",
             daemon=True)
         self._thread.start()
+
+    # -- graceful drain ---------------------------------------------------
+    def _admit(self) -> bool:
+        """Count a request in unless drain began; the refusal happens
+        under the lock so drain() can never miss an in-flight one."""
+        with self._inflight_cv:
+            if self._draining.is_set():
+                return False
+            self._inflight += 1
+            return True
+
+    def _done(self) -> None:
+        with self._inflight_cv:
+            self._inflight -= 1
+            if self._inflight <= 0:
+                self._inflight_cv.notify_all()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def drain(self, grace_s: float = 30.0) -> bool:
+        """Refuse new submits (503 + Retry-After) and wait up to
+        ``grace_s`` for in-flight requests to finish.  Returns True
+        when the server emptied in time.  stop() still owns the actual
+        socket teardown."""
+        with self._inflight_cv:
+            self._draining.set()
+            deadline = time.monotonic() + grace_s
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._inflight_cv.wait(timeout=remaining)
+            return True
 
     def stop(self) -> None:
         self._server.shutdown()
@@ -391,6 +450,21 @@ def main(argv=None) -> int:
                         "prefill slots)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8200)
+    p.add_argument("--replica-id", default=None,
+                   help="register this server in the serving-fabric "
+                        "replica registry under this id (needs "
+                        "--state-host); the affinity router "
+                        "(tik-serve-router) then routes to it")
+    p.add_argument("--state-host", default=None,
+                   help="head state server holding the replica "
+                        "registry")
+    p.add_argument("--state-port", type=int, default=None)
+    p.add_argument("--advertise-url", default=None,
+                   help="URL the router should reach this replica at "
+                        "(default http://<host>:<port>)")
+    p.add_argument("--drain-grace-s", type=float, default=30.0,
+                   help="SIGTERM drain: seconds to let in-flight "
+                        "requests finish before exiting")
     args = p.parse_args(argv)
 
     # warm restarts skip prefill/decode recompiles (TIK_COMPILE_CACHE_DIR)
@@ -427,10 +501,65 @@ def main(argv=None) -> int:
     server.start()
     print(f"tik-serve listening on {args.host}:{server.port}",
           flush=True)
+
+    # serving-fabric registration: beat liveness + load stats into the
+    # head-state replica registry so the affinity router can route here
+    beater = None
+    if args.replica_id and args.state_host:
+        from cloudtik_tpu.control.state import (
+            StateClient, TcpStateBackend)
+        from cloudtik_tpu.serve.replicas import (
+            ReplicaHeartbeat, ReplicaRegistry)
+        backend_kw = {}
+        if args.state_port is not None:
+            backend_kw["port"] = args.state_port
+        registry = ReplicaRegistry(StateClient(
+            TcpStateBackend(args.state_host, **backend_kw)))
+        engine = getattr(backends[0], "engine", None)
+        role = "engine"
+        stats_fn = None
+        if engine is not None:
+            if hasattr(engine, "prefill"):       # DisaggServing pair
+                role, stats_fn = "prefill", engine.prefill.stats
+            else:
+                stats_fn = engine.stats
+        # a wildcard bind address is not a reachable URL — a router on
+        # another host dialing http://0.0.0.0:<port> connects to ITS
+        # OWN loopback; advertise the hostname instead
+        import socket as _socket
+        advertise_host = args.host
+        if advertise_host in ("0.0.0.0", "::", ""):
+            advertise_host = _socket.gethostname()
+        url = args.advertise_url or \
+            f"http://{advertise_host}:{server.port}"
+        beater = ReplicaHeartbeat(
+            registry, args.replica_id, url, role=role,
+            slots=args.slots, stats_fn=stats_fn)
+        beater.start()
+
+    stop_event = threading.Event()
+
+    def _drain_and_exit(signum, frame):
+        # graceful drain: refuse new submits (503 + Retry-After -> the
+        # router spills), mark not-routable, let in-flight finish —
+        # their ledger records stay `done`, never `drained`
+        if beater is not None:
+            beater.drain()
+        server.drain(grace_s=args.drain_grace_s)
+        stop_event.set()
+
+    import signal
+    signal.signal(signal.SIGTERM, _drain_and_exit)
     try:
-        threading.Event().wait()
+        stop_event.wait()
     except KeyboardInterrupt:
-        server.stop()
+        pass
+    if beater is not None:
+        beater.stop(deregister=True)
+    engine = getattr(backends[0], "engine", None)
+    if engine is not None:
+        engine.stop()
+    server.stop()
     return 0
 
 

@@ -82,6 +82,19 @@ SERVE_KV_MIGRATED_TOKENS: Counter = _build(
 SERVE_KV_MIGRATION_FAILURES: Counter = _build(
     "tik_serve_kv_migration_failures_total")
 
+# serve multi-replica router (serve/router.py + serve/replicas.py)
+SERVE_ROUTER_REQUESTS: Counter = _build("tik_serve_router_requests_total")
+SERVE_ROUTER_FAILOVERS: Counter = _build(
+    "tik_serve_router_failovers_total")
+SERVE_ROUTER_SPILLS: Counter = _build("tik_serve_router_spills_total")
+SERVE_ROUTER_AFFINITY_HITS: Counter = _build(
+    "tik_serve_router_affinity_hits_total")
+SERVE_ROUTER_REPLICAS: Gauge = _build("tik_serve_router_replicas")
+SERVE_ROUTER_INFLIGHT: Gauge = _build("tik_serve_router_inflight")
+SERVE_ROUTER_PROBE_FAILURES: Counter = _build(
+    "tik_serve_router_probe_failures_total")
+SERVE_REPLICA_TARGET: Gauge = _build("tik_serve_replica_target")
+
 # serve speculative decoding (EngineConfig.spec draft/verify loop)
 SERVE_SPEC_DRAFT_TOKENS: Counter = _build(
     "tik_serve_spec_draft_tokens_total")

@@ -164,6 +164,36 @@ _ALL = [
     _m("tik_serve_kv_migration_failures_total", "counter",
        "Migrations aborted mid-transfer; the request degraded to the "
        "re-prefill path on the decode role.", "serve"),
+    # -- serve multi-replica router (serve/router.py + serve/replicas.py)
+    _m("tik_serve_router_requests_total", "counter",
+       "Requests the affinity router completed, by result (ok = "
+       "finished on some replica; rejected = cleanly refused, 503 — "
+       "no routable replica or every candidate draining, work never "
+       "started; error = retries exhausted on real failures).",
+       "serve", ("result",)),
+    _m("tik_serve_router_failovers_total", "counter",
+       "Forward attempts that failed connection-shaped (dead replica, "
+       "deadline, injected fault) and retried on a survivor.",
+       "serve"),
+    _m("tik_serve_router_spills_total", "counter",
+       "Requests routed off their affinity primary, by reason (load = "
+       "bounded-load walk past a hot replica, drain = the primary "
+       "refused with 503 Retry-After).", "serve", ("reason",)),
+    _m("tik_serve_router_affinity_hits_total", "counter",
+       "Requests that landed on their chain-key ring primary — the "
+       "replica whose prefix blocks are warm.", "serve"),
+    _m("tik_serve_router_replicas", "gauge",
+       "Registry view by state (routable | draining | condemned).",
+       "serve", ("state",)),
+    _m("tik_serve_router_inflight", "gauge",
+       "Requests currently forwarded and unfinished, all replicas.",
+       "serve"),
+    _m("tik_serve_router_probe_failures_total", "counter",
+       "Health probes that failed (consecutive failures condemn the "
+       "replica).", "serve"),
+    _m("tik_serve_replica_target", "gauge",
+       "Replica count the serve_demand autoscaler currently wants.",
+       "serve"),
     # -- serve speculative decoding (EngineConfig.spec) ------------------
     _m("tik_serve_spec_draft_tokens_total", "counter",
        "Draft-model tokens proposed and verified by speculative "
@@ -313,6 +343,15 @@ _EVENT_LIST = [
      "a request's KV blocks migrated between engines (direction, "
      "result, token/block counts; a failed out-migration degrades "
      "the request to the re-prefill path)."),
+    ("tik_serve_replica_registered",
+     "a serving replica registered in the fabric registry with role "
+     "and capacity."),
+    ("tik_serve_replica_drain",
+     "a serving replica began draining (SIGTERM): not-routable, "
+     "in-flight requests finish, new traffic spills."),
+    ("tik_serve_replica_condemned",
+     "the router condemned a replica (consecutive health-probe "
+     "failures or heartbeat timeout); its traffic fails over."),
     ("tik_fault_fired",
      "an armed fault plan fired at a seam (chaos drills)."),
     ("tik_train_resume",
@@ -358,6 +397,7 @@ SPANS: Dict[str, str] = {
     "checkpoint.restore":     "checkpoint restore",
     "discovery.render":       "registry -> targets/dns render pass",
     "serve.enqueue":          "request submit -> queued",
+    "serve.router.forward":   "one router forward attempt to a replica",
     "serve.prefill":          "one prompt prefill chunk against the paged pool",
     "serve.kvcache.migrate":  "export a request's KV blocks through the migration transport",
     "serve.kvcache.import":   "import migrated KV blocks into a decode-role pool",

@@ -28,7 +28,15 @@ backend, bounded iterations):
       same committed step — then the scaler recycles the slice and
       the job re-expands to K without restarting the surviving
       process; goodput books `elastic_remesh` ≪ the
-      restart-everything baseline's `restart_replay`.
+      restart-everything baseline's `restart_replay`;
+  (h) multi-replica serving fabric: 1 of 3 router-fronted replicas is
+      killed mid-decode under open-loop load — the router condemns it
+      within the probe deadline, its in-flight AND queued requests
+      fail over to ring survivors with BIT-IDENTICAL output, ledger
+      availability stays 1.0 (zero error/drained finishes), the
+      serve_demand autoscaler journals a `lost_node` replacement ask,
+      and the condemnation + ask + failed-over request all share ONE
+      flight-recorder trace.
 """
 
 import itertools
@@ -644,3 +652,176 @@ def test_drill_torn_kv_migration_degrades_to_reprefill(tmp_path):
     assert by_id[healthy.request_id]["migrated_tokens"] == len(prompt)
     assert pair.prefill.pool.used() == 0      # no leak through the tear
     assert pair.decode.pool.used() == 0
+
+
+@pytest.mark.chaos
+def test_drill_replica_killed_mid_traffic_fails_over(tmp_path):
+    """Drill (h): the multi-replica serving fabric loses 1 of 3
+    replicas mid-decode under load.
+
+    A kill is a crash, not a drain: the victim's in-flight engine
+    requests are abandoned (cancelled — a dead process writes no
+    ledger records, and cancels spend no availability budget) and the
+    router's retry policy resubmits the idempotent work on ring
+    survivors.  Asserted: every request finishes with output
+    BIT-IDENTICAL to the models/generate reference (failed-over and
+    survivor-resident alike), ledger availability is exactly 1.0 with
+    ZERO error/drained finishes, the router condemns the victim within
+    the probe deadline, the autoscaler journals ONE
+    `lost_node`-reasoned `add_replica` ask, and the condemnation
+    event, the scaler decision, and the failed-over requests' ledger
+    records all carry the SAME trace id — one stitched story."""
+    import jax
+    import numpy as np
+
+    from cloudtik_tpu import telemetry
+    from cloudtik_tpu.models import generate as G
+    from cloudtik_tpu.models import transformer as T
+    from cloudtik_tpu.serve import reqlog
+    from cloudtik_tpu.serve.engine import (
+        DecodeEngine, EngineConfig, Request)
+    from cloudtik_tpu.serve.replicas import (
+        AutoscalerConfig, ReplicaAutoscaler, ReplicaRegistry)
+    from cloudtik_tpu.serve.router import (
+        EngineReplica, Router, RouterConfig, chain_hash)
+    from cloudtik_tpu.telemetry import events
+    from cloudtik_tpu.telemetry import instruments as ti
+    from cloudtik_tpu.utils.retry import RetryPolicy
+
+    cfg = T.config("tiny", dtype=jax.numpy.float32,
+                   attention_impl="reference", remat=False)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+
+    def make_engine():
+        engine = DecodeEngine(params, cfg, EngineConfig(
+            slots=2, max_len=64, prefill_buckets=(8, 16),
+            block_size=8))
+        engine.start()
+        return engine
+
+    replicas = [EngineReplica(f"r{i}", make_engine())
+                for i in range(3)]
+    # warm every engine outside the drill (prefill buckets + decode)
+    for replica in replicas:
+        replica.engine.generate([1, 2, 3, 4], max_new_tokens=2)
+        replica.engine.generate(list(range(1, 11)), max_new_tokens=2)
+
+    registry = ReplicaRegistry(StateClient(InMemoryStateBackend()))
+    asks = []
+    autoscaler = ReplicaAutoscaler(
+        registry, ask=lambda delta, why: asks.append((delta, why)),
+        config=AutoscalerConfig(min_replicas=3))
+    # the whole drill runs in ONE trace: the router's probe/scale
+    # thread adopts it, every hop propagates it, so condemnation +
+    # replacement ask + per-request records stitch into one story
+    drill_tp = "00-" + "d" * 32 + "-" + "1" * 16 + "-01"
+    router = Router(
+        registry,
+        RouterConfig(block_size=8, probe_interval_s=0.05,
+                     probe_timeout_s=0.5, probe_failures=2,
+                     request_deadline_s=120,
+                     retry=RetryPolicy(max_attempts=5,
+                                       base_delay_s=0.02,
+                                       max_delay_s=0.2)),
+        autoscaler=autoscaler, traceparent=drill_tp)
+    for replica in replicas:
+        router.add_client(replica, slots=2)
+
+    # three block-aligned prefix groups, so every replica owns some
+    # traffic; the victim is group 0's ring primary
+    groups = [[g * 11 + j + 1 for j in range(8)] for g in range(3)]
+    victim_id = router._ring.preference(
+        chain_hash(groups[0] + [99], 8))[0]
+    victim = next(r for r in replicas if r.replica_id == victim_id)
+    survivors = [r for r in replicas if r is not victim]
+
+    def reference(prompt, n):
+        out = G.generate(params, jax.numpy.asarray([prompt], np.int32),
+                         cfg, max_new_tokens=n)
+        return np.asarray(out)[0].tolist()
+
+    prompts = []
+    for i in range(12):
+        group = groups[i % 3]
+        prompts.append(group + [100 + i])          # shared prefix + tail
+
+    events.install(str(tmp_path / "events.jsonl"))
+    reqlog.install(str(tmp_path / "req.jsonl"))
+    failovers_before = ti.SERVE_ROUTER_FAILOVERS.value()
+    router.start()
+    try:
+        with telemetry.trace_context(drill_tp):
+            requests = []
+            for i, prompt in enumerate(prompts):
+                req = Request(prompt, max_new_tokens=12)
+                router.submit(req)
+                requests.append(req)
+            # kill the victim MID-DECODE: wait until it actually holds
+            # in-flight work, then crash it (probes start failing, its
+            # requests abandon and fail over)
+            deadline = time.time() + 30
+            while time.time() < deadline and \
+                    victim.engine.stats()["active_slots"] == 0:
+                time.sleep(0.005)
+            assert victim.engine.stats()["active_slots"] > 0, \
+                "victim never took traffic — drill setup broken"
+            victim.kill()
+            outputs = [req.wait(timeout=300) for req in requests]
+        # every request finished, bit-identical to the undisturbed
+        # reference — failed-over requests AND survivors' in-flight
+        for req, prompt, out in zip(requests, prompts, outputs):
+            assert req.error is None
+            assert out == reference(prompt, 12), \
+                f"output diverged for prompt {prompt}"
+        # the kill actually exercised failover (work was in flight)
+        assert ti.SERVE_ROUTER_FAILOVERS.value() > failovers_before
+        # the router condemns within the probe deadline
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            info = next(i for i in registry.list_replicas()
+                        if i.replica_id == victim_id)
+            if info.condemned:
+                break
+            time.sleep(0.02)
+        assert info.condemned == "probe_failed"
+        # ... and the autoscaler asked for EXACTLY one replacement
+        deadline = time.time() + 10
+        while time.time() < deadline and not asks:
+            time.sleep(0.02)
+        assert asks == [(1, "lost_node")]
+        assert [i.replica_id for i in registry.routable()] == \
+            sorted(r.replica_id for r in survivors)
+    finally:
+        router.stop()
+        reqlog.uninstall()
+        events.uninstall()
+        for replica in replicas:
+            replica.engine.stop()
+
+    # ledger: availability exactly 1.0 — the kill cost retries, never
+    # requests; a crash writes no error/drained records
+    records = reqlog.read_requests(str(tmp_path / "req.jsonl"))
+    stats = reqlog.compute_stats(records)
+    finishes = {r["finish"] for r in records}
+    assert "error" not in finishes and "drained" not in finishes
+    assert stats["availability"] == 1.0
+    done = [r for r in records if r["finish"] == "done"]
+    assert len(done) >= len(prompts)     # every request served somewhere
+
+    # one stitched trace: the condemnation event, the lost_node scaler
+    # decision, and the served requests' ledger records all carry the
+    # drill's trace id
+    drill_trace = "d" * 32
+    journal = [r for r, _s in [events.read_file(
+        str(tmp_path / "events.jsonl"))]][0]
+    condemned = [r for r in journal
+                 if r.get("name") == "tik_serve_replica_condemned"]
+    decisions = [r for r in journal
+                 if r.get("name") == "tik_scaler_decision"
+                 and r.get("reason") == "lost_node"]
+    assert condemned and condemned[0]["replica"] == victim_id
+    assert drill_trace in (condemned[0].get("traceparent") or "")
+    assert decisions and decisions[0]["action"] == "add_replica"
+    assert drill_trace in (decisions[0].get("traceparent") or "")
+    assert all(drill_trace in (r.get("traceparent") or "")
+               for r in done)

@@ -193,6 +193,11 @@ def test_seams_are_noops_without_a_plan(monkeypatch, tmp_path):
     from cloudtik_tpu.serve.engine import fire_verify_seam
     fire_verify_seam(1, 4)
 
+    # router forward seam (serve.router.forward) — the exact helper
+    # the router fires before every forward attempt
+    from cloudtik_tpu.serve.router import fire_forward_seam
+    fire_forward_seam("r0", 1)
+
     # KV-block migration export (serve.kvcache.migrate, fired per
     # block chunk through the real BlockMigrator.export path)
     import numpy as np

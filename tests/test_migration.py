@@ -10,6 +10,8 @@ boundary on imported shared blocks) survives the crossing.
 
 from __future__ import annotations
 
+import time
+
 import jax
 import numpy as np
 import pytest
@@ -267,6 +269,156 @@ class TestExportImportRoundTrip:
         decode._import_tick()
         assert req._done.is_set()
         assert req.error is not None
+        assert decode.pool.used() == 0
+
+
+class _FakeEngine:
+    """Records import_blocks calls; completes nothing."""
+
+    def __init__(self):
+        self.imports = []
+
+    def import_blocks(self, request, header, k, v):
+        self.imports.append((request, header, k, v))
+        return request
+
+
+class TestSocketTransport:
+    """The real-socket `KVTransport` (length-prefixed TCP frames) and
+    the receiver path that constructs Requests FROM MIGRATION HEADERS
+    instead of the loopback's live-object handoff."""
+
+    def test_request_from_header_carries_everything(self):
+        header = {"prompt": [1, 2, 3], "max_new_tokens": 7,
+                  "temperature": 0.5, "eos_id": 9,
+                  "traceparent":
+                      "00-" + "a" * 32 + "-" + "b" * 16 + "-01",
+                  "first_token": 4}
+        req = migration.request_from_header(header)
+        assert req.prompt == [1, 2, 3]
+        assert req.max_new_tokens == 7
+        assert req.temperature == 0.5
+        assert req.eos_id == 9
+        assert req.traceparent == header["traceparent"]
+
+    def test_framed_stream_reaches_the_engine(self):
+        engine = _FakeEngine()
+        receiver = migration.MigrationReceiver(engine,
+                                               host="127.0.0.1")
+        receiver.start()
+        try:
+            transport = migration.SocketKVTransport(
+                "127.0.0.1", receiver.port)
+            rng = np.random.default_rng(3)
+            k = rng.standard_normal((2, 2, 4, 3, 5), dtype=np.float32)
+            v = rng.standard_normal((2, 2, 4, 3, 5), dtype=np.float32)
+            header = {"request_id": 41, "prompt": [1, 2, 3, 4],
+                      "first_token": 5, "length": 4,
+                      "max_new_tokens": 4, "temperature": 0.0,
+                      "eos_id": None, "traceparent": None,
+                      "dtype": "float32", "n_layers": 2,
+                      "block_size": 4, "n_kv_heads": 3, "head_dim": 5,
+                      "blocks": 2}
+            transport.send(migration.pack_header(header))
+            for j in range(2):
+                transport.send(migration.pack_block(
+                    41, j, k[:, j], v[:, j]))
+            transport.send(migration.pack_commit(41, 2))
+            transport.close()
+            deadline = time.time() + 10
+            while not engine.imports and \
+                    time.time() < deadline:
+                time.sleep(0.01)
+            assert len(engine.imports) == 1
+            request, got_header, gk, gv = engine.imports[0]
+            # the Request was CONSTRUCTED from the header — no live
+            # object crossed the socket
+            assert request.prompt == [1, 2, 3, 4]
+            assert got_header["first_token"] == 5
+            assert np.array_equal(gk, k) and np.array_equal(gv, v)
+        finally:
+            receiver.stop()
+
+    def test_torn_connection_drops_partial_stream(self):
+        engine = _FakeEngine()
+        receiver = migration.MigrationReceiver(engine,
+                                               host="127.0.0.1")
+        receiver.start()
+        try:
+            transport = migration.SocketKVTransport(
+                "127.0.0.1", receiver.port)
+            k = np.zeros((2, 1, 4, 3, 5), np.float32)
+            header = {"request_id": 42, "blocks": 2,
+                      "dtype": "float32", "n_layers": 2,
+                      "block_size": 4, "n_kv_heads": 3, "head_dim": 5}
+            transport.send(migration.pack_header(header))
+            transport.send(migration.pack_block(42, 0, k[:, 0],
+                                                k[:, 0]))
+            transport.close()          # torn before block 1 + commit
+            time.sleep(0.3)
+            assert engine.imports == []     # never half-imported
+        finally:
+            receiver.stop()
+
+    def test_send_on_torn_transport_raises(self):
+        engine = _FakeEngine()
+        receiver = migration.MigrationReceiver(engine,
+                                               host="127.0.0.1")
+        receiver.start()
+        try:
+            transport = migration.SocketKVTransport(
+                "127.0.0.1", receiver.port)
+            transport.close()
+            with pytest.raises(OSError):
+                transport.send(b"KVC1\x00\x00\x00\x00")
+        finally:
+            receiver.stop()
+
+    def test_engine_to_engine_over_real_socket(self, tiny):
+        """Prefill-role engine -> TCP socket -> receiver constructs the
+        Request from the header -> decode-role engine: output
+        bit-identical to a monolithic generate, both pools end free,
+        and on_finish observes the completion (the hook a cross-host
+        response path attaches to)."""
+        import threading
+
+        cfg, params = tiny
+        ec = EngineConfig(slots=2, max_len=64, prefill_buckets=(8, 16),
+                          block_size=8)
+        decode = DecodeEngine(params, cfg, EngineConfig(
+            slots=2, max_len=64, prefill_buckets=(8, 16),
+            block_size=8), role="decode")
+        decode.start()
+        finished = []
+        done = threading.Event()
+        receiver = migration.MigrationReceiver(
+            decode, host="127.0.0.1",
+            on_finish=lambda req: (finished.append(req), done.set()))
+        receiver.start()
+        transport = migration.SocketKVTransport("127.0.0.1",
+                                                receiver.port)
+        prefill = DecodeEngine(
+            params, cfg, ec,
+            migrator=migration.BlockMigrator(transport))
+        prefill.start()
+        try:
+            prompt = [((i * 7) % 250) + 1 for i in range(20)]
+            prefill.submit(Request(prompt, max_new_tokens=6))
+            assert done.wait(timeout=300)
+            req = finished[0]
+            ref = np.asarray(G.generate(
+                params, jax.numpy.asarray([prompt], np.int32), cfg,
+                max_new_tokens=6))[0].tolist()
+            assert req.tokens == ref
+            assert req.error is None
+            assert req.migrations == 1
+            assert req.migrated_tokens == len(prompt)
+        finally:
+            prefill.stop()
+            decode.stop()
+            receiver.stop()
+            transport.close()
+        assert prefill.pool.used() == 0
         assert decode.pool.used() == 0
 
 

@@ -1,0 +1,855 @@
+"""Multi-replica serving fabric: registry, affinity router, autoscaler.
+
+Router semantics beyond the tier-1 chaos drill (tests/test_chaos_drills
+drills the kill-1-of-3 story): ring stability, chain-key affinity,
+bounded-load spill, retry exhaustion surfacing the ORIGINAL error,
+graceful drain producing zero `drained` ledger finishes under load,
+the `serve.router.forward` seam, socket KV transport framing, and the
+`serve_demand` autoscaler's WHY-labeled decisions.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import pytest
+
+from cloudtik_tpu.control.state import InMemoryStateBackend, StateClient
+from cloudtik_tpu.faults import seams
+from cloudtik_tpu.faults.plan import FaultInjected, FaultPlan, FaultPoint
+from cloudtik_tpu.serve.replicas import (
+    AutoscalerConfig, ReplicaAutoscaler, ReplicaHeartbeat,
+    ReplicaRegistry)
+from cloudtik_tpu.serve.router import (
+    EngineReplica, HashRing, NoRoutableReplica, ReplicaClient,
+    ReplicaDraining, ReplicaRejected, ReplicaUnavailable, Router,
+    RouterConfig, chain_hash, fire_forward_seam, prefix_chain_key)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    seams.disarm()
+    yield
+    seams.disarm()
+
+
+def make_registry(**kw) -> ReplicaRegistry:
+    return ReplicaRegistry(StateClient(InMemoryStateBackend()), **kw)
+
+
+class FakeReplica(ReplicaClient):
+    """Deterministic in-test replica: records forwards, scripted
+    failures, controllable health/drain."""
+
+    def __init__(self, replica_id: str, fail_with: Optional[
+            BaseException] = None, delay_s: float = 0.0):
+        self.replica_id = replica_id
+        self.fail_with = fail_with
+        self.delay_s = delay_s
+        self.forwards: List[Dict] = []
+        self.healthy = True
+        self._lock = threading.Lock()
+
+    def forward(self, payload, timeout_s, traceparent=None):
+        with self._lock:
+            self.forwards.append(dict(payload))
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.fail_with is not None:
+            raise self.fail_with
+        return {"tokens": [[7, 8, 9]], "request_id": 1}
+
+    def health(self, timeout_s=2.0):
+        return self.healthy
+
+
+def make_router(replicas, registry=None, autoscaler=None, **config_kw
+                ) -> Router:
+    registry = registry or make_registry()
+    config_kw.setdefault("block_size", 4)
+    router = Router(registry, RouterConfig(**config_kw),
+                    autoscaler=autoscaler)
+    for replica in replicas:
+        router.add_client(replica, slots=4)
+    return router
+
+
+# ------------------------------------------------------------ chain keys --
+
+class TestChainKeys:
+    def test_partial_tail_block_excluded(self):
+        # two prompts sharing their block-aligned prefix route
+        # identically no matter how the partial tail differs
+        a = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+        b = [1, 2, 3, 4, 5, 6, 7, 8, 200, 201]
+        assert prefix_chain_key(a, 4) == prefix_chain_key(b, 4)
+        assert chain_hash(a, 4) == chain_hash(b, 4)
+
+    def test_full_block_divergence_changes_key(self):
+        a = [1, 2, 3, 4, 5, 6, 7, 8]
+        b = [1, 2, 3, 4, 9, 9, 9, 9]
+        assert chain_hash(a, 4) != chain_hash(b, 4)
+
+    def test_stable_across_processes(self):
+        # content hash, not salted hash(): a router restart must not
+        # reshuffle every prefix onto cold replicas
+        assert chain_hash([1, 2, 3, 4], 4) == chain_hash([1, 2, 3, 4], 4)
+        assert isinstance(chain_hash([], 4), int)
+
+
+class TestHashRing:
+    def test_adding_a_replica_moves_about_one_nth(self):
+        members = [f"r{i}" for i in range(4)]
+        ring4 = HashRing(members)
+        ring5 = HashRing(members + ["r4"])
+        keys = [chain_hash([i, i + 1, i + 2, i + 3], 4)
+                for i in range(2000)]
+        moved = sum(1 for k in keys
+                    if ring4.preference(k)[0] != ring5.preference(k)[0])
+        # ideal is 1/5 = 400; consistent hashing should be well under a
+        # naive rehash (which moves ~4/5) and near the ideal
+        assert 100 <= moved <= 700, moved
+
+    def test_preference_lists_every_member_once(self):
+        ring = HashRing(["a", "b", "c"])
+        pref = ring.preference(12345)
+        assert sorted(pref) == ["a", "b", "c"]
+
+    def test_empty_ring(self):
+        assert HashRing([]).preference(1) == []
+
+
+# ---------------------------------------------------------------- registry --
+
+class TestRegistry:
+    def test_register_beat_routable(self):
+        registry = make_registry()
+        registry.register("r1", "http://h:1", slots=4)
+        assert [i.replica_id for i in registry.routable()] == ["r1"]
+        info = registry.list_replicas()[0]
+        assert info.slots == 4 and info.url == "http://h:1"
+
+    def test_heartbeat_timeout_ages_out(self):
+        registry = make_registry(deadline_s=0.05)
+        registry.register("r1", None)
+        assert registry.routable()
+        time.sleep(0.1)
+        assert registry.routable() == []
+        registry.beat("r1")             # a fresh beat revives it
+        assert registry.routable()
+
+    def test_condemn_and_reregister(self):
+        registry = make_registry()
+        registry.register("r1", None)
+        registry.condemn("r1", "probe_failed")
+        assert registry.routable() == []
+        assert registry.list_replicas()[0].condemned == "probe_failed"
+        # condemning again keeps the first why
+        registry.condemn("r1", "heartbeat_timeout")
+        assert registry.list_replicas()[0].condemned == "probe_failed"
+        # an explicit re-register is the 'this one is back' signal
+        registry.register("r1", None)
+        assert [i.replica_id for i in registry.routable()] == ["r1"]
+
+    def test_draining_not_routable(self):
+        registry = make_registry()
+        registry.register("r1", None)
+        registry.set_draining("r1")
+        assert registry.routable() == []
+
+    def test_beat_carries_stats(self):
+        registry = make_registry()
+        registry.register("r1", None)
+        registry.beat("r1", stats={"queue_depth": 3,
+                                   "slot_idle_fraction": 0.5})
+        info = registry.routable()[0]
+        assert info.queue_depth == 3
+        assert info.slot_idle_fraction == 0.5
+
+    def test_beat_for_unknown_replica_is_dropped(self):
+        registry = make_registry()
+        registry.beat("ghost", stats={"queue_depth": 1})
+        assert registry.list_replicas() == []
+
+    def test_heartbeat_thread_keeps_replica_alive(self):
+        registry = make_registry(deadline_s=0.2)
+        beater = ReplicaHeartbeat(registry, "r1", None, slots=2,
+                                  stats_fn=lambda: {"queue_depth": 1},
+                                  period_s=0.03)
+        beater.start()
+        try:
+            time.sleep(0.4)             # several deadlines later
+            assert registry.routable()
+            assert registry.routable()[0].queue_depth == 1
+        finally:
+            beater.stop(deregister=True)
+        assert registry.list_replicas() == []
+
+
+# ------------------------------------------------------------------ router --
+
+class TestRouting:
+    def test_affinity_same_prefix_same_replica(self):
+        replicas = [FakeReplica(f"r{i}") for i in range(3)]
+        router = make_router(replicas)
+        payload = {"tokens": [1, 2, 3, 4, 9],
+                   "max_new_tokens": 2}
+        for suffix in range(5):
+            router.handle(dict(payload,
+                               tokens=[1, 2, 3, 4, 100 + suffix]))
+        hit = [r for r in replicas if r.forwards]
+        assert len(hit) == 1            # all five landed together
+        assert len(hit[0].forwards) == 5
+
+    def test_bounded_load_spills_to_ring_neighbor(self):
+        # the affinity primary is saturated with slow in-flight work;
+        # with load_factor 1.0 the next request must spill rather than
+        # queue behind it
+        replicas = [FakeReplica(f"r{i}", delay_s=0.3) for i in range(3)]
+        router = make_router(replicas, load_factor=1.0)
+        prompt = [1, 2, 3, 4]
+        primary_id = router._ring.preference(
+            chain_hash(prompt, 4))[0]
+        primary = next(r for r in replicas
+                       if r.replica_id == primary_id)
+
+        threads = [threading.Thread(
+            target=lambda: router.handle({"tokens": prompt}))
+            for _ in range(6)]
+        for t in threads:
+            t.start()
+            time.sleep(0.02)            # stagger so in-flight builds
+        for t in threads:
+            t.join(timeout=10)
+        others = sum(len(r.forwards) for r in replicas
+                     if r is not primary)
+        assert primary.forwards          # affinity still used
+        assert others > 0                # ...but the overflow spilled
+
+    def test_round_robin_policy_spreads(self):
+        replicas = [FakeReplica(f"r{i}") for i in range(3)]
+        router = make_router(replicas, policy="round_robin")
+        for _ in range(6):
+            router.handle({"tokens": [1, 2, 3, 4]})
+        assert all(len(r.forwards) == 2 for r in replicas)
+
+    def test_failover_retries_on_survivor(self):
+        registry = make_registry()
+        dead = FakeReplica("r0", fail_with=ReplicaUnavailable("down"))
+        live = FakeReplica("r1")
+        router = make_router([dead, live], registry=registry)
+        result = router.handle({"tokens": [1, 2, 3, 4]})
+        assert result["tokens"] == [[7, 8, 9]]
+        # exactly one of them got the retry; the failed one was tried
+        assert (len(dead.forwards), len(live.forwards)) in (
+            (1, 1), (0, 1))
+
+    def test_exhaustion_surfaces_the_original_error(self):
+        # every replica fails: the caller must see the underlying
+        # replica error, not the RetriesExhausted wrapper
+        boom = ReplicaUnavailable("replica r0 exploded")
+        replicas = [FakeReplica(f"r{i}", fail_with=boom)
+                    for i in range(2)]
+        router = make_router(replicas)
+        with pytest.raises(ReplicaUnavailable, match="exploded"):
+            router.handle({"tokens": [1, 2, 3, 4]})
+
+    def test_sampled_requests_do_not_retry(self):
+        # temperature > 0 is not idempotent: the error surfaces on the
+        # first failure instead of silently re-running elsewhere
+        dead = FakeReplica("r0", fail_with=ReplicaUnavailable("down"))
+        live = FakeReplica("r1")
+        router = make_router([dead, live])
+        # force the primary to be the dead one by trying prompts
+        for base in range(100):
+            prompt = [base, base + 1, base + 2, base + 3]
+            if router._ring.preference(
+                    chain_hash(prompt, 4))[0] == "r0":
+                break
+        with pytest.raises(ReplicaUnavailable):
+            router.handle({"tokens": prompt, "temperature": 0.8})
+        assert live.forwards == []       # never re-ran the sampled work
+
+    def test_drain_spills_without_error(self):
+        registry = make_registry()
+        draining = FakeReplica("r0",
+                               fail_with=ReplicaDraining("draining"))
+        live = FakeReplica("r1")
+        router = make_router([draining, live], registry=registry)
+        # drain spills retry even for sampled requests (nothing ran)
+        result = router.handle({"tokens": [1, 2, 3, 4],
+                                "temperature": 0.9})
+        assert result["tokens"] == [[7, 8, 9]]
+        assert len(live.forwards) == 1
+
+    def test_no_routable_replica(self):
+        router = make_router([])
+        with pytest.raises(NoRoutableReplica):
+            router.handle({"tokens": [1, 2, 3, 4]})
+
+    def test_every_candidate_draining_surfaces_draining_as_rejected(
+            self):
+        # a rolling restart draining EVERYTHING must surface as a
+        # clean retriable refusal (ReplicaDraining -> 503 at the HTTP
+        # layer, result="rejected"), never a generic error
+        from cloudtik_tpu.telemetry import instruments as ti
+        replicas = [FakeReplica(f"r{i}",
+                                fail_with=ReplicaDraining("draining"))
+                    for i in range(2)]
+        router = make_router(replicas)
+        rejected0 = ti.SERVE_ROUTER_REQUESTS.value(result="rejected")
+        with pytest.raises(ReplicaDraining):
+            router.handle({"tokens": [1, 2, 3, 4]})
+        assert ti.SERVE_ROUTER_REQUESTS.value(
+            result="rejected") == rejected0 + 1
+
+    def test_replica_4xx_surfaces_as_rejected_never_retried(self):
+        # a client-caused refusal (oversized prompt -> replica 413)
+        # must surface with the replica's status, count `rejected`,
+        # and never re-run on a survivor (it can never succeed)
+        from cloudtik_tpu.telemetry import instruments as ti
+        rejecting = FakeReplica(
+            "r0", fail_with=ReplicaRejected("too big", status=413))
+        live = FakeReplica("r1")
+        router = make_router([rejecting, live])
+        for base in range(100):
+            prompt = [base, base + 1, base + 2, base + 3]
+            if router._ring.preference(
+                    chain_hash(prompt, 4))[0] == "r0":
+                break
+        rejected0 = ti.SERVE_ROUTER_REQUESTS.value(result="rejected")
+        with pytest.raises(ReplicaRejected) as exc_info:
+            router.handle({"tokens": prompt})
+        assert exc_info.value.status == 413
+        assert live.forwards == []       # never retried elsewhere
+        assert ti.SERVE_ROUTER_REQUESTS.value(
+            result="rejected") == rejected0 + 1
+
+    def test_chain_key_is_the_kvcache_chain_key(self):
+        # affinity hashes the SAME chain keys the prefix map shares
+        # blocks by — a drifted copy would silently degrade routing
+        from cloudtik_tpu.serve import kvcache
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+        assert prefix_chain_key(prompt, 4) == \
+            kvcache.chain_keys(prompt, 4)[-1]
+        pool = kvcache.BlockPool(num_blocks=8, block_size=4)
+        assert pool.prefix_keys(prompt)[-1] == \
+            prefix_chain_key(prompt, 4)
+
+    def test_failover_placement_is_not_an_affinity_hit(self):
+        # the ring-second replica a failover lands on is NOT the
+        # primary whose blocks are warm — the locality metric must
+        # not count it
+        from cloudtik_tpu.telemetry import instruments as ti
+        dead = FakeReplica("r0", fail_with=ReplicaUnavailable("down"))
+        live = FakeReplica("r1")
+        router = make_router([dead, live])
+        for base in range(100):
+            prompt = [base, base + 1, base + 2, base + 3]
+            if router._ring.preference(
+                    chain_hash(prompt, 4))[0] == "r0":
+                break
+        hits0 = ti.SERVE_ROUTER_AFFINITY_HITS.value()
+        router.handle({"tokens": prompt})
+        # exactly one hit: the attempt on the true primary; the
+        # survivor placement after the failover counts none
+        assert ti.SERVE_ROUTER_AFFINITY_HITS.value() == hits0 + 1
+        assert len(live.forwards) == 1
+
+    def test_probe_failures_condemn(self):
+        registry = make_registry()
+        replicas = [FakeReplica(f"r{i}") for i in range(2)]
+        router = make_router(replicas, registry=registry,
+                             probe_failures=2)
+        replicas[0].healthy = False
+        router.probe_cycle()
+        assert registry.routable()      # one strike is not out
+        assert len(registry.routable()) == 2
+        router.probe_cycle()
+        routable = [i.replica_id for i in registry.routable()]
+        assert routable == ["r1"]
+        info = next(i for i in registry.list_replicas()
+                    if i.replica_id == "r0")
+        assert info.condemned == "probe_failed"
+
+    def test_describe_reports_states(self):
+        registry = make_registry()
+        replicas = [FakeReplica(f"r{i}") for i in range(2)]
+        router = make_router(replicas, registry=registry)
+        registry.set_draining("r1")
+        router.sync()
+        view = {r["replica_id"]: r
+                for r in router.describe()["replicas"]}
+        assert view["r0"]["routable"] and not view["r1"]["routable"]
+        assert view["r1"]["draining"]
+
+
+# -------------------------------------------------------------- fault seam --
+
+class TestForwardSeam:
+    def test_armed_raise_fails_over(self):
+        replicas = [FakeReplica(f"r{i}") for i in range(2)]
+        router = make_router(replicas)
+        prompt = [1, 2, 3, 4]
+        primary = router._ring.preference(chain_hash(prompt, 4))[0]
+        plan = FaultPlan([FaultPoint("serve.router.forward", "raise",
+                                     times=1,
+                                     match={"replica": primary})])
+        with seams.armed(plan):
+            result = router.handle({"tokens": prompt})
+        assert plan.points[0].fired == 1
+        assert result["tokens"] == [[7, 8, 9]]
+        # the faulted primary never saw the payload; a survivor did
+        total = sum(len(r.forwards) for r in replicas)
+        assert total == 1
+
+    def test_seam_fires_with_context(self):
+        plan = FaultPlan([FaultPoint("serve.router.forward", "raise",
+                                     times=1, match={"replica": "rX"})])
+        with seams.armed(plan):
+            fire_forward_seam("rY", 1)          # no match, no fire
+            with pytest.raises(FaultInjected):
+                fire_forward_seam("rX", 2)
+        assert plan.points[0].fired == 1
+
+
+# -------------------------------------------------------- drain under load --
+
+class TestDrainUnderLoad:
+    def test_drain_leaves_zero_drained_finishes(self, tmp_path):
+        """Graceful drain under live traffic: the draining replica's
+        in-flight requests finish `done`, new traffic spills to the
+        survivor, and the ledger ends with ZERO `drained` records."""
+        import jax
+
+        from cloudtik_tpu.models import transformer as T
+        from cloudtik_tpu.serve import reqlog
+        from cloudtik_tpu.serve.engine import (
+            DecodeEngine, EngineConfig, Request)
+
+        cfg = T.config("tiny", dtype=jax.numpy.float32,
+                       attention_impl="reference", remat=False)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+
+        def make_engine():
+            engine = DecodeEngine(params, cfg, EngineConfig(
+                slots=2, max_len=64, prefill_buckets=(8, 16),
+                block_size=8))
+            engine.start()
+            return engine
+
+        replicas = [EngineReplica(f"r{i}", make_engine())
+                    for i in range(2)]
+        router = make_router(replicas, block_size=8,
+                             request_deadline_s=60)
+        reqlog.install(str(tmp_path / "req.jsonl"))
+        try:
+            requests = []
+            for i in range(10):
+                req = Request([i + 1, 2, 3, 4, 5, 6, 7, 8, 9],
+                              max_new_tokens=4)
+                router.submit(req)
+                requests.append(req)
+                if i == 3:
+                    # drain r0 mid-stream: registry mark + client-side
+                    # refusal (the HTTP twin is 503 + Retry-After)
+                    router.registry.set_draining("r0")
+                    replicas[0].drain()
+                    router.sync()
+            outs = [req.wait(timeout=120) for req in requests]
+            assert all(outs)
+            assert all(req.error is None for req in requests)
+        finally:
+            reqlog.uninstall()
+            for replica in replicas:
+                replica.engine.stop()
+        records = reqlog.read_requests(str(tmp_path / "req.jsonl"))
+        finishes = {r["finish"] for r in records}
+        assert "drained" not in finishes
+        assert "error" not in finishes
+        stats = reqlog.compute_stats(records)
+        assert stats["availability"] == 1.0
+
+
+# -------------------------------------------------------------- autoscaler --
+
+class TestAutoscaler:
+    def _fleet(self, registry, n=3, stats=None):
+        for i in range(n):
+            registry.register(f"r{i}", None, slots=4)
+            if stats is not None:
+                registry.beat(f"r{i}", stats=stats)
+
+    def test_lost_replica_asks_once_with_lost_node_why(self):
+        registry = make_registry()
+        self._fleet(registry, 3)
+        asks = []
+        autoscaler = ReplicaAutoscaler(
+            registry, ask=lambda d, r: asks.append((d, r)),
+            config=AutoscalerConfig(min_replicas=3))
+        assert autoscaler.evaluate() is None
+        registry.condemn("r1", "probe_failed")
+        decision = autoscaler.evaluate()
+        assert decision["action"] == "add_replica"
+        assert decision["reason"] == "lost_node"
+        # the ask is journaled once, not once per evaluation cycle
+        assert autoscaler.evaluate() is None
+        assert asks == [(1, "lost_node")]
+        # the replacement arriving clears the deficit
+        registry.register("r3", None, slots=4)
+        assert autoscaler.evaluate() is None
+
+    def test_sustained_burn_with_backlog_adds_replica(self):
+        registry = make_registry()
+        self._fleet(registry, 2, stats={"queue_depth": 5,
+                                        "slot_idle_fraction": 0.0})
+        burn = {"fast": 3.0, "slow": 2.0}
+        asks = []
+        autoscaler = ReplicaAutoscaler(
+            registry, ask=lambda d, r: asks.append((d, r)),
+            config=AutoscalerConfig(min_replicas=2, sustain_cycles=3),
+            burn_source=lambda: burn)
+        assert autoscaler.evaluate() is None     # 1
+        assert autoscaler.evaluate() is None     # 2
+        decision = autoscaler.evaluate()         # 3: sustained
+        assert decision["reason"] == "serve_demand"
+        assert autoscaler.target == 3
+        assert asks == [(1, "serve_demand")]
+
+    def test_burn_without_backlog_does_not_add(self):
+        registry = make_registry()
+        self._fleet(registry, 2, stats={"queue_depth": 0,
+                                        "slot_idle_fraction": 0.0})
+        autoscaler = ReplicaAutoscaler(
+            registry, config=AutoscalerConfig(min_replicas=2,
+                                              sustain_cycles=1),
+            burn_source=lambda: {"fast": 9.0, "slow": 9.0})
+        for _ in range(5):
+            assert autoscaler.evaluate() is None
+
+    def test_one_window_burning_is_not_sustained(self):
+        registry = make_registry()
+        self._fleet(registry, 2, stats={"queue_depth": 5})
+        autoscaler = ReplicaAutoscaler(
+            registry, config=AutoscalerConfig(min_replicas=2,
+                                              sustain_cycles=1),
+            burn_source=lambda: {"fast": 9.0, "slow": 0.1})
+        assert autoscaler.evaluate() is None
+
+    def test_sustained_idle_removes_down_to_floor(self):
+        registry = make_registry()
+        self._fleet(registry, 3, stats={"queue_depth": 0,
+                                        "slot_idle_fraction": 1.0})
+        asks = []
+        autoscaler = ReplicaAutoscaler(
+            registry, ask=lambda d, r: asks.append((d, r)),
+            config=AutoscalerConfig(min_replicas=2, idle_cycles=2))
+        autoscaler.target = 3
+        assert autoscaler.evaluate() is None
+        decision = autoscaler.evaluate()
+        assert decision["action"] == "remove_replica"
+        assert decision["reason"] == "serve_idle"
+        assert autoscaler.target == 2
+        # at the floor: never below min_replicas
+        for _ in range(5):
+            decision = autoscaler.evaluate()
+            assert decision is None or \
+                decision["action"] != "remove_replica"
+        assert autoscaler.target == 2
+
+    def test_slo_burn_source_reads_collector_endpoint(self):
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        from cloudtik_tpu.serve.replicas import slo_burn_source
+
+        payload = {"status": "success", "data": {"slos": [
+            {"name": "serve-tpot", "burn_fast": 0.1,
+             "burn_slow": 0.1},
+            {"name": "serve-ttft", "burn_fast": 3.5,
+             "burn_slow": 2.25},
+        ]}}
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = _json.dumps(payload).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        server = HTTPServer(("127.0.0.1", 0), Handler)
+        thread = threading.Thread(target=server.serve_forever,
+                                  daemon=True)
+        thread.start()
+        try:
+            url = f"http://127.0.0.1:{server.server_address[1]}"
+            source = slo_burn_source(url)
+            assert source() == {"fast": 3.5, "slow": 2.25}
+            # a window with no data holds (None), never scales
+            payload["data"]["slos"][1]["burn_fast"] = None
+            assert source() is None
+            # an unreachable collector holds too
+            dead = slo_burn_source("http://127.0.0.1:1", timeout_s=0.3)
+            assert dead() is None
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_serve_demand_policy_wires_slo_url_burn_source(self):
+        from cloudtik_tpu.control.scaling_policies import (
+            create_scaling_policy)
+        client = StateClient(InMemoryStateBackend())
+        policy = create_scaling_policy(
+            "serve-demand", {}, "head", state_client=client,
+            scaling_config={"slo_url": "http://head:9090"})
+        assert policy.autoscaler.burn_source is not None
+
+    def test_serve_demand_policy_publishes_target_demands(self):
+        from cloudtik_tpu.control.scaling_policies import (
+            create_scaling_policy)
+        client = StateClient(InMemoryStateBackend())
+        registry = ReplicaRegistry(client)
+        registry.register("r0", None, slots=4)
+        policy = create_scaling_policy(
+            "serve-demand", {}, "head", state_client=client,
+            scaling_config={"resource_per_replica": {"TPU": 8},
+                            "min_replicas": 2})
+        assert policy.name() == "serve-demand"
+        state = policy.get_scaling_state()
+        demands = state.autoscaling_instructions["resource_demands"]
+        assert demands == [{"TPU": 8}, {"TPU": 8}]
+
+
+# ----------------------------------------------- HTTP fabric end-to-end --
+
+class TestHttpFabric:
+    def test_router_server_routes_over_http(self, tmp_path):
+        """The real wire path: two tik-serve engine replicas behind a
+        RouterServer — POST /v1/generate routes with affinity, GET
+        /v1/replicas reports the registry, a drained replica's 503 +
+        Retry-After spills to the survivor, and the routed output
+        matches a direct hit on a replica."""
+        import json as _json
+        import urllib.request
+
+        import jax
+
+        from cloudtik_tpu.models import transformer as T
+        from cloudtik_tpu.serve.engine import DecodeEngine, EngineConfig
+        from cloudtik_tpu.serve.router import (
+            HttpReplica, RouterServer)
+        from cloudtik_tpu.serve.server import ModelBackend, ServeServer
+
+        cfg = T.config("tiny", dtype=jax.numpy.float32,
+                       attention_impl="reference", remat=False)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+
+        servers = []
+        engines = []
+        for i in range(2):
+            engine = DecodeEngine(params, cfg, EngineConfig(
+                slots=2, max_len=64, prefill_buckets=(8, 16),
+                block_size=8))
+            engine.start()
+            engines.append(engine)
+
+            def generate(payload, engine=engine):
+                from cloudtik_tpu.serve.engine import Request
+                prompt = payload["tokens"]
+                prompt = prompt[0] if prompt and \
+                    isinstance(prompt[0], list) else prompt
+                req = engine.submit(Request(
+                    [int(t) for t in prompt],
+                    max_new_tokens=int(
+                        payload.get("max_new_tokens", 16))))
+                return {"tokens": [req.wait(timeout=120)]}
+
+            server = ServeServer(
+                [ModelBackend("engine", {"generate": generate})],
+                host="127.0.0.1", port=0)
+            server.start()
+            servers.append(server)
+
+        registry = make_registry()
+        router = Router(registry, RouterConfig(block_size=8,
+                                               request_deadline_s=120))
+        for i, server in enumerate(servers):
+            url = f"http://127.0.0.1:{server.port}"
+            registry.register(f"r{i}", url, slots=2)
+            router._clients[f"r{i}"] = HttpReplica(f"r{i}", url)
+        router.sync()
+        front = RouterServer(router, host="127.0.0.1", port=0)
+        front.start()
+        try:
+            prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+            body = _json.dumps({"tokens": [prompt],
+                                "max_new_tokens": 4}).encode()
+
+            client_tp = "00-" + "c" * 32 + "-" + "9" * 16 + "-01"
+
+            def post():
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{front.port}/v1/generate",
+                    data=body,
+                    headers={"Content-Type": "application/json",
+                             "traceparent": client_tp})
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    return (_json.loads(resp.read().decode()),
+                            resp.headers)
+
+            routed, headers = post()
+            routed = routed["tokens"][0]
+            direct = engines[0].generate(prompt, max_new_tokens=4)
+            assert routed == direct        # greedy, replica-agnostic
+            # the response echoes the trace the hops carried, read
+            # INSIDE the request's trace context — the client's join
+            # key for `tik cluster trace export --trace-id`
+            assert "c" * 32 in (
+                headers.get("x-tik-traceparent") or "")
+
+            # registry view over HTTP
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{front.port}/v1/replicas",
+                    timeout=10) as resp:
+                view = _json.loads(resp.read().decode())
+            assert len(view["replicas"]) == 2
+            assert all(r["routable"] for r in view["replicas"])
+
+            # drain one replica at the HTTP level: its 503 spills
+            primary_id = router._ring.preference(
+                chain_hash(prompt, 8))[0]
+            primary_idx = int(primary_id[1:])
+            servers[primary_idx].drain(grace_s=5)
+            assert post()[0]["tokens"][0] == direct  # spilled, served
+        finally:
+            front.stop()
+            for server in servers:
+                server.stop()
+            for engine in engines:
+                engine.stop()
+
+
+# ------------------------------------------------- disabled telemetry path --
+
+class TestDisabledTelemetryPath:
+    def test_router_paths_are_attribute_checks_when_off(
+            self, monkeypatch):
+        """TIK_TELEMETRY=off: routing, probing, registry writes, and
+        autoscaler evaluation must never reach a metric record path, a
+        span ring append, or an event journal append — the same
+        tripwire discipline every other serve surface obeys."""
+        from cloudtik_tpu import telemetry
+        from cloudtik_tpu.telemetry import core as tcore
+        from cloudtik_tpu.telemetry import events
+
+        def boom(*a, **k):
+            raise AssertionError(
+                "telemetry record path reached while disabled")
+
+        monkeypatch.setattr(tcore.Counter, "_record", boom)
+        monkeypatch.setattr(tcore.Gauge, "_record", boom)
+        monkeypatch.setattr(tcore.Histogram, "_record", boom)
+        monkeypatch.setattr(tcore.SpanRing, "append", boom)
+        monkeypatch.setattr(events.EventJournal, "append", boom)
+        monkeypatch.setenv("TIK_TELEMETRY", "off")
+        telemetry.configure_from_env()
+        try:
+            registry = make_registry()
+            asks = []
+            autoscaler = ReplicaAutoscaler(
+                registry, ask=lambda d, r: asks.append((d, r)),
+                config=AutoscalerConfig(min_replicas=1))
+            router = Router(registry,
+                            RouterConfig(block_size=4,
+                                         probe_failures=1),
+                            autoscaler=autoscaler)
+            router.add_client(FakeReplica("r0"), slots=4)
+            result = router.handle({"tokens": [1, 2, 3, 4]})
+            assert result["tokens"] == [[7, 8, 9]]
+            router.probe_cycle()
+            registry.set_draining("r0")
+            registry.condemn("r0", "probe_failed")
+        finally:
+            telemetry.enable()
+
+
+# -------------------------------------------------- HTTP drain (503) twin --
+
+class TestServerDrain:
+    def test_drain_returns_503_with_retry_after(self):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        from cloudtik_tpu.serve.server import ModelBackend, ServeServer
+
+        backend = ModelBackend("echo", {
+            "generate": lambda payload: {"tokens": payload["tokens"]}})
+        server = ServeServer([backend], host="127.0.0.1", port=0)
+        server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/v1/generate"
+            body = _json.dumps({"tokens": [[1, 2]]}).encode()
+
+            def post():
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json"})
+                return urllib.request.urlopen(req, timeout=10)
+
+            with post() as resp:
+                assert resp.status == 200
+            assert server.drain(grace_s=5.0)
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                post()
+            assert exc_info.value.code == 503
+            assert exc_info.value.headers.get("Retry-After") == "1"
+            payload = _json.loads(exc_info.value.read().decode())
+            assert payload["reason"] == "draining"
+        finally:
+            server.stop()
+
+    def test_drain_waits_for_inflight(self):
+        from cloudtik_tpu.serve.server import ModelBackend, ServeServer
+
+        release = threading.Event()
+        started = threading.Event()
+
+        def slow(payload):
+            started.set()
+            release.wait(timeout=10)
+            return {"ok": True}
+
+        server = ServeServer(
+            [ModelBackend("slow", {"generate": slow})],
+            host="127.0.0.1", port=0)
+        server.start()
+        try:
+            import json as _json
+            import urllib.request
+
+            def post():
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{server.port}/v1/generate",
+                    data=_json.dumps({}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return resp.status
+
+            worker = threading.Thread(target=post, daemon=True)
+            worker.start()
+            assert started.wait(timeout=10)
+            # drain with the request still in flight: it must wait
+            assert not server.drain(grace_s=0.2)
+            release.set()
+            worker.join(timeout=10)
+            assert server.drain(grace_s=5.0)     # now empty
+        finally:
+            release.set()
+            server.stop()

@@ -160,6 +160,39 @@ class TestServingBench:
             "--fresh", "-",
             "--history", str(tmp_path / "BENCH_none_*.json")]) == 0
 
+    def test_multi_replica_mode_emits_own_trajectory(
+            self, serving, capsys, monkeypatch, tmp_path):
+        """`--workload multi_replica` emits ONE
+        serving_rps_at_slo_replicated line, mode="multi_replica" (its
+        own perf_gate trajectory), with the round-robin baseline and
+        the affinity-attribution counters in detail."""
+        rc = serving.main(["--workload", "multi_replica",
+                           "--requests", "4", "--iters", "0",
+                           "--lo", "1", "--max-rate", "2",
+                           "--slo-ttft-p95", "6.0"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        lines = [l for l in out.splitlines()
+                 if l.strip().startswith("{")]
+        assert len(lines) == 1
+        record = json.loads(lines[0])
+        assert record["metric"] == "serving_rps_at_slo_replicated"
+        assert record["mode"] == "multi_replica"
+        assert record["value"] > 0
+        assert "error" not in record
+        detail = record["detail"]
+        assert detail["replicas"] == 3
+        assert detail["availability"] == 1.0
+        assert "baseline_rps_round_robin" in detail
+        assert detail["affinity_hits"] > 0
+        assert detail["prefix_tokens_saved"] > 0
+        perf_gate = _load_path(REPO / "tools" / "perf_gate.py",
+                               "perf_gate_multi_replica")
+        monkeypatch.setattr("sys.stdin", io.StringIO(lines[0]))
+        assert perf_gate.main([
+            "--fresh", "-",
+            "--history", str(tmp_path / "BENCH_none_*.json")]) == 0
+
     def test_search_marks_capped_results(self, serving, tmp_path):
         """Satellite: the doubling search has no silent rate ceiling.
         An engine that meets the SLO at EVERY rate (instant stub)

@@ -51,6 +51,8 @@ MODE_METRIC_TAGS = {
     "spec": "spec",                # serving_bench.py --spec lines
     "elasticity": "elastic",       # elasticity_bench.py dryrun lines
     "disagg": "disagg",            # serving_bench.py --workload disagg
+    # serving_bench.py --workload multi_replica (affinity router)
+    "multi_replica": "replicated",
 }
 
 
