@@ -42,6 +42,16 @@ until the SLO knee is bracketed, bounded by a wall-clock ``--budget-s``
 (a budget- or ``--max-rate``-stopped search is marked
 ``search_capped`` in detail — the value is a lower bound, not a knee).
 
+``--workload multi_tenant`` is the **multi-tenant LoRA** trajectory
+(`run_multi_tenant`): A adapters x skewed Poisson traffic multiplexed
+through ONE engine (S-LoRA-style gathered batched-adapter decode +
+weighted-fair admission) vs A dedicated merged-weights engines at the
+same total slot/block budget, traffic routed by tenant — the flagship
+``serving_rps_at_slo_multi_tenant`` (``mode: "multi_tenant"``) with
+the dedicated baseline and the FIFO-vs-WFQ fairness drill (a bursting
+tenant must not push a steady tenant's TTFT p95 past the SLO) in
+detail.
+
 ``--spec`` switches to the **speculative-decoding** trajectory
 (`run_spec`): a decode-heavy workload (short prompts, long outputs) on
 a spec-on engine — the draft is the target itself, so greedy
@@ -84,6 +94,7 @@ METRIC_SPEC = "serving_rps_at_slo_spec"
 METRIC_SPEC_TPOT = "serving_tpot_ms_spec"
 METRIC_DISAGG = "serving_rps_at_slo_disagg"
 METRIC_REPLICATED = "serving_rps_at_slo_replicated"
+METRIC_MULTI_TENANT = "serving_rps_at_slo_multi_tenant"
 
 PROMPT_LENGTHS = (4, 6, 8, 12)
 OUTPUT_LENGTHS = (4, 8, 12)
@@ -134,6 +145,32 @@ MULTI_REPLICA_BLOCKS = 61
 # replica, so hot prefixes replicate to exactly as many pools as their
 # load needs
 MULTI_REPLICA_LOAD_FACTOR = 1.25
+# multi-tenant workload: A products (each a LoRA adapter over the one
+# base model) share one engine, traffic SKEWED across them (real
+# multi-product fleets are never uniform — the hot product's burst is
+# exactly what fairness must contain).  The equal-budget baseline is A
+# dedicated merged-weights engines, each with 1/A of the slots and
+# blocks, traffic routed by tenant: the consolidation question is
+# "does multiplexing A products through one batched forward beat
+# static partitioning" — S-LoRA's claim, measured at the SLO knee.
+MULTI_TENANT_ADAPTERS = 4
+MULTI_TENANT_TRAFFIC_WEIGHTS = (8, 4, 2, 1)
+MULTI_TENANT_SLOTS = 4
+MULTI_TENANT_BLOCKS = 49          # 48 usable; dedicated: 4 x 12
+MULTI_TENANT_MAX_LEN = 64
+MULTI_TENANT_LORA_RANK = 4
+# fairness drill: one tenant dumps a BURST at t=0 while a well-behaved
+# tenant keeps a steady trickle; the steady tenant's TTFT p95 is
+# judged against the drill SLO (a fifth of the flagship SLO — like
+# shared_prefix judges a third: the victim's budget must be tight
+# relative to the burst's drain time, or FIFO "passes" by luck of a
+# fast host) under FIFO vs weighted-fair admission.  Sized so FIFO
+# parks the steady tenant behind ~96 x 24 tokens of burst drain while
+# WFQ admits it within ~one request's decode.
+FAIRNESS_BURST = 96
+FAIRNESS_BURST_NEW_TOKENS = 24
+FAIRNESS_STEADY = 8
+FAIRNESS_STEADY_NEW_TOKENS = 4
 
 
 def shared_prefix_tokens(seed: int):
@@ -211,8 +248,15 @@ def run_trial(engine, rate: float, n_requests: int, seed: int,
         t += rng.expovariate(rate)
         arrivals.append(t)
     prefix = []
-    prefixes = picks = None
+    prefixes = picks = tenant_picks = None
     suffix_lengths, output_lengths = PROMPT_LENGTHS, OUTPUT_LENGTHS
+    if workload == "multi_tenant":
+        # seeded SKEWED tenant choice: the hot product dominates, the
+        # tail products must still meet their SLO behind it
+        tenants = [f"t{i}" for i in range(MULTI_TENANT_ADAPTERS)]
+        tenant_picks = rng.choices(
+            tenants, weights=MULTI_TENANT_TRAFFIC_WEIGHTS,
+            k=n_requests)
     if workload == "shared_prefix":
         prefix = shared_prefix_tokens(seed)
         suffix_lengths = SUFFIX_LENGTHS
@@ -262,9 +306,16 @@ def run_trial(engine, rate: float, n_requests: int, seed: int,
                 time.sleep(delay)
             base = prefixes[picks[i]] if prefixes is not None \
                 else prefix
+            tenant_kw = {}
+            if tenant_picks is not None:
+                # tenant tags the ledger record; adapter_id selects the
+                # LoRA delta (the dedicated baseline's router clears it
+                # — its engines carry the weights pre-merged)
+                tenant_kw = {"tenant": tenant_picks[i],
+                             "adapter_id": tenant_picks[i]}
             req = Request(base + [rng.randrange(1, 100)
                                   for _ in range(prompt_len)],
-                          max_new_tokens=max_new)
+                          max_new_tokens=max_new, **tenant_kw)
             engine.submit(req)
             requests.append(req)
         for req in requests:
@@ -433,6 +484,11 @@ def run(slo_ttft_p95_s: float = 0.75, n_requests: int = 24,
                           budget_s=budget_s)
     if workload == "multi_replica":
         return run_multi_replica(
+            slo_ttft_p95_s=slo_ttft_p95_s, n_requests=n_requests,
+            seed=seed, lo=lo, max_rate=max_rate, iters=iters,
+            budget_s=budget_s)
+    if workload == "multi_tenant":
+        return run_multi_tenant(
             slo_ttft_p95_s=slo_ttft_p95_s, n_requests=n_requests,
             seed=seed, lo=lo, max_rate=max_rate, iters=iters,
             budget_s=budget_s)
@@ -807,6 +863,235 @@ def run_multi_replica(slo_ttft_p95_s: float = 0.75,
     return [record]
 
 
+def _multi_tenant_model(seed: int):
+    """(cfg, base params, lora config, tenant -> adapter params).
+    Adapters are random NONZERO LoRA deltas — distinct products, not
+    relabeled copies of the base model."""
+    import jax
+
+    from cloudtik_tpu.models import lora as LO
+    from cloudtik_tpu.models import transformer as T
+
+    cfg = T.config("tiny", dtype=jax.numpy.float32,
+                   attention_impl="reference", remat=False)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    lora_cfg = LO.LoRAConfig(rank=MULTI_TENANT_LORA_RANK)
+    bank = {f"t{i}": LO.random_lora_params(
+                jax.random.PRNGKey(seed * 100 + i + 1), cfg, lora_cfg)
+            for i in range(MULTI_TENANT_ADAPTERS)}
+    return cfg, params, lora_cfg, bank
+
+
+def build_multi_tenant_engine(seed: int = 0, admission: str = "wfq",
+                              max_queue_depth=None):
+    """ONE engine serving all A adapters through the gathered
+    batched-adapter path, started; caller owns stop()."""
+    from cloudtik_tpu.serve.adapters import AdapterPool
+    from cloudtik_tpu.serve.engine import DecodeEngine, EngineConfig
+
+    cfg, params, lora_cfg, bank = _multi_tenant_model(seed)
+    pool = AdapterPool(params, cfg, lora_cfg,
+                       loader=lambda aid: bank[aid],
+                       capacity=MULTI_TENANT_ADAPTERS)
+    engine = DecodeEngine(
+        params, cfg,
+        EngineConfig(slots=MULTI_TENANT_SLOTS,
+                     max_len=MULTI_TENANT_MAX_LEN,
+                     prefill_buckets=(8, 16), block_size=8,
+                     num_blocks=MULTI_TENANT_BLOCKS,
+                     admission=admission,
+                     max_queue_depth=max_queue_depth),
+        adapters=pool)
+    engine.start()
+    return engine
+
+
+class _TenantDedicated:
+    """tenant -> dedicated merged-weights engine: the N-dedicated-
+    engines equal-budget baseline.  Requests route by tenant and
+    decode with adapter_id=None — each engine carries its tenant's
+    adapter pre-merged into the weights."""
+
+    def __init__(self, engines):
+        self.engines = dict(engines)
+
+    def submit(self, req):
+        req.adapter_id = None
+        return self.engines[req.tenant].submit(req)
+
+    def stop(self):
+        for engine in self.engines.values():
+            engine.stop()
+
+
+def build_dedicated_baseline(seed: int = 0) -> _TenantDedicated:
+    """A dedicated engines at the SAME total slot/block budget: each
+    gets slots/A lanes and (usable blocks)/A blocks of its own."""
+    from cloudtik_tpu.models import lora as LO
+    from cloudtik_tpu.serve.engine import DecodeEngine, EngineConfig
+
+    cfg, params, lora_cfg, bank = _multi_tenant_model(seed)
+    per_slots = max(MULTI_TENANT_SLOTS // MULTI_TENANT_ADAPTERS, 1)
+    per_blocks = (MULTI_TENANT_BLOCKS - 1) // MULTI_TENANT_ADAPTERS
+    engines = {}
+    for tenant, adapter in bank.items():
+        merged = dict(params)
+        merged["layers"] = LO.merge_lora(params["layers"], adapter,
+                                         lora_cfg)
+        engine = DecodeEngine(
+            merged, cfg,
+            EngineConfig(slots=per_slots,
+                         max_len=MULTI_TENANT_MAX_LEN,
+                         prefill_buckets=(8, 16), block_size=8,
+                         num_blocks=per_blocks + 1))
+        engine.start()
+        engines[tenant] = engine
+    return _TenantDedicated(engines)
+
+
+def warm_multi_tenant(engine) -> None:
+    """Compile every program a trial will hit OUTSIDE the measured
+    window: both prefill buckets, the gathered heterogeneous decode
+    (two adapters in one batch), the merged homogeneous fallback (a
+    base-only batch), and pre-load all adapters so trial-time loads
+    are plane writes, not compiles."""
+    from cloudtik_tpu.serve.engine import Request
+
+    reqs = [engine.submit(Request([1, 2, 3, 4], max_new_tokens=4,
+                                  tenant=f"t{i}", adapter_id=f"t{i}"))
+            for i in range(MULTI_TENANT_ADAPTERS)]
+    reqs.append(engine.submit(Request(list(range(1, 11)),
+                                      max_new_tokens=4)))
+    for req in reqs:
+        req.wait(timeout=300)
+    engine.generate([5, 6, 7], max_new_tokens=4)
+
+
+def fairness_drill(slo_ttft_p95_s: float, seed: int = 0):
+    """The weighted-fair admission drill: tenant "burst" dumps
+    FAIRNESS_BURST requests at t=0 while tenant "steady" trickles in
+    behind it; the steady tenant's ledger TTFT p95 is judged against
+    the SLO under FIFO vs WFQ admission on the same engine shape.
+    FIFO makes the steady tenant wait behind the whole burst; WFQ
+    admits the steady tenant's head-of-line request as soon as a slot
+    frees (the burster holds more slots/weight), so the burst queues
+    behind ITSELF."""
+    from cloudtik_tpu.serve import reqlog
+    from cloudtik_tpu.serve.engine import Request
+
+    rng = random.Random(seed + 31337)
+    burst_prompts = [[rng.randrange(1, 100) for _ in range(6)]
+                     for _ in range(FAIRNESS_BURST)]
+    steady_prompts = [[rng.randrange(1, 100) for _ in range(4)]
+                      for _ in range(FAIRNESS_STEADY)]
+    out = {"slo_ttft_p95_s": slo_ttft_p95_s,
+           "burst_requests": FAIRNESS_BURST,
+           "steady_requests": FAIRNESS_STEADY}
+    for admission in ("fifo", "wfq"):
+        engine = build_multi_tenant_engine(seed=seed,
+                                           admission=admission)
+        try:
+            warm_multi_tenant(engine)
+            with tempfile.TemporaryDirectory() as ledger_dir:
+                path = os.path.join(ledger_dir, "fairness.jsonl")
+                reqlog.install(path)
+                try:
+                    reqs = [engine.submit(Request(
+                        prompt,
+                        max_new_tokens=FAIRNESS_BURST_NEW_TOKENS,
+                        tenant="burst", adapter_id="t0"))
+                        for prompt in burst_prompts]
+                    for prompt in steady_prompts:
+                        time.sleep(0.05)
+                        reqs.append(engine.submit(Request(
+                            prompt,
+                            max_new_tokens=FAIRNESS_STEADY_NEW_TOKENS,
+                            tenant="steady", adapter_id="t1")))
+                    for req in reqs:
+                        try:
+                            req.wait(timeout=300)
+                        except Exception:
+                            pass
+                finally:
+                    reqlog.uninstall()
+                grouped = reqlog.group_stats(
+                    reqlog.read_requests(path))
+                steady = grouped.get("steady", {})
+                p95 = steady.get("ttft_s", {}).get("p95")
+        finally:
+            engine.stop()
+        out[f"{admission}_steady_ttft_p95_s"] = \
+            round(p95, 4) if p95 is not None else None
+        out[f"{admission}_steady_meets_slo"] = \
+            p95 is not None and p95 <= slo_ttft_p95_s
+    return out
+
+
+def run_multi_tenant(slo_ttft_p95_s: float = 0.75,
+                     n_requests: int = 24, seed: int = 0,
+                     lo: float = 4.0, max_rate=None, iters: int = 4,
+                     budget_s=240.0):
+    """Multi-tenant LoRA trajectory (--workload multi_tenant).
+
+    A adapters x skewed Poisson traffic on ONE engine (gathered
+    batched-adapter decode, WFQ admission) vs A dedicated
+    merged-weights engines at the same total slot/block budget with
+    traffic routed by tenant.  The consolidation win is structural:
+    the shared engine's 4 lanes batch WHOEVER is busy (the hot
+    tenant's queue borrows the cold tenants' idle lanes), while each
+    dedicated engine is capped at its 1/A share — its hot tenant
+    queues behind one lane while the other engines idle.  Emits the
+    flagship ``serving_rps_at_slo_multi_tenant`` LAST (``mode:
+    "multi_tenant"``, its own perf_gate trajectory) with the
+    dedicated baseline AND the weighted-fair fairness drill (burst
+    vs steady tenant under FIFO/WFQ) in detail."""
+    n_requests = n_requests * 4
+    engine = build_multi_tenant_engine(seed=seed)
+    try:
+        warm_multi_tenant(engine)
+        with tempfile.TemporaryDirectory() as ledger_dir:
+            best, stats, capped = find_max_rate(
+                engine, slo_ttft_p95_s, n_requests, seed, ledger_dir,
+                lo=lo, max_rate=max_rate, iters=iters,
+                workload="multi_tenant", budget_s=budget_s)
+    finally:
+        engine.stop()
+    baseline = build_dedicated_baseline(seed=seed)
+    try:
+        for eng in baseline.engines.values():
+            warm_engine(eng)
+        with tempfile.TemporaryDirectory() as ledger_dir:
+            base_best, base_stats, base_capped = find_max_rate(
+                baseline, slo_ttft_p95_s, n_requests, seed,
+                ledger_dir, lo=lo, max_rate=max_rate, iters=iters,
+                workload="multi_tenant", budget_s=budget_s)
+    finally:
+        baseline.stop()
+    fairness = fairness_drill(slo_ttft_p95_s / 5.0, seed=seed)
+    detail = _detail(stats, slo_ttft_p95_s, n_requests,
+                     MULTI_TENANT_SLOTS, seed)
+    detail.update({
+        "adapters": MULTI_TENANT_ADAPTERS,
+        "traffic_weights": list(MULTI_TENANT_TRAFFIC_WEIGHTS),
+        "lora_rank": MULTI_TENANT_LORA_RANK,
+        "search_capped": capped,
+        "baseline_rps_dedicated": round(base_best, 3),
+        "baseline_search_capped": base_capped,
+        "baseline_engines": MULTI_TENANT_ADAPTERS,
+        "multi_tenant_speedup_vs_dedicated":
+            round(best / base_best, 3) if base_best else None,
+        "fairness": fairness,
+    })
+    if base_stats is not None:
+        detail["baseline_ttft_p95_s"] = base_stats["ttft_s"]["p95"]
+    record = {"metric": METRIC_MULTI_TENANT,
+              "value": round(best, 3), "unit": "req/s",
+              "mode": "multi_tenant", "detail": detail}
+    if best <= 0.0:
+        record["error"] = "no request rate met the TTFT SLO"
+    return [record]
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="requests/sec at a TTFT SLO (perf_gate line)")
@@ -835,7 +1120,8 @@ def main(argv=None) -> int:
                         help="bisection rounds after the bracket")
     parser.add_argument("--workload",
                         choices=["mixed", "shared_prefix", "both",
-                                 "disagg", "multi_replica"],
+                                 "disagg", "multi_replica",
+                                 "multi_tenant"],
                         default="both",
                         help="which workload(s) to search; 'both' "
                              "prints shared_prefix first and the "
@@ -845,7 +1131,12 @@ def main(argv=None) -> int:
                              "the same budget; 'multi_replica' "
                              "compares 3 replicas behind the chain-key "
                              "affinity router against the same 3 "
-                             "behind round-robin")
+                             "behind round-robin; 'multi_tenant' "
+                             "compares A LoRA adapters multiplexed on "
+                             "one engine (gathered batched-adapter "
+                             "decode + WFQ admission) against A "
+                             "dedicated merged-weights engines at the "
+                             "same budget")
     parser.add_argument("--spec", action="store_true",
                         help="speculative-decoding mode: decode-heavy "
                              "workload on a spec-on engine (self-draft "

@@ -24,6 +24,7 @@ Seam registry (keep docs/fault-injection.md in sync):
   events.append                   flight recorder append {name, path}    supports torn_write
   serve.reqlog.append             request ledger append {name, path}     supports torn_write
   serve.kvcache.alloc             KV block pool alloc   {need, free, evictable}  raise -> pool exhausted
+  serve.lora.load                 LoRA adapter cold load {adapter}      raise -> the request fails, not the engine
   serve.kvcache.migrate           KV block export, per block chunk {request, seq, blocks}  raise -> transfer torn, request degrades to re-prefill
   serve.spec.verify               speculative verify    {request, width}  raise -> request degrades to plain decode
   serve.router.forward            router forward attempt {replica, request}  raise -> attempt fails over to the next ring replica

@@ -27,6 +27,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from cloudtik_tpu.models import lora as LO
 from cloudtik_tpu.models.transformer import (
     TransformerConfig, _embed_lookup, _lm_head, _rms_norm, _rope)
 
@@ -64,10 +65,15 @@ def _attend(q: jax.Array, ck: jax.Array, cv: jax.Array, start,
 
 
 def _layer_step(cfg: TransformerConfig, x: jax.Array, layer: Params,
-                ck: jax.Array, cv: jax.Array, start
-                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+                ck: jax.Array, cv: jax.Array, start,
+                lora=None) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One layer over S new tokens at absolute position `start`.
-    ck/cv [B, max_len, Hkv, Dh] are updated in place (returned)."""
+    ck/cv [B, max_len, Hkv, Dh] are updated in place (returned).
+
+    `lora` is the gathered batched-adapter triple ``(layer_planes,
+    idx, scale)`` (models/lora.py): each lane's low-rank delta is
+    applied NEXT TO the base projection it adapts — pre-RoPE, exactly
+    where a merged weight would have acted."""
     B, S, d = x.shape
     positions = start + jnp.broadcast_to(
         jnp.arange(S, dtype=jnp.int32), (B, S))
@@ -75,6 +81,14 @@ def _layer_step(cfg: TransformerConfig, x: jax.Array, layer: Params,
     q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cfg.dtype))
     k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(cfg.dtype))
     v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(cfg.dtype))
+    if lora is not None:
+        planes, idx, scale = lora
+        if "wq" in planes:
+            q = q + LO.gathered_delta("wq", h, planes, idx, scale)
+        if "wk" in planes:
+            k = k + LO.gathered_delta("wk", h, planes, idx, scale)
+        if "wv" in planes:
+            v = v + LO.gathered_delta("wv", h, planes, idx, scale)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
@@ -84,6 +98,10 @@ def _layer_step(cfg: TransformerConfig, x: jax.Array, layer: Params,
     o = _attend(q, ck, cv, start, cfg)
     attn_out = jnp.einsum("bshk,hkd->bsd", o,
                           layer["wo"].astype(cfg.dtype))
+    if lora is not None and "wo" in lora[0]:
+        planes, idx, scale = lora
+        attn_out = attn_out + LO.gathered_delta("wo", o, planes, idx,
+                                                scale)
     x = x + attn_out
     h = _rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
     if cfg.is_moe:
@@ -103,21 +121,40 @@ def _layer_step(cfg: TransformerConfig, x: jax.Array, layer: Params,
 
 def forward_step(params: Params, tokens: jax.Array,
                  cache: Dict[str, jax.Array],
-                 cfg: TransformerConfig
+                 cfg: TransformerConfig, lora=None
                  ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Run S new tokens through all layers against the cache.
-    tokens [B, S] -> (logits [B, S, vocab] f32, updated cache)."""
+    tokens [B, S] -> (logits [B, S, vocab] f32, updated cache).
+
+    `lora` enables the gathered batched-adapter path: ``{"planes":
+    {target: {a: [L, A, ...], b: [L, A, ...]}}, "idx": [B] int32,
+    "scale": float}`` — the planes' layer axis rides the scan next to
+    params["layers"], so N heterogeneous adapters cost one program."""
     start = cache["length"]
     x = _embed_lookup(params["embed"], tokens, cfg)
 
-    def body(carry, xs):
-        x = carry
-        layer, ck, cv = xs
-        x, ck, cv = _layer_step(cfg, x, layer, ck, cv, start)
-        return x, (ck, cv)
+    if lora is None:
+        def body(carry, xs):
+            x = carry
+            layer, ck, cv = xs
+            x, ck, cv = _layer_step(cfg, x, layer, ck, cv, start)
+            return x, (ck, cv)
 
-    x, (ks, vs) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"]))
+        x, (ks, vs) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
+    else:
+        idx, scale = lora["idx"], lora["scale"]
+
+        def body(carry, xs):
+            x = carry
+            layer, ck, cv, planes = xs
+            x, ck, cv = _layer_step(cfg, x, layer, ck, cv, start,
+                                    lora=(planes, idx, scale))
+            return x, (ck, cv)
+
+        x, (ks, vs) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"],
+                      lora["planes"]))
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum(
         "bsd,dv->bsv", x, _lm_head(params, cfg).astype(cfg.dtype),
@@ -165,7 +202,7 @@ def gather_paged_cache(kp: jax.Array, vp: jax.Array, table: jax.Array
 
 def paged_prefill_chunk(params: Params, kp: jax.Array, vp: jax.Array,
                         table: jax.Array, tokens: jax.Array, start,
-                        cfg: TransformerConfig
+                        cfg: TransformerConfig, lora=None
                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Run one prompt chunk against a paged pool (chunked prefill).
 
@@ -197,7 +234,7 @@ def paged_prefill_chunk(params: Params, kp: jax.Array, vp: jax.Array,
     cv = jnp.concatenate([cv, scratch], axis=2)
     logits, cache = forward_step(params, tokens,
                                  {"k": ck, "v": cv, "length": start},
-                                 cfg)
+                                 cfg, lora=lora)
     nk = cache["k"][:, :, :M * bs].reshape(L, M, bs, H, D)
     nv = cache["v"][:, :, :M * bs].reshape(L, M, bs, H, D)
     kp = kp.at[:, table].set(nk)

@@ -8,12 +8,26 @@ so the base params stay frozen (no optimizer state for them) and the
 gradient flows only through the adapter leaves — the optimizer trains
 ~0.1% of the parameters while GSPMD shards the frozen base like any
 other pytree.
+
+Multi-tenant serving (S-LoRA, Sheng et al. 2023; Punica, Chen et al.
+MLSys'24) adds the **gathered batched-adapter** half: N adapters stack
+into fixed-capacity planes ``a: [L, A, rows..., r]`` / ``b: [L, A, r,
+cols...]`` (:func:`stack_adapters`, :func:`init_adapter_planes` +
+:func:`write_adapter_slot` for in-place hot-loading), and a decode step
+carrying per-slot adapter indices gathers each lane's pair out of the
+planes and applies the low-rank delta ``((h @ a[idx]) @ b[idx]) *
+scale`` NEXT TO the base projection — one fused base+delta forward for
+a batch of heterogeneous-adapter requests, no per-adapter dispatch
+(:func:`gathered_delta` is the shared application; models/generate.py
+and serve/engine.py call it from their layer steps).  Plane slot 0 is
+the reserved **null adapter** (all zeros — delta exactly 0), so
+base-model requests ride the same program.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -83,6 +97,116 @@ def init_lora_params(rng: jax.Array, cfg: TransformerConfig,
         out[t] = {"a": a.astype(cfg.param_dtype),
                   "b": b.astype(cfg.param_dtype)}
     return out
+
+
+def random_lora_params(rng: jax.Array, cfg: TransformerConfig,
+                       lora: LoRAConfig, scale: float = 0.05) -> Params:
+    """Adapter with NONZERO a and b — a distinct function, not the
+    identity ``init_lora_params`` trains from.  Tests and benches use
+    this to make per-adapter outputs actually differ."""
+    params = init_lora_params(rng, cfg, lora)
+    for i, t in enumerate(sorted(params)):
+        k = jax.random.fold_in(jax.random.fold_in(rng, 1000 + i), 7)
+        b = params[t]["b"]
+        params[t]["b"] = (jax.random.normal(k, b.shape, jnp.float32)
+                          * scale).astype(b.dtype)
+    return params
+
+
+# ----------------------------------------------------- gathered adapters --
+# The serving half (S-LoRA / Punica): all resident adapters live in
+# fixed-capacity stacked planes, and a batched forward gathers each
+# slot's pair by index — heterogeneous-adapter requests share ONE
+# program.  Plane axis order is [L, A, ...]: the layer axis leads so a
+# `lax.scan` over layers slices it exactly like params["layers"], and
+# the adapter axis rides inside for the per-slot gather.
+
+def plane_shapes(cfg: TransformerConfig, lora: LoRAConfig,
+                 capacity: int) -> Dict[str, Dict[str, Tuple[int, ...]]]:
+    """Stacked-plane shapes for `capacity` adapter slots."""
+    d, L, r = cfg.d_model, cfg.n_layers, lora.rank
+    out: Dict[str, Dict[str, Tuple[int, ...]]] = {}
+    for t in lora.targets:
+        heads = cfg.n_heads if t in ("wq", "wo") else cfg.n_kv_heads
+        if _LAYOUTS[t][0] == "in_embed":
+            a = (L, capacity, d, r)
+            b = (L, capacity, r, heads, cfg.head_dim)
+        else:
+            a = (L, capacity, heads, cfg.head_dim, r)
+            b = (L, capacity, r, d)
+        out[t] = {"a": a, "b": b}
+    return out
+
+
+def init_adapter_planes(cfg: TransformerConfig, lora: LoRAConfig,
+                        capacity: int) -> Params:
+    """Zeroed stacked planes: every slot starts as the null adapter
+    (delta exactly 0 — slot 0 stays that way forever)."""
+    shapes = plane_shapes(cfg, lora, capacity)
+    return {t: {k: jnp.zeros(s, cfg.param_dtype)
+                for k, s in pair.items()}
+            for t, pair in shapes.items()}
+
+
+def write_adapter_slot(planes: Params, slot: int,
+                       adapter: Params) -> Params:
+    """Hot-load one adapter into plane slot `slot` (functional update;
+    the caller swaps the result in).  The adapter pytree is
+    init_lora_params-shaped: {target: {a: [L, ...], b: [L, ...]}}."""
+    out = {t: dict(pair) for t, pair in planes.items()}
+    for t, pair in adapter.items():
+        if t not in out:
+            raise ValueError(f"adapter targets {sorted(adapter)} do not "
+                             f"match the planes' {sorted(planes)}")
+        out[t]["a"] = out[t]["a"].at[:, slot].set(
+            pair["a"].astype(out[t]["a"].dtype))
+        out[t]["b"] = out[t]["b"].at[:, slot].set(
+            pair["b"].astype(out[t]["b"].dtype))
+    return out
+
+
+def clear_adapter_slot(planes: Params, slot: int) -> Params:
+    """Evict: zero a slot back to the null adapter."""
+    out = {t: dict(pair) for t, pair in planes.items()}
+    for t, pair in out.items():
+        pair["a"] = pair["a"].at[:, slot].set(0.0)
+        pair["b"] = pair["b"].at[:, slot].set(0.0)
+    return out
+
+
+def stack_adapters(adapters: Sequence[Params], cfg: TransformerConfig,
+                   lora: LoRAConfig) -> Params:
+    """Stack N adapter pytrees into [L, A, ...] planes (A = len(...))."""
+    if not adapters:
+        raise ValueError("need at least one adapter to stack")
+    return {t: {"a": jnp.stack([ad[t]["a"] for ad in adapters], axis=1),
+                "b": jnp.stack([ad[t]["b"] for ad in adapters], axis=1)}
+            for t in adapters[0]}
+
+
+def gathered_delta(t: str, h: jax.Array, layer_planes: Params,
+                   idx: jax.Array, scale: float) -> jax.Array:
+    """Per-slot low-rank delta for target `t`, ONE fused application.
+
+    `h` is the projection input [B, S, d] (in_embed targets wq/wk/wv)
+    or the attention output [B, S, H, Dh] (wo); `layer_planes[t]` holds
+    ONE layer's stacked pair (a: [A, rows..., r], b: [A, r, cols...] —
+    the [L, A, ...] planes after a scan sliced the layer axis); `idx`
+    [B] int32 gathers each lane's adapter.  Lanes pointing at the null
+    slot 0 contribute exactly 0.  Accumulates in f32 like the base
+    attention math; the caller adds the result to the base projection.
+    """
+    a = layer_planes[t]["a"][idx]           # [B, rows..., r]
+    b = layer_planes[t]["b"][idx]           # [B, r, cols...]
+    if _LAYOUTS[t][0] == "in_embed":
+        t1 = jnp.einsum("bsd,bdr->bsr", h.astype(jnp.float32),
+                        a.astype(jnp.float32))
+        t2 = jnp.einsum("bsr,brhk->bshk", t1, b.astype(jnp.float32))
+    else:
+        t1 = jnp.einsum("bshk,bhkr->bsr", h.astype(jnp.float32),
+                        a.astype(jnp.float32))
+        t2 = jnp.einsum("bsr,brd->bsd", t1, b.astype(jnp.float32))
+    return (t2 * scale).astype(h.dtype)
 
 
 def merge_lora(base_layers: Params, lora_params: Params,

@@ -204,6 +204,31 @@ def ai_workload_dashboard() -> Dict[str, Any]:
                "tik_serve_router_replicas", "short", 0, 150),
         _panel(44, "Autoscaler target replicas",
                "tik_serve_replica_target", "short", 12, 150),
+        # -- Multi-tenant serving row: who is spending whose budget -------
+        {"id": 45, "type": "row", "title": "Multi-tenant serving",
+         "collapsed": False,
+         "gridPos": {"h": 1, "w": 24, "x": 0, "y": 158}, "panels": []},
+        _panel(46, "Tenant TTFT p95",
+               "histogram_quantile(0.95, "
+               "rate(tik_serve_tenant_ttft_seconds_bucket[5m]))",
+               "s", 0, 159),
+        _panel(47, "Tenant request rate by result",
+               "rate(tik_serve_tenant_requests_total[5m])", "ops",
+               12, 159),
+        _panel(48, "Tenant queue depth (a burst queues behind itself)",
+               "tik_serve_tenant_queue_depth", "short", 0, 167),
+        _panel(49, "Tenant TPOT p95",
+               "histogram_quantile(0.95, "
+               "rate(tik_serve_tenant_tpot_seconds_bucket[5m]))",
+               "s", 12, 167),
+        _panel(50, "Resident LoRA adapters",
+               "tik_serve_adapters_resident", "short", 0, 175),
+        _panel(51, "Adapter loads by result",
+               "rate(tik_serve_adapter_loads_total[5m])", "ops",
+               12, 175),
+        _panel(52, "Adapter evictions (LRU pressure)",
+               "rate(tik_serve_adapter_evictions_total[5m])", "ops",
+               0, 183),
     ]
     return {
         "uid": "tik-ai-workloads",

@@ -123,6 +123,11 @@ class Collector:
             window_cycles = env_integer("TIK_COLLECTOR_WINDOW_CYCLES", 60)
         self.windows = WindowStore(cycles=window_cycles)
         self.alerts = AlertEngine(alert_rules, windows=self.windows)
+        if slos is None:
+            # defaults + per-tenant SLOs for TIK_SLO_TENANTS (the
+            # multi-tenant burn-rate gauges, enabled by env)
+            from cloudtik_tpu.telemetry.slo import catalog_from_env
+            slos = catalog_from_env()
         self.slos = SloEngine(slos)
         self._slo_state: List[Dict[str, Any]] = self.slos.state()
         self._stop = threading.Event()

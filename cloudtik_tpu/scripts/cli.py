@@ -1068,17 +1068,19 @@ def slo_group():
 def slo_status(url, exposition_file, catalog, as_json):
     """Per-SLO state, burn rates, and error budget remaining."""
     from cloudtik_tpu.telemetry.slo import (
-        default_slos, evaluate_exposition)
+        catalog_from_env, evaluate_exposition)
     if catalog:
+        # the collector's catalog: defaults + TIK_SLO_TENANTS
+        # per-tenant SLOs, so the operator sees what will evaluate
         rows = [{"name": s.name, "kind": s.kind, "metric": s.metric,
                  "objective": s.objective,
                  "threshold_s": s.threshold_s or None,
                  "burn_threshold": s.burn_threshold,
                  "summary": s.summary}
-                for s in default_slos()]
+                for s in catalog_from_env()]
     elif exposition_file:
         with open(exposition_file) as f:
-            rows = evaluate_exposition(f.read())
+            rows = evaluate_exposition(f.read(), catalog_from_env())
     else:
         import urllib.error
         import urllib.request
@@ -1354,10 +1356,15 @@ def serve_group():
 @click.option("--stats", "as_stats", is_flag=True,
               help="Offline p50/p95/p99 (TTFT/TPOT/queue wait) and "
                    "availability over the selected records.")
+@click.option("--by", "group_by", default=None,
+              type=click.Choice(["tenant", "adapter_id"]),
+              help="With --stats: one stats block per group — the "
+                   "per-tenant SLO view (who is burning whose "
+                   "budget).")
 @click.option("--json", "as_json", is_flag=True,
               help="Emit raw records (or the stats dict) as JSON.")
 def serve_requests(path, tail_n, since_s, finish_filter, as_stats,
-                   as_json):
+                   group_by, as_json):
     """Replay the request ledger (torn final line skipped)."""
     import time as _time
 
@@ -1373,11 +1380,10 @@ def serve_requests(path, tail_n, since_s, finish_filter, as_stats,
     records.sort(key=lambda r: r.get("done_ts") or r.get("ts") or 0)
     if tail_n is not None:
         records = records[-tail_n:]
-    if as_stats:
-        stats = reqlog.compute_stats(records)
-        if as_json:
-            click.echo(json.dumps(stats, indent=1))
-            return
+    if group_by and not as_stats:
+        raise click.UsageError("--by requires --stats")
+
+    def _print_stats(stats):
         availability = stats["availability"]
         avail_s = f"{availability * 100:.2f}%" \
             if availability is not None else "-"
@@ -1414,6 +1420,22 @@ def serve_requests(path, tail_n, since_s, finish_filter, as_stats,
                 f"draft {stats['draft_tokens']}  "
                 f"accepted {stats['accepted_tokens']}  "
                 f"acceptance {rate_s}  tokens/verify {tpv_s}")
+
+    if as_stats:
+        if group_by:
+            grouped = reqlog.group_stats(records, by=group_by)
+            if as_json:
+                click.echo(json.dumps(grouped, indent=1))
+                return
+            for key, stats in grouped.items():
+                click.echo(f"--- {group_by}: {key} ---")
+                _print_stats(stats)
+            return
+        stats = reqlog.compute_stats(records)
+        if as_json:
+            click.echo(json.dumps(stats, indent=1))
+            return
+        _print_stats(stats)
         return
     if as_json:
         click.echo(json.dumps(records, indent=1, default=str))

@@ -67,6 +67,22 @@ Memory model (PagedAttention, Kwon et al., SOSP'23 — serve/kvcache.py):
 * Sampling on device: greedy / per-slot temperature (traced — no
   recompiles per request), engine-level static top_k; sampled
   (temperature > 0) requests always take the plain decode step.
+* MULTI-TENANT LoRA (S-LoRA / Punica lineage — serve/adapters.py +
+  models/lora.py): `DecodeEngine(adapters=AdapterPool(...))` serves N
+  products off one base model.  Requests carry `tenant` + `adapter_id`;
+  resident adapters live in fixed stacked planes and
+  heterogeneous-adapter slots decode in ONE fused base+delta dispatch
+  (per-slot plane-index gather — no per-adapter dispatch), while a
+  batch-homogeneous step falls back to cached merged weights on the
+  plain decode program.  Adapters hot-load through the
+  `serve.lora.load` seam behind an LRU keyed like the prefix cache; a
+  load failure fails the REQUEST, not the engine.  Chain keys are
+  salted with the adapter_id, so identical prompts under different
+  adapters never share KV blocks.  `EngineConfig.admission="wfq"`
+  makes admission weighted-fair across tenants (and preemption take
+  the most over-share tenant's newest slot) so one tenant's burst
+  cannot starve another's TTFT budget; `EngineConfig.max_queue_depth`
+  bounds the admission queue (overflow -> 429 + Retry-After).
 """
 
 from __future__ import annotations
@@ -88,11 +104,14 @@ from cloudtik_tpu import telemetry
 from cloudtik_tpu.faults import seams
 from cloudtik_tpu.faults.plan import FaultInjected
 from cloudtik_tpu.serve import kvcache, migration, reqlog
+from cloudtik_tpu.serve.adapters import (
+    AdapterLoadError, AdapterPool, AdapterSlotsExhausted)
 from cloudtik_tpu.serve.kvcache import BlockPool, BlockPoolExhausted
 from cloudtik_tpu.telemetry import events, goodput
 from cloudtik_tpu.telemetry import instruments as ti
 from cloudtik_tpu.telemetry.core import STATE as _telemetry_state
 from cloudtik_tpu.models import generate as G
+from cloudtik_tpu.models import lora as LO
 from cloudtik_tpu.models.generate import _NEG, _rms_norm
 from cloudtik_tpu.models.transformer import (
     TransformerConfig, _embed_lookup, _lm_head, _rope)
@@ -137,6 +156,20 @@ class EngineConfig:
     chunk_size: Optional[int] = None
     # draft-model speculative decoding; needs DecodeEngine(draft=...)
     spec: Optional[SpecConfig] = None
+    # admission-queue bound: a submit arriving past this many waiting
+    # requests is REFUSED (RequestRejected reason="queue_full" -> HTTP
+    # 429 + Retry-After) instead of growing the queue without bound
+    # under sustained overload.  None = unbounded (the old behavior).
+    max_queue_depth: Optional[int] = None
+    # admission policy: "fifo" (arrival order, PR 8 behavior) or "wfq"
+    # — weighted-fair queueing across tenants: the next admit goes to
+    # the waiting tenant with the lowest slots-held/weight share, and
+    # pool-exhaustion preemption picks the newest slot of the MOST
+    # over-share tenant, so one tenant's burst cannot starve another's
+    # TTFT budget.
+    admission: str = "fifo"
+    # per-tenant weights for "wfq" (unlisted tenants weigh 1.0)
+    tenant_weights: Optional[Dict[str, float]] = None
 
 
 @dataclasses.dataclass
@@ -148,6 +181,7 @@ class _Slot:
     length: int = 0                   # tokens in cache once decoding
     remaining: int = 0                # new tokens still wanted
     decoding: bool = False            # prefill finished
+    adapter_slot: int = 0             # LoRA plane slot (0 = base model)
     # speculative decoding (EngineConfig.spec): the slot's private
     # static draft cache, its prompt-prefill cursor, the host-side
     # mirror of cache["length"], and the per-request degrade latch a
@@ -188,11 +222,18 @@ class Request:
 
     def __init__(self, prompt: List[int], max_new_tokens: int = 32,
                  temperature: float = 0.0,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None,
+                 tenant: str = "default",
+                 adapter_id: Optional[str] = None):
         self.prompt = list(prompt)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.eos_id = eos_id
+        # multi-tenant serving: which product this request belongs to
+        # (reqlog records, per-tenant SLOs, weighted-fair admission)
+        # and which LoRA adapter decodes it (None = the base model)
+        self.tenant = str(tenant)
+        self.adapter_id = adapter_id
         self.tokens: List[int] = []
         self.error: Optional[Exception] = None
         self.request_id = next(_request_ids)
@@ -266,6 +307,8 @@ class Request:
                     self.done_time = time.time()
                     self.done_mono = time.monotonic()
                     ti.SERVE_REQUESTS.inc(result="cancelled")
+                    ti.SERVE_TENANT_REQUESTS.inc(
+                        tenant=self.tenant, result="cancelled")
                     events.emit("tik_serve_cancel",
                                 request=self.request_id)
                     reqlog.record(self, reqlog.FINISH_CANCELLED)
@@ -284,8 +327,8 @@ def fire_verify_seam(request_id: int, width: int) -> None:
 
 def _decode_layer(cfg: TransformerConfig, x: jax.Array, layer: Params,
                   ck: jax.Array, cv: jax.Array, tables: jax.Array,
-                  lengths: jax.Array, active: jax.Array, block_size: int
-                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+                  lengths: jax.Array, active: jax.Array, block_size: int,
+                  lora=None) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One layer, one token per slot, against the paged pool.
 
     x [B,1,d]; ck/cv [N,bs,Hkv,Dh] (this layer's pool plane); tables
@@ -293,7 +336,13 @@ def _decode_layer(cfg: TransformerConfig, x: jax.Array, layer: Params,
     position); active [B] bool.  Each lane scatters its new K/V at
     (table[length // bs], length % bs) and attends over its gathered
     table — inactive lanes target the null block and their output is
-    discarded by the caller."""
+    discarded by the caller.
+
+    `lora` is the gathered batched-adapter triple ``(layer_planes,
+    idx, scale)`` (models/lora.py): each lane gathers ITS adapter's
+    low-rank pair out of the stacked planes and applies the delta next
+    to the base projection, pre-RoPE — heterogeneous-adapter lanes
+    share this one program, no per-adapter dispatch."""
     B = x.shape[0]
     M = tables.shape[1]
     bs = block_size
@@ -302,6 +351,14 @@ def _decode_layer(cfg: TransformerConfig, x: jax.Array, layer: Params,
     q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cfg.dtype))
     k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(cfg.dtype))
     v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(cfg.dtype))
+    if lora is not None:
+        planes, idx, scale = lora
+        if "wq" in planes:
+            q = q + LO.gathered_delta("wq", h, planes, idx, scale)
+        if "wk" in planes:
+            k = k + LO.gathered_delta("wk", h, planes, idx, scale)
+        if "wv" in planes:
+            v = v + LO.gathered_delta("wv", h, planes, idx, scale)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     # per-slot scatter at each slot's own (block, offset); inactive
@@ -331,6 +388,10 @@ def _decode_layer(cfg: TransformerConfig, x: jax.Array, layer: Params,
                    cv_h.astype(jnp.float32)).astype(x.dtype)
     attn_out = jnp.einsum("bshk,hkd->bsd", o,
                           layer["wo"].astype(cfg.dtype))
+    if lora is not None and "wo" in lora[0]:
+        planes, idx, scale = lora
+        attn_out = attn_out + LO.gathered_delta("wo", o, planes, idx,
+                                                scale)
     x = x + attn_out
     h = _rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
     if cfg.is_moe:
@@ -351,7 +412,8 @@ def _decode_layer(cfg: TransformerConfig, x: jax.Array, layer: Params,
 def decode_step(params: Params, tokens: jax.Array, kp: jax.Array,
                 vp: jax.Array, tables: jax.Array, lengths: jax.Array,
                 active: jax.Array, temps: jax.Array, rng: jax.Array,
-                cfg: TransformerConfig, block_size: int, top_k: int
+                cfg: TransformerConfig, block_size: int, top_k: int,
+                lora=None
                 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """One token for every active slot, paged.
 
@@ -359,17 +421,37 @@ def decode_step(params: Params, tokens: jax.Array, kp: jax.Array,
     pools, tables [B,M], lengths/active/temps [B].  Returns
     (next_tokens, kp, vp, new_lengths); inactive slots keep their
     state.
+
+    `lora` = ``{"planes": {target: {a: [L, A, ...], b: [L, A, ...]}},
+    "idx": [B] int32, "scale": float}`` enables the gathered
+    batched-adapter path: the planes' layer axis rides the scan next
+    to params["layers"], so a batch mixing N adapters (and base-model
+    lanes on the null slot 0) is still ONE fused dispatch.
     """
     x = _embed_lookup(params["embed"], tokens[:, None], cfg)
 
-    def body(carry, xs):
-        x = carry
-        layer, ck, cv = xs
-        x, ck, cv = _decode_layer(cfg, x, layer, ck, cv, tables,
-                                  lengths, active, block_size)
-        return x, (ck, cv)
+    if lora is None:
+        def body(carry, xs):
+            x = carry
+            layer, ck, cv = xs
+            x, ck, cv = _decode_layer(cfg, x, layer, ck, cv, tables,
+                                      lengths, active, block_size)
+            return x, (ck, cv)
 
-    x, (kp, vp) = jax.lax.scan(body, x, (params["layers"], kp, vp))
+        x, (kp, vp) = jax.lax.scan(body, x, (params["layers"], kp, vp))
+    else:
+        idx, scale = lora["idx"], lora["scale"]
+
+        def body(carry, xs):
+            x = carry
+            layer, ck, cv, planes = xs
+            x, ck, cv = _decode_layer(cfg, x, layer, ck, cv, tables,
+                                      lengths, active, block_size,
+                                      lora=(planes, idx, scale))
+            return x, (ck, cv)
+
+        x, (kp, vp) = jax.lax.scan(
+            body, x, (params["layers"], kp, vp, lora["planes"]))
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum(
         "bsd,dv->bsv", x, _lm_head(params, cfg).astype(cfg.dtype),
@@ -401,10 +483,15 @@ class DecodeEngine:
                  draft: Optional[Tuple[Params, TransformerConfig]]
                  = None,
                  migrator: Optional[migration.BlockMigrator] = None,
-                 role: Optional[str] = None):
+                 role: Optional[str] = None,
+                 adapters: Optional[AdapterPool] = None):
         self.params = params
         self.cfg = cfg
         self.ec = engine_config or EngineConfig()
+        if self.ec.admission not in ("fifo", "wfq"):
+            raise ValueError(
+                f"unknown admission policy {self.ec.admission!r}; "
+                "expected 'fifo' or 'wfq'")
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         B, T = self.ec.slots, self.ec.max_len
         bs = self.ec.block_size
@@ -442,6 +529,7 @@ class DecodeEngine:
         # (FIFO), preemption re-queues at the FRONT so the victim
         # re-admits as soon as blocks free up
         self._waiting: "collections.deque[Request]" = collections.deque()
+        self._tenants_gauged: set = set()
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -463,6 +551,57 @@ class DecodeEngine:
 
         self._prefill_chunk = jax.jit(_prefill_chunk)
         self._copy_block = jax.jit(G.copy_block)
+
+        # -- multi-tenant LoRA adapters (serve/adapters.py) ------------
+        # heterogeneous-adapter lanes decode in ONE jitted step: the
+        # stacked planes ([L, A+1, ...] per target — fixed shapes, so
+        # hot-loading never recompiles) plus per-slot plane indices
+        # ride the decode/prefill programs as arguments; a
+        # batch-HOMOGENEOUS step (every active lane on the same
+        # adapter) falls back to the pool's cached merged weights with
+        # the PLAIN decode program — same program, different params,
+        # zero gather overhead.
+        self._adapters = adapters
+        self._adapter_idx = np.zeros((B,), np.int32)
+        # loop-thread-only counters: which decode path each step took
+        # (tests assert the homogeneous fallback actually engages)
+        self._merged_steps = 0
+        self._gathered_steps = 0
+        if adapters is not None:
+            if self.ec.spec is not None:
+                raise ValueError(
+                    "EngineConfig.spec with an adapter pool is not "
+                    "supported — the draft model knows nothing about "
+                    "per-request adapters, so its proposals would "
+                    "verify at ~0 acceptance; run spec on a "
+                    "single-tenant engine")
+            if migrator is not None:
+                raise ValueError(
+                    "a prefill-role engine (migrator=...) with an "
+                    "adapter pool is not supported yet — migration "
+                    "headers do not carry adapter identity, so the "
+                    "decode role could not reproduce the delta")
+            scale = adapters.lora_cfg.scale
+
+            self._decode_lora = jax.jit(
+                lambda p, planes, idx, tok, kp, vp, tbl, ln, act, tmp,
+                rng: decode_step(
+                    p, tok, kp, vp, tbl, ln, act, tmp, rng, cfg=cfg,
+                    block_size=bs, top_k=self.ec.top_k,
+                    lora={"planes": planes, "idx": idx,
+                          "scale": scale}))
+
+            def _prefill_chunk_lora(p, planes, idx, kp, vp, table,
+                                    tokens, start, last_idx):
+                kp, vp, logits = G.paged_prefill_chunk(
+                    p, kp, vp, table, tokens, start, cfg,
+                    lora={"planes": planes, "idx": idx,
+                          "scale": scale})
+                last = jax.lax.dynamic_index_in_dim(
+                    logits[0], last_idx, 0, keepdims=False)
+                return kp, vp, last.argmax(-1).astype(jnp.int32)
+
+            self._prefill_chunk_lora = jax.jit(_prefill_chunk_lora)
 
         # -- KV-block migration (serve/migration.py) -------------------
         # prefill role: `migrator` set — a finished prefill exports its
@@ -545,6 +684,11 @@ class DecodeEngine:
         if not request.prompt:
             return RequestRejected("empty prompt",
                                    reason="empty_prompt")
+        if request.adapter_id is not None and self._adapters is None:
+            return RequestRejected(
+                f"request names adapter {request.adapter_id!r} but "
+                "this engine serves the base model only (no adapter "
+                "pool configured)", reason="adapter")
         if prompt_only is None:
             prompt_only = self._migrator is not None
         bs = self.ec.block_size
@@ -569,6 +713,18 @@ class DecodeEngine:
 
     def submit(self, request: Request) -> Request:
         rejected = self._submit_check(request)
+        if rejected is None and self.ec.max_queue_depth is not None:
+            # bounded admission: sustained overload must surface as a
+            # clean 429 + Retry-After (the router respills it like a
+            # drain refusal), not as an unbounded loop-owned deque.
+            # The depth read races admissions harmlessly — the cap is
+            # a back-pressure threshold, not an exact budget.
+            depth = self._queue.qsize() + len(self._waiting)
+            if depth >= self.ec.max_queue_depth:
+                rejected = RequestRejected(
+                    f"admission queue is full ({depth} waiting, cap "
+                    f"{self.ec.max_queue_depth}); retry shortly",
+                    reason="queue_full")
         if rejected is not None:
             self._finish_request(request, "rejected", rejected)
             return request
@@ -656,8 +812,10 @@ class DecodeEngine:
         first = req.first_token_time
         if first is not None:
             if len(req.tokens) > 1:
-                ti.SERVE_TPOT.observe(
-                    (req.done_time - first) / (len(req.tokens) - 1))
+                tpot = (req.done_time - first) / (len(req.tokens) - 1)
+                ti.SERVE_TPOT.observe(tpot)
+                ti.SERVE_TENANT_TPOT.observe(
+                    tpot, tenant=getattr(req, "tenant", "default"))
             with telemetry.trace_context(req.traceparent):
                 telemetry.add_span(
                     "serve.decode", first, req.done_time - first,
@@ -670,6 +828,8 @@ class DecodeEngine:
             with telemetry.trace_context(req.traceparent):
                 events.emit("tik_serve_cancel", request=req.request_id)
         ti.SERVE_REQUESTS.inc(result=result)
+        ti.SERVE_TENANT_REQUESTS.inc(
+            tenant=getattr(req, "tenant", "default"), result=result)
         if finish is None:
             # "rejected" stays distinct from "error": submit-time
             # refusals are client-caused and spend no availability
@@ -713,6 +873,8 @@ class DecodeEngine:
                                  finish=reqlog.FINISH_DRAINED)
         ti.SERVE_QUEUE_DEPTH.set(0, role=getattr(self, "_role",
                                                  "engine"))
+        if getattr(self, "_tenants_gauged", None):
+            self._emit_tenant_queue_depth()
 
     def _teardown(self, reason: str = "engine stopped") -> None:
         """Fail everything still queued or mid-decode — callers must not
@@ -751,6 +913,11 @@ class DecodeEngine:
         self._slots[slot_id] = None
         self.pool.release(list(reversed(slot.table)))
         slot.table = []
+        if self._adapters is not None:
+            # drop this request's pin; a refcount-0 adapter parks on
+            # the pool's idle LRU (planes stay warm, reclaimable)
+            self._adapters.release(slot.request.adapter_id)
+            self._adapter_idx[slot_id] = 0
         self._sync_table(slot_id)
 
     def _stamp_first_token(self, slot_id: int, slot: _Slot,
@@ -764,7 +931,10 @@ class DecodeEngine:
         req.tokens.append(first_tok)
         req.first_token_time = time.time()
         req.first_token_mono = time.monotonic()
-        ti.SERVE_TTFT.observe(req.first_token_time - req.created)
+        ttft = req.first_token_time - req.created
+        ti.SERVE_TTFT.observe(ttft)
+        ti.SERVE_TENANT_TTFT.observe(
+            ttft, tenant=getattr(req, "tenant", "default"))
         ti.SERVE_TOKENS.inc()
         slot.length = slot.true_len
         self._tokens = self._tokens.at[slot_id].set(first_tok)
@@ -782,6 +952,28 @@ class DecodeEngine:
                 newest, newest_mono = slot_id, mono
         return newest
 
+    def _tenant_weight(self, tenant: str) -> float:
+        weights = self.ec.tenant_weights or {}
+        return max(float(weights.get(tenant, 1.0)), 1e-9)
+
+    def _preempt_victim(self) -> Optional[int]:
+        """Pool-exhaustion victim.  FIFO: the newest slot overall.
+        WFQ: the newest slot of the MOST over-share tenant
+        (slots-held / weight) — the burster pays for its own burst,
+        a well-behaved tenant's in-flight work survives."""
+        if self.ec.admission != "wfq":
+            return self._newest_slot()
+        held: Dict[str, List[int]] = {}
+        for slot_id, slot in enumerate(self._slots):
+            if slot is not None:
+                held.setdefault(slot.request.tenant, []).append(slot_id)
+        if not held:
+            return None
+        tenant = max(held, key=lambda t: (
+            len(held[t]) / self._tenant_weight(t)))
+        return max(held[tenant], key=lambda i: (
+            self._slots[i].request.admitted_mono or 0.0))
+
     def _preempt(self, slot_id: int) -> None:
         """Pool exhausted: evict this slot's request and requeue it at
         the admission front.  The victim's computed prompt blocks are
@@ -798,7 +990,8 @@ class DecodeEngine:
         salvaged = 0
         if self.ec.prefix_cache and at_stake >= self.ec.block_size:
             salvaged = self.pool.register_prefix(
-                req.prompt[:at_stake], slot.table)
+                req.prompt[:at_stake], slot.table,
+                namespace=req.adapter_id)
         self._release_slot(slot_id)
         req.tokens.clear()
         req.admitted = None
@@ -829,7 +1022,7 @@ class DecodeEngine:
             try:
                 return self.pool.alloc(n)
             except (BlockPoolExhausted, FaultInjected):
-                victim = self._newest_slot()
+                victim = self._preempt_victim()
                 if victim is None:
                     raise     # no slot held — submit() sizing bug
                 self._preempt(victim)
@@ -987,7 +1180,8 @@ class DecodeEngine:
             reuse_blocks: List[int] = []
             if self.ec.prefix_cache:
                 reuse_blocks, _ = self.pool.match_prefix(
-                    req.prompt, count=False)
+                    req.prompt, count=False,
+                    namespace=req.adapter_id)
             start = len(reuse_blocks)
             try:
                 fresh = self.pool.alloc(n_blocks - start)
@@ -1028,7 +1222,8 @@ class DecodeEngine:
                         if self.ec.prefix_cache:
                             self.pool.register_prefix(
                                 req.prompt, slot.table,
-                                start_block=start)
+                                start_block=start,
+                                namespace=req.adapter_id)
                     ti.SERVE_KV_MIGRATIONS.inc(direction="in")
                     ti.SERVE_KV_MIGRATED_TOKENS.inc(true_len,
                                                     direction="in")
@@ -1070,10 +1265,56 @@ class DecodeEngine:
                 return b
         raise ValueError(f"chunk length {n} exceeds largest bucket")
 
+    def _pick_waiting(self) -> int:
+        """Index into the waiting deque of the next request to admit.
+
+        FIFO: always the head.  WFQ: the head-of-line request (per-
+        tenant arrival order is preserved) of the tenant with the
+        LOWEST slots-held/weight share — a bursting tenant queues
+        behind its own backlog while other tenants keep admitting;
+        equal shares tie-break to arrival order."""
+        if self.ec.admission != "wfq" or len(self._waiting) <= 1:
+            return 0
+        held: Dict[str, int] = {}
+        for slot in self._slots:
+            if slot is not None:
+                tenant = slot.request.tenant
+                held[tenant] = held.get(tenant, 0) + 1
+        best_i = 0
+        best_share: Optional[float] = None
+        seen: set = set()
+        for i, req in enumerate(self._waiting):
+            tenant = req.tenant
+            if tenant in seen:
+                continue       # only each tenant's head-of-line counts
+            seen.add(tenant)
+            share = held.get(tenant, 0) / self._tenant_weight(tenant)
+            if best_share is None or share < best_share:
+                best_i, best_share = i, share
+        return best_i
+
+    def _emit_tenant_queue_depth(self) -> None:
+        """Per-tenant waiting counts (the loop-owned deque; gauges for
+        tenants that emptied out reset to 0 so a burst's tail is
+        visible ending, not frozen at its peak)."""
+        if not _telemetry_state.enabled:
+            return
+        counts: Dict[str, int] = {}
+        for req in self._waiting:
+            counts[req.tenant] = counts.get(req.tenant, 0) + 1
+        for tenant in self._tenants_gauged - set(counts):
+            ti.SERVE_TENANT_QUEUE_DEPTH.set(0, tenant=tenant,
+                                            role=self._role)
+        for tenant, n in counts.items():
+            ti.SERVE_TENANT_QUEUE_DEPTH.set(n, tenant=tenant,
+                                            role=self._role)
+        self._tenants_gauged = set(counts)
+
     def _admit(self) -> None:
         """Move submissions into slots.  Pool exhaustion stops
-        admission (requests stay queued, FIFO) — it must never crash
-        the loop or drop a request."""
+        admission (requests stay queued) — it must never crash
+        the loop or drop a request.  `_pick_waiting` is the admission
+        policy: FIFO arrival order, or weighted-fair across tenants."""
         while True:
             try:
                 self._waiting.append(self._queue.get_nowait())
@@ -1084,12 +1325,13 @@ class DecodeEngine:
                             if s is None), None)
             if slot_id is None:
                 break
-            req = self._waiting[0]
+            i = self._pick_waiting()
+            req = self._waiting[i]
             if req._done.is_set():
-                self._waiting.popleft()
+                del self._waiting[i]
                 continue
             if req._cancel:   # cancelled while queued: no slot taken
-                self._waiting.popleft()
+                del self._waiting[i]
                 self._finish_request(
                     req, "cancelled",
                     RequestCancelled("request cancelled"))
@@ -1103,11 +1345,27 @@ class DecodeEngine:
                 # case fits — optimistic re-admission would thrash
                 # (prefill, grow, get preempted again, repeat)
                 break
+            adapter_slot = 0
+            if self._adapters is not None:
+                try:
+                    adapter_slot = self._adapters.acquire(
+                        req.adapter_id)
+                except AdapterSlotsExhausted:
+                    break     # every plane slot pinned: wait, like
+                    #           KV-block exhaustion
+                except AdapterLoadError as e:
+                    # the load failure fails the REQUEST, never the
+                    # engine: record it and admit the next one
+                    del self._waiting[i]
+                    self._finish_request(req, "error", e)
+                    continue
             reuse_blocks: List[int] = []
             reuse_len = 0
             if self.ec.prefix_cache:
-                reuse_blocks, reuse_len = \
-                    self.pool.match_prefix(req.prompt)
+                # chain keys are salted with the adapter_id: identical
+                # prompts under different adapters NEVER share KV
+                reuse_blocks, reuse_len = self.pool.match_prefix(
+                    req.prompt, namespace=req.adapter_id)
             need = kvcache.blocks_for(true_len, self.ec.block_size) \
                 - len(reuse_blocks)
             try:
@@ -1115,8 +1373,10 @@ class DecodeEngine:
             except (BlockPoolExhausted, FaultInjected):
                 if reuse_blocks:
                     self.pool.release(reuse_blocks)
+                if self._adapters is not None:
+                    self._adapters.release(req.adapter_id)
                 break         # exhaustion queues new admissions
-            self._waiting.popleft()
+            del self._waiting[i]
             try:
                 req.admitted = time.time()
                 req.admitted_mono = time.monotonic()
@@ -1129,7 +1389,8 @@ class DecodeEngine:
                              table=reuse_blocks + fresh,
                              true_len=true_len,
                              prefill_pos=reuse_len,
-                             remaining=req.max_new_tokens - 1)
+                             remaining=req.max_new_tokens - 1,
+                             adapter_slot=adapter_slot)
                 if self._spec is not None \
                         and req.temperature <= 0.0:
                     # private draft cache; the draft prefills the WHOLE
@@ -1141,6 +1402,7 @@ class DecodeEngine:
                         self._draft_cfg, 1, self._draft_plane)
                 req.kv_blocks = max(req.kv_blocks, len(slot.table))
                 self._slots[slot_id] = slot
+                self._adapter_idx[slot_id] = adapter_slot
                 self._sync_table(slot_id)
                 # re-enter the request's trace: this is the loop
                 # thread, so the submit-side context does not carry over
@@ -1154,10 +1416,13 @@ class DecodeEngine:
                     self._release_slot(slot_id)
                 else:     # failed before the slot took ownership
                     self.pool.release(reuse_blocks + fresh)
+                    if self._adapters is not None:
+                        self._adapters.release(req.adapter_id)
                 self._finish_request(req, "error", e)
         ti.SERVE_QUEUE_DEPTH.set(self._queue.qsize()
                                  + len(self._waiting),
                                  role=self._role)
+        self._emit_tenant_queue_depth()
 
     def _prefill_tick(self) -> None:
         """Run ONE prompt chunk for the oldest prefilling slot.  One
@@ -1196,12 +1461,33 @@ class DecodeEngine:
                         padded = np.zeros((1, bucket), np.int32)
                         padded[0, :chunk] = req.prompt[
                             slot.prefill_pos:slot.prefill_pos + chunk]
-                        self._kp, self._vp, tok = self._prefill_chunk(
-                            self.params, self._kp, self._vp,
-                            jnp.asarray(self._tables_np[slot_id]),
-                            jnp.asarray(padded),
-                            jnp.asarray(slot.prefill_pos, jnp.int32),
-                            jnp.asarray(chunk - 1, jnp.int32))
+                        if self._adapters is not None:
+                            # the gathered-adapter prefill program:
+                            # same chunk path, the slot's adapter
+                            # delta applied next to the base forward
+                            self._kp, self._vp, tok = \
+                                self._prefill_chunk_lora(
+                                    self.params,
+                                    self._adapters.planes,
+                                    jnp.asarray([slot.adapter_slot],
+                                                jnp.int32),
+                                    self._kp, self._vp,
+                                    jnp.asarray(
+                                        self._tables_np[slot_id]),
+                                    jnp.asarray(padded),
+                                    jnp.asarray(slot.prefill_pos,
+                                                jnp.int32),
+                                    jnp.asarray(chunk - 1, jnp.int32))
+                        else:
+                            self._kp, self._vp, tok = \
+                                self._prefill_chunk(
+                                    self.params, self._kp, self._vp,
+                                    jnp.asarray(
+                                        self._tables_np[slot_id]),
+                                    jnp.asarray(padded),
+                                    jnp.asarray(slot.prefill_pos,
+                                                jnp.int32),
+                                    jnp.asarray(chunk - 1, jnp.int32))
                 slot.prefill_pos += chunk
                 req.prefill_chunks += 1
                 ti.SERVE_PREFILL_CHUNKS.inc()
@@ -1212,7 +1498,8 @@ class DecodeEngine:
                     if self.ec.prefix_cache:
                         self.pool.register_prefix(
                             req.prompt, slot.table,
-                            start_block=req.prefix_blocks)
+                            start_block=req.prefix_blocks,
+                            namespace=req.adapter_id)
                     done_now = (req.eos_id is not None
                                 and first_tok == req.eos_id) \
                         or slot.remaining <= 0
@@ -1490,10 +1777,43 @@ class DecodeEngine:
                  if s is not None and decoding[i] else 0.0
                  for i, s in enumerate(self._slots)], np.float32)
             self._rng, step_rng = jax.random.split(self._rng)
-            nxt, self._kp, self._vp, self._lengths = self._decode(
-                self.params, self._tokens, self._kp, self._vp,
-                jnp.asarray(self._tables_np), self._lengths,
-                jnp.asarray(active_mask), jnp.asarray(temps), step_rng)
+            if self._adapters is None:
+                nxt, self._kp, self._vp, self._lengths = self._decode(
+                    self.params, self._tokens, self._kp, self._vp,
+                    jnp.asarray(self._tables_np), self._lengths,
+                    jnp.asarray(active_mask), jnp.asarray(temps),
+                    step_rng)
+            else:
+                active_ids = {self._slots[i].request.adapter_id
+                              for i, on in enumerate(decoding) if on}
+                if len(active_ids) == 1:
+                    # batch-HOMOGENEOUS step: every active lane wears
+                    # the same adapter — the pool's cached merged
+                    # weights ride the PLAIN decode program (params
+                    # are an argument, so no recompile and no gather
+                    # arithmetic)
+                    self._merged_steps += 1
+                    nxt, self._kp, self._vp, self._lengths = \
+                        self._decode(
+                            self._adapters.merged(next(
+                                iter(active_ids))),
+                            self._tokens, self._kp, self._vp,
+                            jnp.asarray(self._tables_np),
+                            self._lengths, jnp.asarray(active_mask),
+                            jnp.asarray(temps), step_rng)
+                else:
+                    # heterogeneous adapters decode in ONE fused
+                    # base+delta dispatch — per-slot plane indices
+                    # gather each lane's low-rank pair
+                    self._gathered_steps += 1
+                    nxt, self._kp, self._vp, self._lengths = \
+                        self._decode_lora(
+                            self.params, self._adapters.planes,
+                            jnp.asarray(self._adapter_idx),
+                            self._tokens, self._kp, self._vp,
+                            jnp.asarray(self._tables_np),
+                            self._lengths, jnp.asarray(active_mask),
+                            jnp.asarray(temps), step_rng)
             self._tokens = nxt
             host_tokens = np.asarray(nxt)
         ti.SERVE_TOKENS.inc(n_active)

@@ -71,16 +71,23 @@ def blocks_for(tokens: int, block_size: int) -> int:
     return max(0, -(-tokens // block_size))
 
 
-def chain_keys(prompt: Sequence[int], block_size: int
-               ) -> List[PrefixKey]:
+def chain_keys(prompt: Sequence[int], block_size: int,
+               namespace=None) -> List[PrefixKey]:
     """Chain keys for every FULL block of `prompt`, in order.
 
     ONE implementation shared by the pool's prefix map
     (`BlockPool.prefix_keys`) and the router's affinity hashing
     (serve/router.py) — the two must agree on key structure or
-    affinity routing silently degrades to random placement."""
+    affinity routing silently degrades to random placement.
+
+    `namespace` salts the ROOT of the chain (multi-tenant serving
+    passes the request's adapter_id): a prompt's KV depends on the
+    adapter that computed it, so identical prompts under different
+    adapters must never share blocks — a different root makes every
+    downstream key differ, structurally, not probabilistically."""
     keys: List[PrefixKey] = []
-    parent: PrefixKey = ("root",)
+    parent: PrefixKey = ("root",) if namespace is None \
+        else ("root", namespace)
     for start in range(0, len(prompt) - block_size + 1, block_size):
         key = (parent, tuple(prompt[start:start + block_size]))
         keys.append(key)
@@ -211,12 +218,15 @@ class BlockPool:
         return self.ref(block) > 1
 
     # -- prefix map -------------------------------------------------------
-    def prefix_keys(self, prompt: Sequence[int]) -> List[PrefixKey]:
-        """Chain keys for every FULL block of `prompt`, in order."""
-        return chain_keys(prompt, self.block_size)
+    def prefix_keys(self, prompt: Sequence[int],
+                    namespace=None) -> List[PrefixKey]:
+        """Chain keys for every FULL block of `prompt`, in order.
+        `namespace` (an adapter_id) salts the chain root so different
+        adapters' identical prompts never share blocks."""
+        return chain_keys(prompt, self.block_size, namespace=namespace)
 
-    def match_prefix(self, prompt: Sequence[int], count: bool = True
-                     ) -> Tuple[List[int], int]:
+    def match_prefix(self, prompt: Sequence[int], count: bool = True,
+                     namespace=None) -> Tuple[List[int], int]:
         """Longest cached full-block prefix of `prompt`.
 
         Returns ``(blocks, reuse_tokens)`` with every returned block
@@ -231,7 +241,7 @@ class BlockPool:
         """
         bs = self.block_size
         matched: List[int] = []
-        for key in self.prefix_keys(prompt):
+        for key in self.prefix_keys(prompt, namespace=namespace):
             if len(matched) * bs + bs >= len(prompt):
                 break                      # keep >= 1 token to prefill
             block = self._key_to_block.get(key)
@@ -255,13 +265,15 @@ class BlockPool:
 
     def register_prefix(self, prompt: Sequence[int],
                         table: Sequence[int],
-                        start_block: int = 0) -> int:
+                        start_block: int = 0,
+                        namespace=None) -> int:
         """Publish `prompt`'s full blocks from `table` into the prefix
         map (from `start_block` on — earlier ones came FROM the map).
         First writer wins: a key already cached keeps its block.
         Returns how many blocks were newly registered."""
         registered = 0
-        for j, key in enumerate(self.prefix_keys(prompt)):
+        for j, key in enumerate(self.prefix_keys(prompt,
+                                                 namespace=namespace)):
             if j < start_block:
                 continue
             if key in self._key_to_block:

@@ -54,7 +54,8 @@ RECORD_NAME = "request"
 # ts/seq/name/traceparent).  Keep docs/observability.md's "Record
 # fields" table in sync — tools/check_telemetry_names.py enforces it.
 RECORD_FIELDS = (
-    "request_id", "finish", "bucket", "prompt_tokens", "output_tokens",
+    "request_id", "finish", "tenant", "adapter_id", "bucket",
+    "prompt_tokens", "output_tokens",
     "kv_blocks", "prefix_blocks", "prefix_tokens", "prefill_chunks",
     "preemptions",
     "migrations", "migrated_tokens",
@@ -130,6 +131,11 @@ def record(req, finish: str) -> None:
     fields: Dict[str, Any] = {
         "request_id": req.request_id,
         "finish": finish,
+        # multi-tenant serving: which product the request belongs to
+        # and which LoRA adapter decoded it (None = base model) —
+        # `tik serve requests --stats --by tenant` groups on these
+        "tenant": getattr(req, "tenant", "default"),
+        "adapter_id": getattr(req, "adapter_id", None),
         "bucket": getattr(req, "bucket", None),
         "prompt_tokens": len(req.prompt),
         "output_tokens": len(req.tokens),
@@ -267,3 +273,20 @@ def compute_stats(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     stats["spec_tokens_per_verify"] = \
         (stats["accepted_tokens"] + steps) / steps if steps else None
     return stats
+
+
+def group_stats(records: List[Dict[str, Any]], by: str = "tenant"
+                ) -> Dict[str, Dict[str, Any]]:
+    """Per-group offline stats (`tik serve requests --stats --by
+    tenant`): records grouped on field `by`, compute_stats each.
+    Records predating the field land under "default" for tenant
+    grouping (every request has a tenant, "default" included) and
+    under "-" otherwise."""
+    groups: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in records:
+        key = rec.get(by)
+        if key is None:
+            key = "default" if by == "tenant" else "-"
+        groups.setdefault(str(key), []).append(rec)
+    return {key: compute_stats(recs)
+            for key, recs in sorted(groups.items())}

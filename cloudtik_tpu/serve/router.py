@@ -221,7 +221,9 @@ class EngineReplica(ReplicaClient):
                       max_new_tokens=int(
                           payload.get("max_new_tokens", 16)),
                       temperature=float(payload.get("temperature", 0.0)),
-                      eos_id=payload.get("eos_id"))
+                      eos_id=payload.get("eos_id"),
+                      tenant=str(payload.get("tenant", "default")),
+                      adapter_id=payload.get("adapter"))
         with self._lock:
             if self._dead:
                 raise ReplicaUnavailable(
@@ -235,6 +237,15 @@ class EngineReplica(ReplicaClient):
             try:
                 tokens = req.wait(timeout=timeout_s)
             except RequestRejected as e:
+                if e.reason == "queue_full":
+                    # bounded admission queue overflow (HTTP 429 +
+                    # Retry-After on the wire): back-pressure, not a
+                    # client error — respill to the next ring replica
+                    # exactly like a drain refusal, spending no
+                    # availability budget
+                    raise ReplicaDraining(
+                        f"replica {self.replica_id} admission queue "
+                        f"full: {e}") from e
                 raise ReplicaRejected(
                     str(e), status=413 if e.reason == "capacity"
                     else 400) from e
@@ -300,11 +311,15 @@ class HttpReplica(ReplicaClient):
                                headers) as resp:
                 return json.loads(resp.read().decode())
         except urllib.error.HTTPError as e:
-            if e.code == 503:
+            if e.code in (503, 429):
+                # 503 = draining, 429 = admission queue full: both are
+                # clean back-pressure refusals (Retry-After on the
+                # wire, work never started) — respill to the next ring
+                # replica, spend no availability budget
                 raise ReplicaDraining(
-                    f"replica {self.replica_id} is draining "
-                    f"(Retry-After: {e.headers.get('Retry-After')})"
-                ) from e
+                    f"replica {self.replica_id} refused new work "
+                    f"({e.code}; Retry-After: "
+                    f"{e.headers.get('Retry-After')})") from e
             body = e.read().decode(errors="replace")
             if 400 <= e.code < 500:
                 # the replica refused the REQUEST (oversized prompt,
@@ -614,7 +629,9 @@ class Router:
                    "max_new_tokens": request.max_new_tokens,
                    "temperature": request.temperature,
                    "eos_id": request.eos_id,
-                   "request_id": request.request_id}
+                   "request_id": request.request_id,
+                   "tenant": getattr(request, "tenant", "default"),
+                   "adapter": getattr(request, "adapter_id", None)}
 
         def run() -> None:
             with telemetry.trace_context(traceparent):

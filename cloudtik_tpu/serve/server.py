@@ -120,6 +120,13 @@ def engine_backend(model: str = "tiny",
                    disagg: bool = False,
                    prefill_slots: int = 2,
                    prefill_blocks: Optional[int] = None,
+                   adapters_dir: Optional[str] = None,
+                   adapter_slots: int = 8,
+                   lora_rank: int = 16,
+                   lora_alpha: float = 32.0,
+                   admission: str = "fifo",
+                   tenant_weights: Optional[Dict[str, float]] = None,
+                   max_queue_depth: Optional[int] = None,
                    **config_overrides) -> ModelBackend:
     """Continuous-batching generation endpoint (serve/engine.py).
 
@@ -135,7 +142,16 @@ def engine_backend(model: str = "tiny",
     (`prefill_slots`/`prefill_blocks`) streaming finished KV blocks to
     a decode-role engine (`slots`/`num_blocks`) over the in-process
     migration transport (serve/disagg.py) — prompt-heavy and
-    decode-heavy load stop competing for the same loop."""
+    decode-heavy load stop competing for the same loop.
+
+    `adapters_dir` turns on multi-tenant LoRA serving: requests naming
+    ``"adapter": "<id>"`` hot-load ``<adapters_dir>/<id>`` into the
+    engine's adapter pool (LRU over `adapter_slots` plane slots) and
+    decode through the gathered batched-adapter path — heterogeneous
+    adapters share one fused forward.  ``"tenant"`` tags the request
+    for per-tenant SLOs and (with ``admission="wfq"`` +
+    `tenant_weights`) weighted-fair admission.  `max_queue_depth`
+    bounds the admission queue: overflow is a 429 + Retry-After."""
     import jax
 
     from cloudtik_tpu.serve.disagg import DisaggServing
@@ -166,24 +182,48 @@ def engine_backend(model: str = "tiny",
             draft_params = _restore(draft_params, spec_checkpoint_dir)
         draft = (draft_params, draft_cfg)
         spec = SpecConfig(k=spec_k)
+    adapter_pool = None
+    if adapters_dir:
+        from cloudtik_tpu.models.lora import LoRAConfig
+        from cloudtik_tpu.serve.adapters import (
+            AdapterPool, checkpoint_loader)
+        if disagg:
+            raise ValueError("--disagg and --adapters-dir are "
+                             "mutually exclusive for now (migration "
+                             "headers carry no adapter identity)")
+        lora_cfg = LoRAConfig(rank=lora_rank, alpha=lora_alpha)
+        adapter_pool = AdapterPool(
+            params, cfg, lora_cfg,
+            loader=checkpoint_loader(adapters_dir, cfg, lora_cfg),
+            capacity=adapter_slots)
     if disagg:
         if spec is not None:
             raise ValueError("--disagg and --spec-model are mutually "
                              "exclusive (imported requests decode "
                              "plain; run spec on a monolithic engine)")
+        # admission happens on the PREFILL role (DisaggServing.submit
+        # forwards there), so the queue bound and fairness policy wire
+        # into its config — silently dropping them would leave an
+        # operator believing overload is bounded when it is not
         engine = DisaggServing(
             params, cfg,
             EngineConfig(slots=prefill_slots, max_len=max_len,
                          block_size=block_size,
-                         num_blocks=prefill_blocks),
+                         num_blocks=prefill_blocks,
+                         admission=admission,
+                         tenant_weights=tenant_weights,
+                         max_queue_depth=max_queue_depth),
             EngineConfig(slots=slots, max_len=max_len,
                          block_size=block_size, num_blocks=num_blocks))
     else:
         engine = DecodeEngine(
-            params, cfg, EngineConfig(slots=slots, max_len=max_len,
-                                      block_size=block_size,
-                                      num_blocks=num_blocks, spec=spec),
-            draft=draft)
+            params, cfg, EngineConfig(
+                slots=slots, max_len=max_len,
+                block_size=block_size,
+                num_blocks=num_blocks, spec=spec,
+                admission=admission, tenant_weights=tenant_weights,
+                max_queue_depth=max_queue_depth),
+            draft=draft, adapters=adapter_pool)
     engine.start()
 
     def generate(payload: Dict[str, Any]):
@@ -195,7 +235,9 @@ def engine_backend(model: str = "tiny",
             max_new_tokens=int(payload.get("max_new_tokens", 16)),
             temperature=float(payload.get("temperature", 0.0)),
             eos_id=(int(payload["eos_id"])
-                    if "eos_id" in payload else None)))
+                    if "eos_id" in payload else None),
+            tenant=str(payload.get("tenant", "default")),
+            adapter_id=payload.get("adapter")))
         # hand the request's identity back in response headers: the
         # client can join its call to `tik serve requests` (by
         # request_id) and `tik cluster trace export --trace-id` (by the
@@ -207,10 +249,18 @@ def engine_backend(model: str = "tiny",
         try:
             tokens = req.wait(timeout=600)
         except RequestRejected as e:
-            # submit-time refusal, in KV-pool-capacity terms: 413 for
-            # a request the pool can never hold, 400 for a malformed
-            # one; the machine-readable reason rides the body
-            status = 413 if e.reason == "capacity" else 400
+            # submit-time refusal: 413 for a request the pool can
+            # never hold, 429 + Retry-After for a full admission
+            # queue (back-pressure — the affinity router respills it
+            # like a drain refusal), 400 for a malformed one; the
+            # machine-readable reason rides the body
+            if e.reason == "capacity":
+                status = 413
+            elif e.reason == "queue_full":
+                status = 429
+                headers["Retry-After"] = "1"
+            else:
+                status = 400
             raise BackendError(str(e), headers, status=status,
                                reason=e.reason) from e
         except Exception as e:
@@ -448,6 +498,34 @@ def main(argv=None) -> int:
                    help="prefill-role KV pool size in blocks "
                         "(--disagg; default fully provisions "
                         "prefill slots)")
+    p.add_argument("--adapters-dir", default=None,
+                   help="multi-tenant LoRA serving (engine mode): "
+                        "requests naming \"adapter\": \"<id>\" "
+                        "hot-load <adapters-dir>/<id> into the "
+                        "adapter pool and decode through the gathered "
+                        "batched-adapter path")
+    p.add_argument("--adapter-slots", type=int, default=8,
+                   help="resident-adapter capacity (LRU evicts idle "
+                        "adapters past it)")
+    p.add_argument("--lora-rank", type=int, default=16,
+                   help="LoRA rank the adapter checkpoints were "
+                        "trained at")
+    p.add_argument("--lora-alpha", type=float, default=32.0,
+                   help="LoRA alpha (scale = alpha / rank)")
+    p.add_argument("--admission", choices=["fifo", "wfq"],
+                   default="fifo",
+                   help="admission policy: fifo (arrival order) or "
+                        "wfq — weighted-fair across tenants, so one "
+                        "tenant's burst cannot starve another's TTFT "
+                        "budget")
+    p.add_argument("--tenant-weight", action="append", default=[],
+                   metavar="TENANT=WEIGHT",
+                   help="wfq share weight for a tenant (repeatable; "
+                        "unlisted tenants weigh 1.0)")
+    p.add_argument("--max-queue-depth", type=int, default=None,
+                   help="admission-queue bound: submits past this "
+                        "many waiting requests get 429 + Retry-After "
+                        "instead of unbounded queueing")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8200)
     p.add_argument("--replica-id", default=None,
@@ -485,6 +563,14 @@ def main(argv=None) -> int:
     if args.gbdt:
         backends.append(gbdt_backend(args.gbdt))
     elif args.engine:
+        tenant_weights = {}
+        for entry in args.tenant_weight:
+            name, _, weight = entry.partition("=")
+            try:
+                tenant_weights[name] = float(weight)
+            except ValueError:
+                p.error(f"--tenant-weight {entry!r}: expected "
+                        "TENANT=WEIGHT with a numeric weight")
         backends.append(engine_backend(
             args.model, checkpoint_dir=args.checkpoint_dir,
             slots=args.slots, max_len=args.max_len,
@@ -493,7 +579,13 @@ def main(argv=None) -> int:
             spec_checkpoint_dir=args.spec_checkpoint_dir,
             spec_k=args.spec_k, disagg=args.disagg,
             prefill_slots=args.prefill_slots,
-            prefill_blocks=args.prefill_blocks))
+            prefill_blocks=args.prefill_blocks,
+            adapters_dir=args.adapters_dir,
+            adapter_slots=args.adapter_slots,
+            lora_rank=args.lora_rank, lora_alpha=args.lora_alpha,
+            admission=args.admission,
+            tenant_weights=tenant_weights or None,
+            max_queue_depth=args.max_queue_depth))
     else:
         backends.append(transformer_backend(
             args.model, checkpoint_dir=args.checkpoint_dir))

@@ -95,6 +95,16 @@ SERVE_ROUTER_PROBE_FAILURES: Counter = _build(
     "tik_serve_router_probe_failures_total")
 SERVE_REPLICA_TARGET: Gauge = _build("tik_serve_replica_target")
 
+# serve multi-tenant LoRA (serve/adapters.py pool + tenant SLO substrate)
+SERVE_TENANT_REQUESTS: Counter = _build("tik_serve_tenant_requests_total")
+SERVE_TENANT_TTFT: Histogram = _build("tik_serve_tenant_ttft_seconds")
+SERVE_TENANT_TPOT: Histogram = _build("tik_serve_tenant_tpot_seconds")
+SERVE_TENANT_QUEUE_DEPTH: Gauge = _build("tik_serve_tenant_queue_depth")
+SERVE_ADAPTERS_RESIDENT: Gauge = _build("tik_serve_adapters_resident")
+SERVE_ADAPTER_LOADS: Counter = _build("tik_serve_adapter_loads_total")
+SERVE_ADAPTER_EVICTIONS: Counter = _build(
+    "tik_serve_adapter_evictions_total")
+
 # serve speculative decoding (EngineConfig.spec draft/verify loop)
 SERVE_SPEC_DRAFT_TOKENS: Counter = _build(
     "tik_serve_spec_draft_tokens_total")

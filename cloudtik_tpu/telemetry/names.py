@@ -194,6 +194,33 @@ _ALL = [
     _m("tik_serve_replica_target", "gauge",
        "Replica count the serve_demand autoscaler currently wants.",
        "serve"),
+    # -- serve multi-tenant LoRA (serve/adapters.py + tenant SLOs) --------
+    _m("tik_serve_tenant_requests_total", "counter",
+       "Serve requests finished, by tenant and result — the per-tenant "
+       "availability SLO reads it.", "serve", ("tenant", "result")),
+    _m("tik_serve_tenant_ttft_seconds", "histogram",
+       "Time to first token, by tenant — the per-tenant TTFT burn-rate "
+       "SLO reads it.", "serve", ("tenant",), LATENCY_BUCKETS),
+    _m("tik_serve_tenant_tpot_seconds", "histogram",
+       "Decode cadence after the first token, by tenant.", "serve",
+       ("tenant",), FAST_BUCKETS),
+    _m("tik_serve_tenant_queue_depth", "gauge",
+       "Requests waiting for a slot, by tenant — a bursting tenant's "
+       "queue grows while weighted-fair admission holds the others "
+       "flat.  role keeps two engines in one process (a disaggregated "
+       "pair) from overwriting each other.", "serve",
+       ("tenant", "role")),
+    _m("tik_serve_adapters_resident", "gauge",
+       "LoRA adapters resident in the stacked plane slots (pinned + "
+       "idle-LRU; capacity is AdapterPool(capacity=...), the "
+       "--adapter-slots serving flag).", "serve", ("role",)),
+    _m("tik_serve_adapter_loads_total", "counter",
+       "Cold adapter loads through the serve.lora.load seam, by "
+       "result (a load failure fails the request, not the engine).",
+       "serve", ("result",)),
+    _m("tik_serve_adapter_evictions_total", "counter",
+       "Idle adapters evicted from their plane slot to make room "
+       "(LRU, like the prefix cache).", "serve"),
     # -- serve speculative decoding (EngineConfig.spec) ------------------
     _m("tik_serve_spec_draft_tokens_total", "counter",
        "Draft-model tokens proposed and verified by speculative "
@@ -400,6 +427,7 @@ SPANS: Dict[str, str] = {
     "serve.router.forward":   "one router forward attempt to a replica",
     "serve.prefill":          "one prompt prefill chunk against the paged pool",
     "serve.kvcache.migrate":  "export a request's KV blocks through the migration transport",
+    "serve.lora.load":        "cold-load one LoRA adapter into its plane slot",
     "serve.kvcache.import":   "import migrated KV blocks into a decode-role pool",
     "serve.spec.verify":      "one speculative draft/verify round for a slot",
     "serve.decode_step":      "one engine decode step over all slots",

@@ -40,9 +40,10 @@ docs/observability.md documenting every SLO by name.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from cloudtik_tpu.telemetry import events
 
@@ -113,6 +114,56 @@ def default_slos() -> List[SLO]:
                     "(cancellations excluded; errors and shutdown "
                     "drains spend budget)"),
     ]
+
+
+def tenant_slos(tenants: Sequence[str],
+                ttft_objective: float = 0.95,
+                ttft_threshold_s: float = 2.5,
+                availability_objective: float = 0.99,
+                burn_threshold: float = 2.0) -> List[SLO]:
+    """Per-tenant SLOs over the tenant-labeled serve metrics
+    (multi-tenant serving): one TTFT and one availability objective
+    per tenant, each matching ``tenant="<name>"`` — so
+    ``tik_slo_burn_rate{slo="serve-ttft-tenant-<name>"}`` reads ONE
+    tenant's budget spend, and a bursting neighbor shows up as ITS
+    burn rising while the others hold (the weighted-fair admission
+    story, observable)."""
+    out: List[SLO] = []
+    for tenant in tenants:
+        out.append(SLO(
+            name=f"serve-ttft-tenant-{tenant}", kind=KIND_LATENCY,
+            metric="tik_serve_tenant_ttft_seconds",
+            labels=(("tenant", tenant),),
+            objective=ttft_objective, threshold_s=ttft_threshold_s,
+            burn_threshold=burn_threshold,
+            summary=f"tenant {tenant}: {ttft_objective * 100:g}% of "
+                    f"requests see their first token within "
+                    f"{ttft_threshold_s}s"))
+        out.append(SLO(
+            name=f"serve-availability-tenant-{tenant}",
+            kind=KIND_AVAILABILITY,
+            metric="tik_serve_tenant_requests_total",
+            labels=(("tenant", tenant),),
+            objective=availability_objective,
+            burn_threshold=burn_threshold,
+            summary=f"tenant {tenant}: "
+                    f"{availability_objective * 100:g}% of accepted "
+                    "requests finish `done`"))
+    return out
+
+
+def catalog_from_env() -> List[SLO]:
+    """The collector's SLO catalog: the defaults, plus per-tenant
+    SLOs for every tenant named in ``TIK_SLO_TENANTS`` (comma-
+    separated) — how an operator turns on per-tenant burn-rate gauges
+    without code."""
+    slos = default_slos()
+    names = [t.strip()
+             for t in os.environ.get("TIK_SLO_TENANTS", "").split(",")
+             if t.strip()]
+    if names:
+        slos.extend(tenant_slos(names))
+    return slos
 
 
 class _SloState:

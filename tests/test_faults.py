@@ -198,6 +198,11 @@ def test_seams_are_noops_without_a_plan(monkeypatch, tmp_path):
     from cloudtik_tpu.serve.router import fire_forward_seam
     fire_forward_seam("r0", 1)
 
+    # LoRA adapter cold-load seam (serve.lora.load) — the exact helper
+    # AdapterPool.acquire fires before every cold load
+    from cloudtik_tpu.serve.adapters import fire_load_seam
+    fire_load_seam("tenant-adapter")
+
     # KV-block migration export (serve.kvcache.migrate, fired per
     # block chunk through the real BlockMigrator.export path)
     import numpy as np

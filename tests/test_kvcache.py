@@ -172,3 +172,54 @@ class TestPrefixMap:
         assert pool.prefix_tokens_saved == 8
         pool.release(blocks)
         pool.release(table)
+
+
+class TestTenantIsolation:
+    """Multi-tenant chain-key namespaces: a prompt's KV depends on the
+    adapter that computed it, so identical prompts under different
+    adapter_ids must NEVER share blocks — the namespace salts the
+    chain ROOT, making every downstream key differ structurally."""
+
+    def test_namespace_changes_every_chain_key(self):
+        from cloudtik_tpu.serve.kvcache import chain_keys
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+        a = chain_keys(prompt, 4, namespace="adapter-a")
+        b = chain_keys(prompt, 4, namespace="adapter-b")
+        base = chain_keys(prompt, 4)
+        assert len(a) == len(b) == len(base) == 2
+        assert set(a).isdisjoint(b)
+        assert set(a).isdisjoint(base)
+        assert set(b).isdisjoint(base)
+        # and None stays the PR 8 shape — router hashing unchanged
+        assert base[0] == (("root",), (1, 2, 3, 4))
+
+    def test_identical_prompts_different_adapters_never_share(self):
+        pool = BlockPool(num_blocks=9, block_size=4)
+        prompt = list(range(1, 10))          # 9 tokens = 2 full blocks
+        table = pool.alloc(3)
+        assert pool.register_prefix(prompt, table,
+                                    namespace="adapter-a") == 2
+        # the other adapter — and the base model — see a cold cache
+        blocks, reuse = pool.match_prefix(prompt,
+                                          namespace="adapter-b")
+        assert blocks == [] and reuse == 0
+        blocks, reuse = pool.match_prefix(prompt)
+        assert blocks == [] and reuse == 0
+        # the same adapter hits
+        blocks, reuse = pool.match_prefix(prompt,
+                                          namespace="adapter-a")
+        assert blocks == table[:2] and reuse == 8
+        pool.release(blocks)
+        pool.release(table)
+
+    def test_namespaced_entries_evict_like_any_other(self):
+        pool = BlockPool(num_blocks=4, block_size=4)
+        table = pool.alloc(2)
+        pool.register_prefix(list(range(8)), table, namespace="a")
+        pool.release(table)                  # parks on the LRU
+        got = pool.alloc(3)                  # needs both cached blocks
+        assert len(got) == 3
+        blocks, reuse = pool.match_prefix(list(range(8)),
+                                          namespace="a")
+        assert blocks == [] and reuse == 0   # evicted, entry dropped
+        pool.release(got)

@@ -1,0 +1,618 @@
+"""Multi-tenant LoRA serving (serve/adapters.py + models/lora.py).
+
+The hard property: heterogeneous-adapter requests decoding TOGETHER in
+one gathered batched program must each produce exactly the output a
+DEDICATED engine with that adapter's weights merged (merge_lora) would
+— and the batch-homogeneous merged-weights fallback must agree with
+the gathered path, so a request's tokens never depend on who shares
+the batch.  Around that: adapter-pool LRU residency, load failures
+failing the request (not the engine), prefix-cache tenant isolation,
+the bounded admission queue (429 + Retry-After over HTTP, drain-like
+respill at the router), and weighted-fair admission/preemption.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from cloudtik_tpu.models import generate as G
+from cloudtik_tpu.models import lora as LO
+from cloudtik_tpu.models import transformer as T
+from cloudtik_tpu.serve.adapters import (
+    AdapterLoadError, AdapterPool, AdapterSlotsExhausted)
+from cloudtik_tpu.serve.engine import (
+    DecodeEngine, EngineConfig, Request, RequestRejected)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = T.config("tiny", dtype=jax.numpy.float32,
+                   attention_impl="reference", remat=False)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    lora_cfg = LO.LoRAConfig(rank=4)
+    bank = {f"t{i}": LO.random_lora_params(jax.random.PRNGKey(i + 1),
+                                           cfg, lora_cfg)
+            for i in range(4)}
+    return cfg, params, lora_cfg, bank
+
+
+def _pool(model, capacity=4, loader=None):
+    cfg, params, lora_cfg, bank = model
+    return AdapterPool(params, cfg, lora_cfg,
+                       loader=loader or (lambda aid: bank[aid]),
+                       capacity=capacity)
+
+
+ENGINE_KW = dict(max_len=64, prefill_buckets=(8, 16), block_size=8)
+
+
+def _engine(model, pool, slots=3, **ec_kw):
+    cfg, params, _lora_cfg, _bank = model
+    kw = dict(ENGINE_KW, slots=slots)
+    kw.update(ec_kw)
+    return DecodeEngine(params, cfg, EngineConfig(**kw), adapters=pool)
+
+
+def _merged_reference(model, adapter_id, prompt, max_new):
+    """The dedicated merged-weights engine's output for one request."""
+    cfg, params, lora_cfg, bank = model
+    merged = dict(params)
+    if adapter_id is not None:
+        merged["layers"] = LO.merge_lora(params["layers"],
+                                         bank[adapter_id], lora_cfg)
+    engine = DecodeEngine(merged, cfg,
+                          EngineConfig(slots=1, **ENGINE_KW))
+    engine.start()
+    try:
+        return engine.generate(prompt, max_new_tokens=max_new)
+    finally:
+        engine.stop()
+
+
+# ------------------------------------------------- gathered equivalence --
+
+class TestGatheredEquivalence:
+    def test_heterogeneous_batch_matches_dedicated_merged_engines(
+            self, model):
+        """Three requests wearing different adapters (one the base
+        model) decode in one shared gathered program; each output is
+        bit-identical to its dedicated merged-weights engine."""
+        engine = _engine(model, _pool(model))
+        engine.start()
+        prompts = [[5, 17, 101, 9], [42, 7, 19, 23, 88],
+                   [200, 201, 202]]
+        adapters = ["t0", "t1", None]
+        try:
+            reqs = [engine.submit(Request(
+                p, max_new_tokens=8, adapter_id=a,
+                tenant=a or "base"))
+                for p, a in zip(prompts, adapters)]
+            outs = [r.wait(timeout=300) for r in reqs]
+        finally:
+            engine.stop()
+        assert engine._gathered_steps > 0
+        assert engine.pool.used() == 0
+        for prompt, adapter, out in zip(prompts, adapters, outs):
+            assert out == _merged_reference(model, adapter, prompt, 8)
+
+    def test_homogeneous_batch_takes_merged_fallback(self, model):
+        """Every active lane on ONE adapter: the engine must use the
+        cached merged weights with the plain decode program — and
+        still match the dedicated engine exactly."""
+        engine = _engine(model, _pool(model), slots=2)
+        engine.start()
+        try:
+            r1 = engine.submit(Request([5, 17, 101, 9],
+                                       max_new_tokens=8,
+                                       adapter_id="t2"))
+            r2 = engine.submit(Request([42, 7, 19], max_new_tokens=8,
+                                       adapter_id="t2"))
+            o1, o2 = r1.wait(timeout=300), r2.wait(timeout=300)
+        finally:
+            engine.stop()
+        assert engine._merged_steps > 0
+        assert engine._gathered_steps == 0
+        assert o1 == _merged_reference(model, "t2", [5, 17, 101, 9], 8)
+        assert o2 == _merged_reference(model, "t2", [42, 7, 19], 8)
+
+    def test_multi_chunk_adapter_prompt_matches(self, model):
+        """A prompt spanning several prefill chunks under an adapter:
+        the gathered prefill path must agree with the merged engine."""
+        prompt = list(range(1, 21))          # 20 tokens, chunk max 16
+        engine = _engine(model, _pool(model), slots=2)
+        engine.start()
+        try:
+            out = engine.submit(Request(
+                prompt, max_new_tokens=6,
+                adapter_id="t0")).wait(timeout=300)
+        finally:
+            engine.stop()
+        assert out == _merged_reference(model, "t0", prompt, 6)
+
+    def test_batch_composition_does_not_change_output(self, model):
+        """The same request decoded alongside OTHER adapters (gathered
+        path) and alongside its own kind (merged fallback) yields the
+        same tokens — a request's output never depends on who shares
+        the batch."""
+        prompt = [3, 1, 4, 1, 5]
+        solo = _merged_reference(model, "t1", prompt, 8)
+        engine = _engine(model, _pool(model))
+        engine.start()
+        try:
+            hetero = [engine.submit(Request(prompt, max_new_tokens=8,
+                                            adapter_id="t1")),
+                      engine.submit(Request([9, 9, 9],
+                                            max_new_tokens=8,
+                                            adapter_id="t3"))]
+            assert hetero[0].wait(timeout=300) == solo
+            hetero[1].wait(timeout=300)
+        finally:
+            engine.stop()
+
+
+# ------------------------------------------------------- adapter pool --
+
+class TestAdapterPool:
+    def test_lru_eviction_past_capacity(self, model):
+        pool = _pool(model, capacity=2)
+        pool.acquire("t0")
+        pool.release("t0")
+        pool.acquire("t1")
+        pool.release("t1")
+        assert pool.resident() == ["t0", "t1"]
+        # t2 needs a slot: t0 is least recently used — evicted
+        pool.acquire("t2")
+        assert pool.resident() == ["t1", "t2"]
+        # distinct slots, never the reserved null slot 0
+        assert pool.slot("t1") != pool.slot("t2")
+        assert 0 not in (pool.slot("t1"), pool.slot("t2"))
+
+    def test_pinned_adapters_are_not_evictable(self, model):
+        pool = _pool(model, capacity=1)
+        pool.acquire("t0")                   # pinned (refcount 1)
+        with pytest.raises(AdapterSlotsExhausted):
+            pool.acquire("t1")
+        pool.release("t0")                   # parks on the idle LRU
+        assert pool.acquire("t1") == pool.slot("t1")
+        assert pool.resident() == ["t1"]
+
+    def test_resident_reacquire_is_cheap_and_refcounted(self, model):
+        loads = []
+
+        def loader(aid):
+            loads.append(aid)
+            return model[3][aid]
+
+        pool = _pool(model, capacity=2, loader=loader)
+        pool.acquire("t0")
+        pool.acquire("t0")                   # second holder, no load
+        assert loads == ["t0"]
+        pool.release("t0")                   # one holder remains
+        pool.acquire("t1")
+        # both slots pinned (t0 still held once): nothing evictable
+        with pytest.raises(AdapterSlotsExhausted):
+            pool.acquire("t2")
+        pool.release("t0")
+        pool.release("t1")
+        # both idle now: t2 evicts the least recently used (t0)
+        pool.acquire("t2")
+        assert loads == ["t0", "t1", "t2"]
+        assert pool.resident() == ["t1", "t2"]
+
+    def test_load_failure_returns_slot_and_raises(self, model):
+        def loader(aid):
+            if aid == "bad":
+                raise OSError("checkpoint unreadable")
+            return model[3][aid]
+
+        pool = _pool(model, capacity=1, loader=loader)
+        with pytest.raises(AdapterLoadError):
+            pool.acquire("bad")
+        # the slot went back to the free list: a good adapter loads
+        assert pool.acquire("t0") > 0
+        assert pool.resident() == ["t0"]
+
+    def test_mismatched_adapter_fails_as_load_error_no_slot_leak(
+            self, model):
+        """A loader returning wrong-shaped planes (rank/target drift
+        between training and serving) must fail as AdapterLoadError
+        with the slot returned — not leak the slot and surface an
+        arbitrary exception to the engine loop."""
+        cfg, _params, _lora_cfg, bank = model
+        wrong = LO.random_lora_params(jax.random.PRNGKey(9), cfg,
+                                      LO.LoRAConfig(rank=8))
+        pool = _pool(model, capacity=1,
+                     loader=lambda aid: wrong if aid == "wrong"
+                     else bank[aid])
+        with pytest.raises(AdapterLoadError):
+            pool.acquire("wrong")
+        assert pool.resident() == []
+        # the slot went back to the free list: a good adapter loads
+        assert pool.acquire("t0") > 0
+
+    def test_merged_cache_rides_residency(self, model):
+        cfg, params, lora_cfg, bank = model
+        pool = _pool(model, capacity=2)
+        pool.acquire("t0")
+        first = pool.merged("t0")
+        assert pool.merged("t0") is first          # cached
+        assert pool.merged(None) is params         # base untouched
+        pool.release("t0")
+        pool.acquire("t1")
+        pool.release("t1")
+        pool.acquire("t2")                         # evicts t0 (LRU)
+        assert "t0" not in pool._merged
+
+
+# ----------------------------------------- load failures fail requests --
+
+class TestLoadFailureFailsRequestNotEngine:
+    def test_unknown_adapter_fails_request_engine_lives(self, model):
+        engine = _engine(model, _pool(model))
+        engine.start()
+        try:
+            bad = engine.submit(Request([1, 2, 3], max_new_tokens=4,
+                                        adapter_id="no-such-adapter"))
+            with pytest.raises(AdapterLoadError):
+                bad.wait(timeout=300)
+            # the engine is untouched: the next request serves fine
+            out = engine.generate([1, 2, 3], max_new_tokens=4)
+            assert out == _merged_reference(model, None, [1, 2, 3], 4)
+            assert engine.pool.used() == 0
+        finally:
+            engine.stop()
+
+    def test_armed_fault_at_lora_load_seam(self, model):
+        """A `raise` armed at serve.lora.load fails exactly the
+        request whose cold load fired it; the next request's load
+        succeeds (times=1)."""
+        from cloudtik_tpu.faults import seams
+        from cloudtik_tpu.faults.plan import FaultPlan, FaultPoint
+        engine = _engine(model, _pool(model))
+        engine.start()
+        plan = FaultPlan([FaultPoint("serve.lora.load", "raise",
+                                     times=1)])
+        try:
+            with seams.armed(plan):
+                bad = engine.submit(Request([1, 2, 3],
+                                            max_new_tokens=4,
+                                            adapter_id="t0"))
+                with pytest.raises(AdapterLoadError):
+                    bad.wait(timeout=300)
+                out = engine.submit(Request(
+                    [1, 2, 3], max_new_tokens=4,
+                    adapter_id="t0")).wait(timeout=300)
+            assert out == _merged_reference(model, "t0", [1, 2, 3], 4)
+        finally:
+            engine.stop()
+            seams.disarm()
+
+
+# -------------------------------------------- prefix-cache isolation --
+
+class TestPrefixTenantIsolation:
+    def test_identical_prompts_different_adapters_share_nothing(
+            self, model):
+        """The chain-key namespace: an identical (block-aligned)
+        prompt under adapter B must not reuse adapter A's cached
+        blocks — their KV differs; sharing would serve corrupt
+        attention.  The SAME adapter's second request still hits."""
+        prompt = list(range(1, 18))          # 17 tokens = 2 full blocks
+        engine = _engine(model, _pool(model), slots=1)
+        engine.start()
+        try:
+            a1 = engine.submit(Request(prompt, max_new_tokens=2,
+                                       adapter_id="t0"))
+            a1.wait(timeout=300)
+            b = engine.submit(Request(prompt, max_new_tokens=2,
+                                      adapter_id="t1"))
+            b.wait(timeout=300)
+            assert b.prefix_tokens == 0      # NEVER shares across
+            assert b.prefix_blocks == 0      # adapters
+            base = engine.submit(Request(prompt, max_new_tokens=2))
+            base.wait(timeout=300)
+            assert base.prefix_tokens == 0   # nor with the base model
+            a2 = engine.submit(Request(prompt, max_new_tokens=2,
+                                       adapter_id="t0"))
+            a2.wait(timeout=300)
+            assert a2.prefix_tokens > 0      # same adapter: warm
+            # and the reused output is still the merged engine's
+            assert a2.tokens == _merged_reference(model, "t0", prompt,
+                                                  2)
+        finally:
+            engine.stop()
+
+
+# ---------------------------------------------- bounded admission queue --
+
+class TestQueueBound:
+    def test_submit_past_cap_rejects_queue_full(self, model):
+        cfg, params, _lc, _bank = model
+        engine = DecodeEngine(params, cfg, EngineConfig(
+            slots=1, max_queue_depth=2, **ENGINE_KW))
+        # never started: submissions stay queued, deterministically
+        engine.submit(Request([1, 2], max_new_tokens=2))
+        engine.submit(Request([3, 4], max_new_tokens=2))
+        third = engine.submit(Request([5, 6], max_new_tokens=2))
+        with pytest.raises(RequestRejected) as exc:
+            third.wait(timeout=5)
+        assert exc.value.reason == "queue_full"
+        engine.stop()                        # drains the queued two
+
+    def test_queue_full_maps_to_429_with_retry_after_over_http(self):
+        import urllib.error
+        import urllib.request
+
+        from cloudtik_tpu.serve.server import (
+            ServeServer, engine_backend)
+        backend = engine_backend(slots=1, max_len=32, block_size=8,
+                                 max_queue_depth=0,
+                                 dtype=jax.numpy.float32,
+                                 attention_impl="reference",
+                                 remat=False)
+        server = ServeServer([backend], host="127.0.0.1")
+        server.start()
+        try:
+            body = json.dumps({"tokens": [[1, 2, 3]],
+                               "max_new_tokens": 2}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/v1/generate",
+                data=body,
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=60)
+            assert exc.value.code == 429
+            assert exc.value.headers.get("Retry-After")
+            payload = json.loads(exc.value.read())
+            assert payload["reason"] == "queue_full"
+        finally:
+            server.stop()
+            backend.engine.stop()
+
+    def test_router_respills_queue_full_like_a_drain(self, model):
+        """EngineReplica surfaces a queue_full rejection as
+        ReplicaDraining — the router respills it to the next ring
+        replica without spending availability budget."""
+        from cloudtik_tpu.serve.router import (
+            EngineReplica, ReplicaDraining)
+        cfg, params, _lc, _bank = model
+        full = DecodeEngine(params, cfg, EngineConfig(
+            slots=1, max_queue_depth=0, **ENGINE_KW))
+        replica = EngineReplica("r-full", full)
+        with pytest.raises(ReplicaDraining):
+            replica.forward({"tokens": [1, 2, 3],
+                             "max_new_tokens": 2}, timeout_s=10)
+        full.stop()
+
+
+# ------------------------------------------- weighted-fair admission --
+
+class TestWeightedFairAdmission:
+    def _unstarted(self, model, slots=2, **kw):
+        cfg, params, _lc, _bank = model
+        return DecodeEngine(params, cfg, EngineConfig(
+            slots=slots, admission="wfq", **dict(ENGINE_KW, **kw)))
+
+    def test_wfq_admits_under_share_tenant_first(self, model):
+        """Queue [A1, A2, A3, B1] with 2 slots: WFQ admits A1 (nobody
+        holds anything, arrival order breaks the tie), then B1 — NOT
+        A2 — because A already holds a slot."""
+        engine = self._unstarted(model)
+        reqs = [Request([1, 2], max_new_tokens=2, tenant="a")
+                for _ in range(3)]
+        reqs.append(Request([3, 4], max_new_tokens=2, tenant="b"))
+        for req in reqs:
+            engine.submit(req)
+        engine._admit()                      # driven on the test thread
+        admitted = sorted(slot.request.tenant
+                          for slot in engine._slots
+                          if slot is not None)
+        assert admitted == ["a", "b"]
+        assert engine._slots[0].request is reqs[0]   # A's head, not A2
+        assert [r.tenant for r in engine._waiting] == ["a", "a"]
+        engine.stop()
+
+    def test_fifo_admits_arrival_order(self, model):
+        cfg, params, _lc, _bank = model
+        engine = DecodeEngine(params, cfg, EngineConfig(
+            slots=2, admission="fifo", **ENGINE_KW))
+        for tenant in ("a", "a", "b"):
+            engine.submit(Request([1, 2], max_new_tokens=2,
+                                  tenant=tenant))
+        engine._admit()
+        admitted = sorted(s.request.tenant for s in engine._slots
+                          if s is not None)
+        assert admitted == ["a", "a"]        # arrival order, B waits
+        engine.stop()
+
+    def test_weights_scale_the_share(self, model):
+        """weights a=3, b=1 and 4 slots: WFQ should admit a, b, a, a —
+        every admission goes to the lowest slots/weight share."""
+        engine = self._unstarted(model, slots=4,
+                                 tenant_weights={"a": 3.0, "b": 1.0})
+        order = ["a", "a", "a", "b", "b"]
+        for tenant in order:
+            engine.submit(Request([1, 2], max_new_tokens=2,
+                                  tenant=tenant))
+        engine._admit()
+        held = [s.request.tenant for s in engine._slots
+                if s is not None]
+        assert sorted(held) == ["a", "a", "a", "b"]
+        assert [r.tenant for r in engine._waiting] == ["b"]
+        engine.stop()
+
+    def test_preemption_victim_is_most_over_share_tenants_newest(
+            self, model):
+        """Slots held a, a, b (equal weights): the over-share tenant
+        is a, and the victim is a's NEWEST slot."""
+        engine = self._unstarted(model, slots=3)
+        for tenant in ("a", "a", "b"):
+            engine.submit(Request([1, 2], max_new_tokens=2,
+                                  tenant=tenant))
+        engine._admit()
+        # WFQ admission order interleaves (a, b, a): identify a's
+        # newest by admitted_mono, then ask for the victim
+        victim = engine._preempt_victim()
+        a_slots = [i for i, s in enumerate(engine._slots)
+                   if s is not None and s.request.tenant == "a"]
+        newest_a = max(a_slots, key=lambda i: (
+            engine._slots[i].request.admitted_mono or 0.0))
+        assert victim == newest_a
+        engine.stop()
+
+    def test_preemption_victim_respects_weights(self, model):
+        """a holds 2 of 3 slots at weight 4 (share 0.5); b holds 1 at
+        weight 1 (share 1.0): b is the over-share tenant despite
+        holding fewer slots."""
+        engine = self._unstarted(model, slots=3,
+                                 tenant_weights={"a": 4.0, "b": 1.0})
+        for tenant in ("a", "a", "b"):
+            engine.submit(Request([1, 2], max_new_tokens=2,
+                                  tenant=tenant))
+        engine._admit()
+        victim = engine._preempt_victim()
+        assert engine._slots[victim].request.tenant == "b"
+        engine.stop()
+
+
+# --------------------------------------------------- tenant telemetry --
+
+class TestTenantLedgerAndCli:
+    def test_records_carry_tenant_and_adapter(self, model, tmp_path):
+        from cloudtik_tpu.serve import reqlog
+        path = str(tmp_path / "req.jsonl")
+        engine = _engine(model, _pool(model), slots=2)
+        engine.start()
+        reqlog.install(path)
+        try:
+            engine.submit(Request([1, 2, 3], max_new_tokens=3,
+                                  tenant="acme",
+                                  adapter_id="t0")).wait(timeout=300)
+            engine.submit(Request([4, 5], max_new_tokens=3,
+                                  tenant="globex")).wait(timeout=300)
+        finally:
+            reqlog.uninstall()
+            engine.stop()
+        records = reqlog.read_requests(path)
+        by_tenant = {r["tenant"]: r for r in records}
+        assert by_tenant["acme"]["adapter_id"] == "t0"
+        assert by_tenant["globex"]["adapter_id"] is None
+        grouped = reqlog.group_stats(records)
+        assert set(grouped) == {"acme", "globex"}
+        assert grouped["acme"]["count"] == 1
+
+    def test_cli_stats_by_tenant(self, model, tmp_path):
+        from click.testing import CliRunner
+
+        from cloudtik_tpu.scripts.cli import cli
+        from cloudtik_tpu.serve import reqlog
+        import types
+        path = str(tmp_path / "req.jsonl")
+        reqlog.install(path)
+        for i, tenant in enumerate(["acme", "acme", "globex"]):
+            req = types.SimpleNamespace(
+                request_id=i, prompt=[1, 2], tokens=[7, 8],
+                traceparent=None, bucket=8, tenant=tenant,
+                adapter_id=None,
+                created=100.0, admitted=100.1,
+                first_token_time=100.2 + i * 0.1, done_time=100.9,
+                created_mono=10.0, admitted_mono=10.1,
+                first_token_mono=10.2 + i * 0.1, done_mono=10.9)
+            reqlog.record(req, reqlog.FINISH_DONE)
+        reqlog.uninstall()
+        result = CliRunner().invoke(
+            cli, ["serve", "requests", "--path", path, "--stats",
+                  "--by", "tenant", "--json"])
+        assert result.exit_code == 0, result.output
+        grouped = json.loads(result.output)
+        assert set(grouped) == {"acme", "globex"}
+        assert grouped["acme"]["count"] == 2
+        assert grouped["globex"]["count"] == 1
+        # human table renders one block per tenant
+        result = CliRunner().invoke(
+            cli, ["serve", "requests", "--path", path, "--stats",
+                  "--by", "tenant"])
+        assert result.exit_code == 0, result.output
+        assert "tenant: acme" in result.output
+        assert "tenant: globex" in result.output
+        # --by without --stats is a usage error
+        result = CliRunner().invoke(
+            cli, ["serve", "requests", "--path", path, "--by",
+                  "tenant"])
+        assert result.exit_code != 0
+
+
+class TestTenantSlos:
+    def test_tenant_slos_factory(self):
+        from cloudtik_tpu.telemetry.slo import tenant_slos
+        slos = tenant_slos(["acme", "globex"])
+        names = [s.name for s in slos]
+        assert "serve-ttft-tenant-acme" in names
+        assert "serve-availability-tenant-globex" in names
+        for slo in slos:
+            assert dict(slo.labels).get("tenant") in ("acme", "globex")
+            assert slo.metric in ("tik_serve_tenant_ttft_seconds",
+                                  "tik_serve_tenant_requests_total")
+
+    def test_catalog_from_env(self, monkeypatch):
+        from cloudtik_tpu.telemetry.slo import (
+            catalog_from_env, default_slos)
+        monkeypatch.delenv("TIK_SLO_TENANTS", raising=False)
+        assert len(catalog_from_env()) == len(default_slos())
+        monkeypatch.setenv("TIK_SLO_TENANTS", "acme, globex")
+        catalog = catalog_from_env()
+        assert len(catalog) == len(default_slos()) + 4
+        names = {s.name for s in catalog}
+        assert "serve-ttft-tenant-globex" in names
+
+
+class TestCheckpointLoader:
+    def test_roundtrip_from_saved_checkpoint(self, model, tmp_path):
+        """`--adapters-dir` semantics: <dir>/<adapter_id> is a trainer
+        checkpoint of the adapter pytree; the loader restores it
+        against this server's model/rank template."""
+        from cloudtik_tpu.serve.adapters import checkpoint_loader
+        from cloudtik_tpu.train.checkpoint import (
+            CheckpointConfig, Checkpointer)
+        cfg, _params, lora_cfg, bank = model
+        ckpt = Checkpointer(CheckpointConfig(
+            directory=str(tmp_path / "adapters" / "t0")))
+        ckpt.save(0, {"params": bank["t0"]}, force=True)
+        ckpt.close()
+        load = checkpoint_loader(str(tmp_path / "adapters"), cfg,
+                                 lora_cfg)
+        restored = load("t0")
+        for target, pair in bank["t0"].items():
+            assert np.allclose(np.asarray(restored[target]["a"]),
+                               np.asarray(pair["a"]))
+            assert np.allclose(np.asarray(restored[target]["b"]),
+                               np.asarray(pair["b"]))
+        with pytest.raises(AdapterLoadError):
+            load("no-such-adapter")
+
+
+# ----------------------------------------------------- plane plumbing --
+
+class TestAdapterPlanes:
+    def test_write_and_clear_slot_roundtrip(self, model):
+        cfg, _params, lora_cfg, bank = model
+        planes = LO.init_adapter_planes(cfg, lora_cfg, 3)
+        planes = LO.write_adapter_slot(planes, 1, bank["t0"])
+        a = np.asarray(planes["wq"]["a"])
+        assert np.abs(a[:, 1]).max() > 0
+        assert np.abs(a[:, 0]).max() == 0       # null slot untouched
+        assert np.abs(a[:, 2]).max() == 0
+        planes = LO.clear_adapter_slot(planes, 1)
+        assert np.abs(np.asarray(planes["wq"]["a"])[:, 1]).max() == 0
+
+    def test_stack_adapters_layer_axis_leads(self, model):
+        cfg, _params, lora_cfg, bank = model
+        planes = LO.stack_adapters([bank["t0"], bank["t1"]], cfg,
+                                   lora_cfg)
+        a = planes["wq"]["a"]
+        assert a.shape[0] == cfg.n_layers and a.shape[1] == 2

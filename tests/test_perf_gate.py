@@ -321,6 +321,41 @@ class TestMultiReplicaTrajectoryIsolation:
         assert report["mode"] == "multi_replica"
 
 
+class TestMultiTenantTrajectoryIsolation:
+    """Multi-tenant LoRA records (serving_bench.py --workload
+    multi_tenant) carry mode="multi_tenant" and form their own
+    trajectory — mode-isolated in MODE_METRIC_TAGS exactly like
+    spec/disagg/multi_replica/elasticity/cpu_dryrun."""
+
+    def test_gate_excludes_multi_tenant_from_monolithic_median(
+            self, perf_gate, tmp_path):
+        _trajectory(tmp_path, [64.0, 60.0], metric="serving_rps_at_slo")
+        mislabeled = tmp_path / "BENCH_r13.json"
+        mislabeled.write_text(json.dumps({"parsed": {
+            "metric": "serving_rps_at_slo", "value": 9000.0,
+            "mode": "multi_tenant"}}))
+        paths = [str(p) for p in tmp_path.glob("BENCH_*.json")]
+        history = perf_gate.load_history(paths,
+                                         metric="serving_rps_at_slo")
+        assert sorted(v for _p, v in history) == [60.0, 64.0]
+
+    def test_multi_tenant_metric_forms_its_own_trajectory(
+            self, perf_gate, tmp_path):
+        record = {"parsed": {
+            "metric": "serving_rps_at_slo_multi_tenant",
+            "value": 200.0, "mode": "multi_tenant"}}
+        (tmp_path / "BENCH_r13.json").write_text(json.dumps(record))
+        paths = [str(p) for p in tmp_path.glob("BENCH_*.json")]
+        history = perf_gate.load_history(
+            paths, metric="serving_rps_at_slo_multi_tenant")
+        assert [v for _p, v in history] == [200.0]
+        code, report = perf_gate.gate(
+            {"metric": "serving_rps_at_slo_multi_tenant",
+             "value": 195.0, "mode": "multi_tenant"}, history, 10.0)
+        assert code == 0
+        assert report["mode"] == "multi_tenant"
+
+
 class TestCpuDryrunFallback:
     """Open item 3 first step: a probe failure must never record 0.0
     again — bench.py falls back to a labeled CPU-dryrun measurement,

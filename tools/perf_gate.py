@@ -53,6 +53,8 @@ MODE_METRIC_TAGS = {
     "disagg": "disagg",            # serving_bench.py --workload disagg
     # serving_bench.py --workload multi_replica (affinity router)
     "multi_replica": "replicated",
+    # serving_bench.py --workload multi_tenant (LoRA multiplexing)
+    "multi_tenant": "multi_tenant",
 }
 
 
