@@ -230,6 +230,7 @@ SUITES = {
     "telemetry_overhead": "telemetry_overhead.py",
     "serving": "serving_bench.py",
     "elasticity": "elasticity_bench.py",
+    "train_step": "train_step_bench.py",
 }
 
 

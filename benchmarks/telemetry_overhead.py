@@ -59,6 +59,14 @@ def main() -> int:
     _prof = stepprof.StepProfiler(goodput.LEDGER)
     disabled_step_record_ns = _ns(
         lambda: _prof.record_step(1, 0.001, 0.001, 0.01), n)
+    # the overlapped-step instrumentation: the per-step grad_sync
+    # segment record and the unarmed train.grad_sync seam both sit on
+    # every accumulated step's hot path
+    disabled_grad_sync_record_ns = _ns(
+        lambda: _prof.record_grad_sync(1, 0.001), n)
+    from cloudtik_tpu.parallel import overlap as _overlap
+    unarmed_grad_sync_seam_ns = _ns(
+        lambda: _overlap.fire_grad_sync_seam(1, True, 1024), n)
     # the async input pipeline's per-batch instrumentation (queue-depth
     # gauge + stall/wait histograms) must be attribute-check cheap too
     from cloudtik_tpu.train import prefetch as _prefetch
@@ -124,6 +132,10 @@ def main() -> int:
                 round(disabled_goodput_attr_ns, 1),
             "disabled_step_record_ns":
                 round(disabled_step_record_ns, 1),
+            "disabled_grad_sync_record_ns":
+                round(disabled_grad_sync_record_ns, 1),
+            "unarmed_grad_sync_seam_ns":
+                round(unarmed_grad_sync_seam_ns, 1),
             "disabled_prefetch_consumer_note_ns":
                 round(disabled_prefetch_note_ns, 1),
             "disabled_prefetch_producer_note_ns":

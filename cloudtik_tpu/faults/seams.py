@@ -29,6 +29,7 @@ Seam registry (keep docs/fault-injection.md in sync):
   serve.spec.verify               speculative verify    {request, width}  raise -> request degrades to plain decode
   serve.router.forward            router forward attempt {replica, request}  raise -> attempt fails over to the next ring replica
   train.prefetch.next             prefetcher hand-off   {qsize}         latency -> data_wait
+  train.grad_sync                 accumulated-step sync boundary {step, overlap, sync_bytes, fence}  latency -> grad_sync bucket, never step_compute
   elastic.slice_lost              coordinator membership poll {slice, step}  drop -> slice treated as lost
   elastic.remesh                  elastic re-mesh boundary {from_slices, to_slices, reason}  raise aborts the re-mesh
   serve.decode_step               DecodeEngine._step    {active}

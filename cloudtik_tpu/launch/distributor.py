@@ -34,6 +34,7 @@ class Distributor:
         hostfile: Optional[str] = None,
         num_nodes: Optional[int] = None,
         coordinator_port: int = 8476,
+        num_slices: Optional[int] = None,
     ):
         specs: List[HostSpec] = []
         if hostfile:
@@ -56,6 +57,16 @@ class Distributor:
             specs = specs[:num_nodes]
         self.hosts = specs
         self.coordinator_port = coordinator_port
+        # Multi-slice topology: hosts split into `num_slices` contiguous
+        # groups; each worker learns its dense slice index through env
+        # (parallel/distributed.slice_index — what lets fit_elastic's
+        # membership view run from a real `tik-run` launch).
+        if num_slices is not None:
+            if num_slices < 1 or len(specs) % num_slices != 0:
+                raise ValueError(
+                    f"num_slices={num_slices} must evenly divide the "
+                    f"{len(specs)} launch host(s)")
+        self.num_slices = num_slices
 
     @property
     def num_processes(self) -> int:
@@ -70,9 +81,16 @@ class Distributor:
 
     def env_for(self, process_index: int) -> dict:
         """Env exported to the program on host `process_index` — consumed by
-        cloudtik_tpu.parallel.distributed.auto_initialize."""
-        return {
+        cloudtik_tpu.parallel.distributed.auto_initialize (and, for
+        multi-slice launches, slice_index()/slice_count())."""
+        env = {
             "TIK_COORDINATOR_ADDRESS": self.coordinator_address,
             "TIK_NUM_PROCESSES": str(self.num_processes),
             "TIK_PROCESS_ID": str(process_index),
         }
+        if self.num_slices:
+            hosts_per_slice = self.num_processes // self.num_slices
+            env["TIK_SLICE_INDEX"] = str(
+                process_index // hosts_per_slice)
+            env["TIK_NUM_SLICES"] = str(self.num_slices)
+        return env

@@ -71,20 +71,24 @@ def resolve_cluster_hosts() -> List[str]:
 @click.option("--hostfile", default=None, type=click.Path(exists=True))
 @click.option("--num-nodes", "-n", default=None, type=int,
               help="Limit to the first N hosts.")
+@click.option("--num-slices", default=None, type=int,
+              help="Split the hosts into N pod slices: each worker gets "
+                   "TIK_SLICE_INDEX/TIK_NUM_SLICES in its env (what the "
+                   "elastic trainer's membership view keys on).")
 @click.option("--coordinator-port", default=8476, type=int)
 @click.option("--ssh-user", default=None)
 @click.option("--ssh-key", default=None)
 @click.option("--python", "python_bin", default=sys.executable)
 @click.argument("program", nargs=-1, required=True,
                 type=click.UNPROCESSED)
-def main(hosts, hostfile, num_nodes, coordinator_port, ssh_user, ssh_key,
-         python_bin, program):
+def main(hosts, hostfile, num_nodes, num_slices, coordinator_port,
+         ssh_user, ssh_key, python_bin, program):
     """Launch PROGRAM (a python script + args) across the slice."""
     host_list = [h for h in (hosts or "").split(",") if h] or \
         resolve_cluster_hosts()
     dist = Distributor(
         hosts=host_list or None, hostfile=hostfile, num_nodes=num_nodes,
-        coordinator_port=coordinator_port)
+        coordinator_port=coordinator_port, num_slices=num_slices)
 
     program = list(program)
     if program and program[0].endswith(".py"):

@@ -138,6 +138,21 @@ def batch_sharding(mesh: Mesh, rules: AxisRules = DEFAULT_RULES) -> NamedShardin
     return named_sharding(mesh, "batch", rules=rules)
 
 
+def batch_mesh_axes(mesh: Mesh,
+                    rules: AxisRules = DEFAULT_RULES) -> Tuple[str, ...]:
+    """The mesh axes the logical ``batch`` axis maps onto, filtered to
+    those present in ``mesh`` with size > 1 — the axes a data-parallel
+    gradient reduction crosses (parallel/overlap.py scatters its flat
+    gradient buckets over exactly these)."""
+    axes = dict(rules).get("batch")
+    if axes is None:
+        return ()
+    if isinstance(axes, str):
+        axes = (axes,)
+    return tuple(a for a in axes
+                 if a in mesh.axis_names and mesh.shape[a] > 1)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

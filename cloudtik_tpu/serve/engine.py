@@ -575,12 +575,6 @@ class DecodeEngine:
                     "per-request adapters, so its proposals would "
                     "verify at ~0 acceptance; run spec on a "
                     "single-tenant engine")
-            if migrator is not None:
-                raise ValueError(
-                    "a prefill-role engine (migrator=...) with an "
-                    "adapter pool is not supported yet — migration "
-                    "headers do not carry adapter identity, so the "
-                    "decode role could not reproduce the delta")
             scale = adapters.lora_cfg.scale
 
             self._decode_lora = jax.jit(
@@ -1172,6 +1166,31 @@ class DecodeEngine:
                     f"{bs} tokens ({self._capacity_tokens} tokens "
                     "per request)"))
                 continue
+            if req.adapter_id is not None and self._adapters is None:
+                # adapter-identity mismatch is geometry-shaped: THIS
+                # request can never decode here, so it fails — the
+                # pool and every later import are untouched
+                self._pending_imports.popleft()
+                self._finish_request(req, "error", RequestRejected(
+                    f"migrated request names adapter "
+                    f"{req.adapter_id!r} but this decode engine "
+                    "serves the base model only (no adapter pool "
+                    "configured)", reason="adapter"))
+                continue
+            adapter_slot = 0
+            if self._adapters is not None:
+                try:
+                    adapter_slot = self._adapters.acquire(
+                        req.adapter_id)
+                except AdapterSlotsExhausted:
+                    break     # every plane slot pinned: wait, FIFO,
+                    #           exactly like KV-block exhaustion
+                except AdapterLoadError as e:
+                    # the load failure fails the REQUEST, never the
+                    # engine or the pool
+                    self._pending_imports.popleft()
+                    self._finish_request(req, "error", e)
+                    continue
             # identical prefix blocks already cached HERE are reused
             # (a shared prompt imports once); only tail planes
             # scatter.  count=False: these tokens arrived computed,
@@ -1188,6 +1207,8 @@ class DecodeEngine:
             except (BlockPoolExhausted, FaultInjected):
                 if reuse_blocks:
                     self.pool.release(reuse_blocks)
+                if self._adapters is not None:
+                    self._adapters.release(req.adapter_id)
                 break             # wait for blocks, FIFO
             self._pending_imports.popleft()
             try:
@@ -1207,7 +1228,8 @@ class DecodeEngine:
                             prefill_pos=true_len,
                             length=true_len,
                             remaining=req.max_new_tokens - 1,
-                            decoding=True)
+                            decoding=True,
+                            adapter_slot=adapter_slot)
                         if req.admitted is None:   # cross-host import
                             req.admitted = time.time()
                             req.admitted_mono = time.monotonic()
@@ -1216,6 +1238,7 @@ class DecodeEngine:
                         req.kv_blocks = max(req.kv_blocks,
                                             len(slot.table))
                         self._slots[slot_id] = slot
+                        self._adapter_idx[slot_id] = adapter_slot
                         self._sync_table(slot_id)
                         self._stamp_first_token(slot_id, slot,
                                                 first_tok)
@@ -1237,6 +1260,8 @@ class DecodeEngine:
                     self._release_slot(slot_id)
                 else:     # failed before the slot took ownership
                     self.pool.release(reuse_blocks + fresh)
+                    if self._adapters is not None:
+                        self._adapters.release(req.adapter_id)
                 self._finish_request(req, "error", e)
 
     def _scatter_imported(self, table: List[int], start: int,

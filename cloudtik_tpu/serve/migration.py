@@ -209,7 +209,9 @@ def request_from_header(header: Dict[str, Any]):
         [int(t) for t in header["prompt"]],
         max_new_tokens=int(header.get("max_new_tokens", 16)),
         temperature=float(header.get("temperature", 0.0)),
-        eos_id=header.get("eos_id"))
+        eos_id=header.get("eos_id"),
+        tenant=str(header.get("tenant", "default")),
+        adapter_id=header.get("adapter_id"))
     request.traceparent = header.get("traceparent")
     return request
 
@@ -397,6 +399,12 @@ class BlockMigrator:
             "temperature": request.temperature,
             "eos_id": request.eos_id,
             "traceparent": request.traceparent,
+            # adapter identity crosses with the KV state: the decode
+            # role re-acquires the SAME LoRA delta (and salts its
+            # prefix-cache keys with it), so disaggregated serving
+            # composes with multi-tenant adapters
+            "tenant": getattr(request, "tenant", "default"),
+            "adapter_id": getattr(request, "adapter_id", None),
             "block_size": int(block_size),
             "n_layers": int(k.shape[0]),
             "n_kv_heads": int(k.shape[3]),

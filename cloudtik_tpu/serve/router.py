@@ -110,23 +110,31 @@ def fire_forward_seam(replica_id: str, request_id: Any) -> None:
 
 # ------------------------------------------------------------ chain keys --
 
-def prefix_chain_key(prompt: Sequence[int], block_size: int) -> Tuple:
+def prefix_chain_key(prompt: Sequence[int], block_size: int,
+                     namespace=None) -> Tuple:
     """The routing key: the chain-key tuple over the prompt's FULL
     ``block_size``-aligned blocks — built by the SAME
     `kvcache.chain_keys` the prefix map shares blocks by (the partial
     tail block is excluded, exactly as the prefix map excludes it), so
     two prompts sharing their block-aligned prefix route identically
-    no matter how their tails differ."""
-    keys = kvcache.chain_keys(prompt, block_size)
-    return keys[-1] if keys else ("root",)
+    no matter how their tails differ.  ``namespace`` (an adapter_id)
+    salts the chain ROOT exactly as the prefix map salts it: fleets
+    serving disjoint adapter sets keep adapter-warm replicas hot
+    because identical prompts under different adapters hash apart —
+    just as their KV blocks never share."""
+    keys = kvcache.chain_keys(prompt, block_size, namespace=namespace)
+    if keys:
+        return keys[-1]
+    return ("root",) if namespace is None else ("root", namespace)
 
 
-def chain_hash(prompt: Sequence[int], block_size: int) -> int:
+def chain_hash(prompt: Sequence[int], block_size: int,
+               namespace=None) -> int:
     """Stable 64-bit digest of the prompt's chain key.  ``hash()`` is
     salted per process (PYTHONHASHSEED) — a router restart must not
     reshuffle every prefix onto cold replicas, so the digest is a
     content hash of a canonical encoding."""
-    key = prefix_chain_key(prompt, block_size)
+    key = prefix_chain_key(prompt, block_size, namespace=namespace)
     digest = hashlib.blake2b(repr(key).encode(), digest_size=8)
     return int.from_bytes(digest.digest(), "big")
 
@@ -537,7 +545,8 @@ class Router:
             prompt = prompt[0]
         payload = dict(payload, tokens=list(prompt))
         temperature = float(payload.get("temperature", 0.0))
-        key_hash = chain_hash(prompt, self.config.block_size)
+        key_hash = chain_hash(prompt, self.config.block_size,
+                              namespace=payload.get("adapter"))
         excluded: set = set()
         last_error: List[Optional[BaseException]] = [None]
         traceparent = telemetry.current_traceparent()

@@ -48,6 +48,15 @@ BUCKET_HOST_TRANSFER = "host_transfer"
 BUCKET_CHECKPOINT_SAVE = "checkpoint_save"
 BUCKET_CHECKPOINT_RESTORE = "checkpoint_restore"
 BUCKET_RESTART_REPLAY = "restart_replay"
+# data-parallel gradient sync at the step boundary of an accumulated
+# step (train/trainer.py _StepDispatcher): the host wall between the
+# grads and apply dispatches (plus the apply-retirement tail at window
+# flush) — injected latency at the train.grad_sync seam and the bench's
+# emulated-DCN sync land here, never in step_compute.  With overlap on
+# the per-microbatch reduces hide inside the scan, so a large grad_sync
+# under overlap means the buckets are too coarse or the mesh has no
+# data axis (docs/observability.md reading guide).
+BUCKET_GRAD_SYNC = "grad_sync"
 # elastic re-mesh coordination: the step-loop pause while the trainer
 # re-meshes across slices (train/elastic.py), NET of the restore and
 # compile seconds booked to their own buckets.  First-class so the
@@ -65,6 +74,7 @@ BUCKETS = (
     BUCKET_CHECKPOINT_SAVE,
     BUCKET_CHECKPOINT_RESTORE,
     BUCKET_RESTART_REPLAY,
+    BUCKET_GRAD_SYNC,
     BUCKET_ELASTIC_REMESH,
     BUCKET_SLOT_IDLE,
     BUCKET_IDLE,

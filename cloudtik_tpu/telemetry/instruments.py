@@ -47,6 +47,7 @@ DISCOVERY_SYNCS: Counter = _build("tik_discovery_sync_total")
 # train
 CHECKPOINT_SAVES: Counter = _build("tik_checkpoint_saves_total")
 CHECKPOINT_SAVE_SECONDS: Histogram = _build("tik_checkpoint_save_seconds")
+CHECKPOINT_D2H_SECONDS: Histogram = _build("tik_checkpoint_d2h_seconds")
 CHECKPOINT_RESTORE_SECONDS: Histogram = _build(
     "tik_checkpoint_restore_seconds")
 TRAIN_STEPS: Counter = _build("tik_train_steps_total")
@@ -128,6 +129,7 @@ TRAIN_DATA_WAIT_SECONDS: Histogram = _build("tik_train_data_wait_seconds")
 TRAIN_HOST_TRANSFER_SECONDS: Histogram = _build(
     "tik_train_host_transfer_seconds")
 TRAIN_DISPATCH_SECONDS: Histogram = _build("tik_train_dispatch_seconds")
+TRAIN_GRAD_SYNC_SECONDS: Histogram = _build("tik_train_grad_sync_seconds")
 TRAIN_COMPILES: Counter = _build("tik_train_compiles_total")
 TRAIN_STRAGGLER_LAG: Gauge = _build("tik_train_straggler_lag_seconds")
 TRAIN_PREFETCH_QUEUE_DEPTH: Gauge = _build("tik_train_prefetch_queue_depth")

@@ -272,6 +272,17 @@ _ALL = [
     _m("tik_train_compiles_total", "counter",
        "XLA backend compiles observed by the compile-tracking seam "
        "(first-step and recompiles).", "train"),
+    _m("tik_train_grad_sync_seconds", "histogram",
+       "Host-visible gradient-sync wall of an accumulated step: the "
+       "grads->apply dispatch boundary per step plus the window "
+       "flush's sync/update retirement tail (books to the grad_sync "
+       "goodput bucket, never step_compute).", "train",
+       (), FAST_BUCKETS),
+    _m("tik_checkpoint_d2h_seconds", "histogram",
+       "Background device->host transfer of one offloaded checkpoint "
+       "save (chunked per shard off the step loop; the step loop only "
+       "paid the on-device snapshot copy).", "train", (),
+       SLOW_BUCKETS),
     _m("tik_train_straggler_lag_seconds", "gauge",
        "Largest per-host step-publish lag behind the fastest host.",
        "train"),
@@ -421,6 +432,7 @@ SPANS: Dict[str, str] = {
     "updater.setup":          "initialization + setup commands",
     "updater.start_services": "start commands",
     "checkpoint.save":        "checkpoint save dispatch",
+    "checkpoint.d2h":         "background device->host copy of an offloaded save",
     "checkpoint.restore":     "checkpoint restore",
     "discovery.render":       "registry -> targets/dns render pass",
     "serve.enqueue":          "request submit -> queued",

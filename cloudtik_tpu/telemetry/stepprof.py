@@ -124,7 +124,8 @@ class StepProfiler:
 
     def record_step(self, step: int, data_wait_s: float,
                     transfer_s: float, dispatch_s: float,
-                    prefetch_wait_s: float = 0.0) -> None:
+                    prefetch_wait_s: float = 0.0,
+                    grad_sync_s: float = 0.0) -> None:
         """Account one step's segments.  Single attribute check when
         telemetry is off.
 
@@ -136,6 +137,13 @@ class StepProfiler:
         that one collapses toward zero instead of silently absorbing
         the queue wait (`tik_train_prefetch_consumer_wait_seconds`
         carries it, observed by the prefetcher itself).
+
+        `grad_sync_s`: the host wall an accumulated step spent at the
+        gradient-sync boundary (between the grads and apply dispatches
+        — where the ``train.grad_sync`` seam fires).  It is part of
+        ``dispatch_s``, so it is carved OUT of the dispatch attribution
+        and booked to the ``grad_sync`` bucket: sync wait must never
+        masquerade as ``step_compute``.
         """
         if not core.STATE.enabled:
             return
@@ -147,17 +155,35 @@ class StepProfiler:
         compiled = max(
             self.ledger.total(goodput.BUCKET_COMPILE)
             - self._compile_marker, 0.0)
-        dispatch_attr = max(dispatch_s - compiled, 0.0)
+        grad_sync_s = min(max(grad_sync_s, 0.0), dispatch_s)
+        dispatch_attr = max(dispatch_s - compiled - grad_sync_s, 0.0)
         ti.TRAIN_DISPATCH_SECONDS.observe(dispatch_attr)
+        if grad_sync_s:
+            ti.TRAIN_GRAD_SYNC_SECONDS.observe(grad_sync_s)
         wait_s = data_wait_s + prefetch_wait_s
         if step <= self.replay_until:
             self.ledger.attribute(
                 goodput.BUCKET_RESTART_REPLAY,
-                wait_s + transfer_s + dispatch_attr)
+                wait_s + transfer_s + dispatch_attr + grad_sync_s)
             return
         self.ledger.attribute(goodput.BUCKET_DATA_WAIT, wait_s)
         self.ledger.attribute(goodput.BUCKET_HOST_TRANSFER, transfer_s)
         self.ledger.attribute(goodput.BUCKET_STEP_COMPUTE, dispatch_attr)
+        if grad_sync_s:
+            self.ledger.attribute(goodput.BUCKET_GRAD_SYNC, grad_sync_s)
+
+    def record_grad_sync(self, step: int, seconds: float) -> None:
+        """The window boundary's sync/update tail: wall between the
+        last grads program retiring and the applied state retiring —
+        the deferred all-gather + optimizer update an accumulated step
+        leaves at the boundary (with overlap on it collapses; the
+        docs reading guide interprets a fat one)."""
+        if not core.STATE.enabled:
+            return
+        ti.TRAIN_GRAD_SYNC_SECONDS.observe(seconds)
+        bucket = goodput.BUCKET_RESTART_REPLAY \
+            if step <= self.replay_until else goodput.BUCKET_GRAD_SYNC
+        self.ledger.attribute(bucket, seconds)
 
     def record_sync(self, step: int, seconds: float) -> None:
         """The blocking window boundary: dispatched compute retiring

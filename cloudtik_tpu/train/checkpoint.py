@@ -23,10 +23,13 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import queue
+import threading
 import time
 from typing import Any, Optional
 
 import jax
+import numpy as np
 
 from cloudtik_tpu import telemetry
 from cloudtik_tpu.faults import seams
@@ -52,6 +55,16 @@ class CheckpointConfig:
     # deadline the wait gives up, journals tik_checkpoint_wait_timeout,
     # and teardown proceeds without it.
     wait_deadline_s: float = 0.0
+    # Offload the device->host transfer of async saves to a background
+    # thread: save() pays only an on-device snapshot copy (donated-safe
+    # — the trainer's donated buffers may be overwritten the moment the
+    # next step dispatches, but the snapshot is never donated) and the
+    # d2h + orbax write run off the step loop, bounded only by the
+    # wait()/close() deadlines above.  tik_checkpoint_d2h_seconds
+    # carries the transfer cost the step loop no longer pays.  Falls
+    # back to the in-line path for sync saves, torn-write drills, and
+    # multi-host shards this process cannot fully address.
+    offload_d2h: bool = True
 
 
 class Checkpointer:
@@ -78,10 +91,29 @@ class Checkpointer:
             path, options=options,
             item_handlers={"state": ocp.StandardCheckpointHandler()})
         self._ocp = ocp
+        # background d2h offload (CheckpointConfig.offload_d2h): the
+        # step loop stages a snapshot; this machinery moves it to host
+        # and through orbax off the loop
+        self._d2h_queue: "queue.Queue" = queue.Queue(maxsize=1)
+        self._d2h_thread: Optional[threading.Thread] = None
+        self._d2h_lock = threading.Lock()
+        self._d2h_pending = 0
+        self._d2h_done = threading.Condition(self._d2h_lock)
+        self._d2h_error: Optional[BaseException] = None
+        self._snapshot_jit = None
 
     # -- save --------------------------------------------------------------
     def save(self, step: int, state: Any, force: bool = False) -> bool:
-        """Async-save `state` at `step`; returns True if a save started."""
+        """Async-save `state` at `step`; returns True if a save started.
+
+        With ``offload_d2h`` (and async saves) the call stages an
+        on-device snapshot and returns — the device->host transfer and
+        the orbax write happen on the d2h worker thread, so the step
+        loop never blocks on d2h; durability is what ``wait()`` (with
+        its deadline) means.  A background failure is re-raised at the
+        next ``save()``/``wait()``, mirroring orbax's own async-error
+        discipline."""
+        self._reraise_d2h_error()
         # fire the seam only for saves that will actually start — a
         # skipped (off-interval) call must not consume a scheduled
         # fault's budget with nothing written to tear
@@ -89,12 +121,61 @@ class Checkpointer:
         if force or self._manager.should_save(step):
             directive = seams.fire("checkpoint.save", step=step,
                                    directory=self.config.directory)
+        else:
+            return False
+        # the torn-write drill needs the deterministic in-line path (it
+        # tears the files right after durability); multi-host shards
+        # this process cannot address cannot be device_get offloaded
+        offload = (self.config.async_save and self.config.offload_d2h
+                   and directive != DIRECTIVE_TORN_WRITE
+                   and all(getattr(l, "is_fully_addressable", True)
+                           for l in jax.tree.leaves(state)))
+        if not offload:
+            saved = self._save_inline(step, state, force)
+            if saved and directive == DIRECTIVE_TORN_WRITE:
+                # drill point: let the write land, then tear it — the
+                # step LOOKS committed (dir present, listed by
+                # latest_step) but its data is truncated, which is what
+                # a host dying between data write and durable flush
+                # leaves behind
+                self.wait()
+                self._tear_step(step)
+            return saved
+        t0 = time.perf_counter()
+        with telemetry.span("checkpoint.save", step=step,
+                            async_save=True, offload=True):
+            # the previous offloaded save must be durable before the
+            # next stages — the same next-save-waits backpressure orbax
+            # applies to its own async saves, and what keeps the
+            # elastic shrink scan's invariant: when save(N) returns,
+            # save(N-1) is committed and readable
+            self._d2h_join()
+            self._reraise_d2h_error()
+            snapshot = self._device_snapshot(state)
+            with self._d2h_lock:
+                self._d2h_pending += 1
+            if self._d2h_thread is None:
+                self._d2h_thread = threading.Thread(
+                    target=self._d2h_worker, name="tik-checkpoint-d2h",
+                    daemon=True)
+                self._d2h_thread.start()
+            self._d2h_queue.put((step, snapshot))
+        dt = time.perf_counter() - t0
+        ti.CHECKPOINT_SAVE_SECONDS.observe(dt)
+        goodput.attribute(goodput.BUCKET_CHECKPOINT_SAVE, dt)
+        return True
+
+    def _save_inline(self, step: int, state: Any, force: bool,
+                     offloaded: bool = False) -> bool:
+        """The in-line orbax save (the pre-offload path; also the tail
+        of the d2h worker, where `state` is already host-resident)."""
         t0 = time.perf_counter()
         compile_marker = goodput.LEDGER.total(goodput.BUCKET_COMPILE)
         # async saves: the span/histogram cover the dispatch (device ->
         # host copy), not background durability — attr async says which
         with telemetry.span("checkpoint.save", step=step,
-                            async_save=self.config.async_save):
+                            async_save=self.config.async_save,
+                            offload=offloaded):
             try:
                 saved = self._manager.save(
                     step,
@@ -110,7 +191,8 @@ class Checkpointer:
                 raise
         if saved:
             dt = time.perf_counter() - t0
-            ti.CHECKPOINT_SAVE_SECONDS.observe(dt)
+            if not offloaded:
+                ti.CHECKPOINT_SAVE_SECONDS.observe(dt)
             ti.CHECKPOINT_SAVES.inc(result="ok")
             # any jax compile fired inside this window was already
             # booked to the compile bucket by the stepprof listener;
@@ -124,14 +206,70 @@ class Checkpointer:
                               max(dt - compiled, 0.0))
             events.emit("tik_checkpoint_commit", step=step, result="ok",
                         directory=self.config.directory)
-        if saved and directive == DIRECTIVE_TORN_WRITE:
-            # drill point: let the write land, then tear it — the step
-            # LOOKS committed (dir present, listed by latest_step) but
-            # its data is truncated, which is what a host dying between
-            # data write and durable flush leaves behind
-            self.wait()
-            self._tear_step(step)
         return saved
+
+    # -- d2h offload -------------------------------------------------------
+    def _device_snapshot(self, state: Any) -> Any:
+        """Donated-safe on-device copy of the state, taken at the step
+        boundary: the copy is dispatched before the next step can
+        donate/overwrite the live buffers (stream order protects the
+        read), and the snapshot itself is never donated, so the worker
+        may d2h it at leisure."""
+        import jax.numpy as jnp
+
+        if self._snapshot_jit is None:
+            self._snapshot_jit = jax.jit(
+                lambda t: jax.tree.map(jnp.copy, t))
+        return self._snapshot_jit(state)
+
+    def _d2h_worker(self) -> None:
+        while True:
+            step, snapshot = self._d2h_queue.get()
+            try:
+                t0 = time.perf_counter()
+                with telemetry.span("checkpoint.d2h", step=step):
+                    host_state = _tree_device_get(snapshot)
+                del snapshot
+                dt = time.perf_counter() - t0
+                ti.CHECKPOINT_D2H_SECONDS.observe(dt)
+                # the transfer is checkpoint work whichever thread pays
+                # it; the ledger's first-booked-wins clamp keeps
+                # concurrent attribution under wall
+                goodput.attribute(goodput.BUCKET_CHECKPOINT_SAVE, dt)
+                # force=True: the should_save decision was taken at
+                # staging time; re-deciding here against the manager's
+                # now-stale last-saved step would drop queued saves
+                self._save_inline(step, host_state, force=True,
+                                  offloaded=True)
+                # drive THIS save to durability before taking the next:
+                # an offloaded save is committed-and-readable the
+                # moment the worker finishes it (what _d2h_join means)
+                t1 = time.perf_counter()
+                self._manager.wait_until_finished()
+                goodput.attribute(goodput.BUCKET_CHECKPOINT_SAVE,
+                                  time.perf_counter() - t1)
+            except BaseException as e:
+                logger.warning("offloaded checkpoint save of step %d "
+                               "failed", step, exc_info=True)
+                with self._d2h_lock:
+                    self._d2h_error = e
+            finally:
+                with self._d2h_done:
+                    self._d2h_pending -= 1
+                    self._d2h_done.notify_all()
+
+    def _d2h_join(self) -> None:
+        with self._d2h_done:
+            while self._d2h_pending > 0:
+                self._d2h_done.wait(timeout=0.5)
+
+    def _reraise_d2h_error(self) -> None:
+        with self._d2h_lock:
+            error, self._d2h_error = self._d2h_error, None
+        if error is not None:
+            raise RuntimeError(
+                "background (offloaded) checkpoint save failed"
+            ) from error
 
     def _tear_step(self, step: int) -> None:
         """Truncate the largest data file of a committed step in place."""
@@ -151,7 +289,8 @@ class Checkpointer:
                        largest, largest_size, max(largest_size // 2, 1))
 
     def wait(self, deadline_s: Optional[float] = None) -> bool:
-        """Block until all in-flight async saves are durable.
+        """Block until all in-flight async saves are durable —
+        offloaded d2h transfers included.
 
         ``deadline_s`` (falling back to the config's
         ``wait_deadline_s``; 0/None = unbounded) caps the wait: orbax's
@@ -162,8 +301,13 @@ class Checkpointer:
         forever.  Returns True when all saves are durable, False on
         deadline.
         """
-        return self._bounded(self._manager.wait_until_finished,
-                             deadline_s, op="wait")
+        def _wait_all():
+            self._d2h_join()
+            self._manager.wait_until_finished()
+
+        finished = self._bounded(_wait_all, deadline_s, op="wait")
+        self._reraise_d2h_error()
+        return finished
 
     def _bounded(self, fn, deadline_s: Optional[float], op: str) -> bool:
         from cloudtik_tpu.utils.retry import run_with_deadline
@@ -333,11 +477,20 @@ class Checkpointer:
             "silently restart from scratch") from last_error
 
     def close(self, deadline_s: Optional[float] = None) -> bool:
-        """Close the manager (drains async saves).  Same deadline
-        discipline as :meth:`wait`: a wedged save thread cannot hang
-        shutdown past ``deadline_s``.  Returns True when the close
-        completed, False on deadline."""
-        return self._bounded(self._manager.close, deadline_s, op="close")
+        """Close the manager (drains async saves — offloaded d2h
+        transfers included).  Same deadline discipline as :meth:`wait`:
+        a wedged save thread cannot hang shutdown past ``deadline_s``.
+        Returns True when the close completed, False on deadline."""
+        def _close_all():
+            self._d2h_join()
+            self._manager.close()
+
+        finished = self._bounded(_close_all, deadline_s, op="close")
+        # same async-error discipline as wait(): a background save that
+        # failed must not vanish silently at teardown — close is often
+        # the LAST call a trainer makes on the checkpointer
+        self._reraise_d2h_error()
+        return finished
 
 
 def _as_abstract(x):
@@ -345,3 +498,26 @@ def _as_abstract(x):
         return x
     sharding = getattr(x, "sharding", None)
     return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+
+def _tree_device_get(tree: Any) -> Any:
+    """Device->host copy of a snapshot, chunked per addressable shard
+    so one giant leaf never demands a monolithic transfer buffer.
+    Replicated leaves copy one representative shard per distinct index
+    (not one per device)."""
+    def one(x):
+        if not isinstance(x, jax.Array):
+            return np.asarray(x)
+        shards = getattr(x, "addressable_shards", None)
+        if not shards or len(shards) == 1:
+            return np.asarray(jax.device_get(x))
+        out = np.empty(x.shape, x.dtype)
+        seen = set()
+        for shard in shards:
+            key = tuple((s.start, s.stop, s.step) for s in shard.index)
+            if key in seen:
+                continue
+            seen.add(key)
+            out[shard.index] = np.asarray(shard.data)
+        return out
+    return jax.tree.map(one, tree)

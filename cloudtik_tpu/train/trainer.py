@@ -21,6 +21,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cloudtik_tpu import telemetry
+from cloudtik_tpu.parallel import overlap as overlap_lib
 from cloudtik_tpu.parallel.mesh import (
     MeshConfig, build_mesh, local_batch_slice)
 from cloudtik_tpu.telemetry import events, goodput, stepprof
@@ -31,6 +32,7 @@ from cloudtik_tpu.train.checkpoint import CheckpointConfig, Checkpointer
 from cloudtik_tpu.train.optim import OptimizerConfig, make_optimizer
 from cloudtik_tpu.train.prefetch import Prefetcher, put_device_batch
 from cloudtik_tpu.utils.compile_cache import ensure_compile_cache
+from cloudtik_tpu.utils.xla_flags import ensure_lhs_flags
 
 # Peak bf16 FLOPs/s per chip by TPU generation (public spec sheet numbers),
 # used for MFU.  Unknown platforms fall back to measured-only reporting.
@@ -188,6 +190,16 @@ class TrainerConfig:
     # many sequential micro-steps (the batch splits on its leading dim).
     # Scales effective batch beyond what one step's activations fit.
     grad_accum_steps: int = 1
+    # Overlapped gradient sync (parallel/overlap.py): with accum > 1,
+    # each microbatch's gradients are reduced over the data axis inside
+    # the scan (bucketed, scattered carry) so XLA's latency-hiding
+    # scheduler can interleave collective i with microbatch i+1's
+    # compute; only the closing all-gather stays at the step boundary.
+    # None = auto (on when accum > 1 and the mesh has a data axis);
+    # False = the sequential reference path (one deferred sync).  The
+    # two paths are loss-bit-identical on the tier-1 CPU mesh (tested).
+    overlap_grad_sync: Optional[bool] = None
+    overlap_bucket_bytes: int = overlap_lib.DEFAULT_BUCKET_BYTES
     # Async input pipeline (train/prefetch.py): batches are pulled and
     # device_put on background threads and handed to the step loop
     # already device-resident, behind a bounded depth-k queue.
@@ -206,6 +218,10 @@ class Trainer:
         # warm restarts after preemption deserialize XLA executables
         # instead of recompiling (TIK_COMPILE_CACHE_DIR; fail-soft)
         ensure_compile_cache()
+        # opt-in latency-hiding-scheduler flags (TIK_XLA_LHS) — what
+        # lets the overlapped grad-sync collectives actually hide under
+        # compute on TPU; must land in XLA_FLAGS before backend init
+        ensure_lhs_flags()
         self.mesh = mesh if mesh is not None else build_mesh(config.mesh)
         self.optimizer = make_optimizer(config.optimizer)
         # abstract shapes are mesh-independent: computed ONCE so an
@@ -220,10 +236,10 @@ class Trainer:
             self.mesh, spec.logical_axes, self._params_shape,
             config.rules)
         self.data_sharding = batch_sharding(self.mesh, config.rules)
-        self.step_fn = self._build_step()
         self.state = None
         self.step = 0
         self._jitted_step = None
+        self._retired_steps: list = []
         # steps <= this were already run before a restart (resume from
         # an older checkpoint): the goodput ledger books their time as
         # restart_replay, not progress
@@ -350,6 +366,12 @@ class Trainer:
             self.config.rules)
         self.data_sharding = batch_sharding(mesh, self.config.rules)
         self._opt_shardings = None
+        # retire (not destroy) the old dispatcher: freeing its XLA
+        # executables costs tens of ms, which must not book into the
+        # elastic_remesh coordination window — the next compile_step
+        # (outside the remesh span) drops it
+        if self._jitted_step is not None:
+            self._retired_steps.append(self._jitted_step)
         self._jitted_step = None
 
     def fit_elastic(
@@ -529,66 +551,26 @@ class Trainer:
         coordinator.commit(decision)
 
     # -- step --------------------------------------------------------------
-    def _build_step(self):
-        optimizer = self.optimizer
-        loss_fn = self.spec.loss_fn
-        accum = max(int(self.config.grad_accum_steps), 1)
-
-        def grads_of(params, batch):
-            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-            (_loss, metrics), grads = grad_fn(params, batch)
-            return grads, metrics
-
-        def accumulated_grads(params, batch):
-            """Mean grads over `accum` sequential micro-steps: the batch
-            splits on its leading dim and a lax.scan re-uses one
-            micro-step's activation memory for all of them."""
-            micro = jax.tree.map(
-                lambda b: b.reshape(accum, b.shape[0] // accum,
-                                    *b.shape[1:]), batch)
-
-            def body(carry, micro_batch):
-                grads, metrics = grads_of(params, micro_batch)
-                carry = jax.tree.map(
-                    lambda acc, g: acc + g.astype(acc.dtype),
-                    carry, grads)
-                return carry, metrics
-
-            zeros = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            total, metrics_stacked = jax.lax.scan(body, zeros, micro)
-            grads = jax.tree.map(lambda g: g / accum, total)
-            metrics = jax.tree.map(lambda m: m.mean(), metrics_stacked)
-            return grads, metrics
-
-        def train_step(state, batch):
-            if accum == 1:
-                grads, metrics = grads_of(state["params"], batch)
-            else:
-                grads, metrics = accumulated_grads(state["params"], batch)
-            updates, new_opt = optimizer.update(
-                grads, state["opt_state"], state["params"])
-            new_params = jax.tree.map(
-                lambda p, u: (p + u.astype(p.dtype)), state["params"], updates)
-            metrics["grad_norm"] = optax_global_norm(grads)
-            return {"params": new_params, "opt_state": new_opt}, metrics
-
-        return train_step
-
-    def compile_step(self):
-        """Jit the step with explicit shardings + donation (cached)."""
+    def compile_step(self) -> "_StepDispatcher":
+        """Build the jitted step program(s) for the current mesh
+        (cached; a remesh invalidates).  Returns a callable
+        ``(state, batch) -> (state, metrics)`` — one fused program when
+        ``grad_accum_steps == 1``, a grads/apply split otherwise so the
+        host sees the gradient-sync boundary (the ``train.grad_sync``
+        seam and the goodput ``grad_sync`` segment live there)."""
         if self._jitted_step is None:
-            opt_shardings = self._opt_state_shardings()
-            state_shardings = {"params": self.param_shardings,
-                               "opt_state": opt_shardings}
-            self._jitted_step = jax.jit(
-                self.step_fn,
-                in_shardings=(state_shardings, self.data_sharding),
-                out_shardings=(state_shardings,
-                               NamedSharding(self.mesh, P())),
-                donate_argnums=(0,),
-            )
+            self._retired_steps.clear()
+            self._jitted_step = _StepDispatcher(self)
         return self._jitted_step
+
+    @property
+    def overlap_enabled(self) -> bool:
+        """Whether this trainer's accumulated steps run the overlapped
+        gradient-sync schedule (resolved ``overlap_grad_sync``)."""
+        accum = max(int(self.config.grad_accum_steps), 1)
+        return overlap_lib.should_overlap(
+            self.config.overlap_grad_sync, accum, self.mesh,
+            self.config.rules)
 
     # -- loop --------------------------------------------------------------
     def fit(
@@ -663,9 +645,21 @@ class Trainer:
             # and MFU inflate
             nonlocal t_window, window_steps
             t_sync = time.perf_counter()
+            t_fence = None
+            if getattr(jitted, "split", False):
+                # accumulated steps retire in two fences: the grads
+                # program (compute) and the apply program (the
+                # gradient-sync/update tail) — the tail books to the
+                # grad_sync segment, not step_compute
+                jitted.fence()
+                t_fence = time.perf_counter()
             entry = {k: float(v) for k, v in metrics.items()}
-            profiler.record_sync(
-                self.step, time.perf_counter() - t_sync)
+            t_done = time.perf_counter()
+            if t_fence is not None:
+                profiler.record_sync(self.step, t_fence - t_sync)
+                profiler.record_grad_sync(self.step, t_done - t_fence)
+            else:
+                profiler.record_sync(self.step, t_done - t_sync)
             dt = time.perf_counter() - t_window
             tokens_s = tokens_per_step * window_steps / dt
             entry.update(step=self.step, tokens_per_sec=tokens_s)
@@ -712,7 +706,8 @@ class Trainer:
                     self.step,
                     0.0 if prefetching else wait_s,
                     t_put - t_data, t_dispatch - t_put,
-                    prefetch_wait_s=wait_s if prefetching else 0.0)
+                    prefetch_wait_s=wait_s if prefetching else 0.0,
+                    grad_sync_s=getattr(jitted, "last_sync_s", 0.0))
                 if capture.active:
                     capture.step_done(jax.tree.leaves(self.state)[0])
                 if (self.checkpointer is not None
@@ -729,6 +724,197 @@ class Trainer:
         capture.stop(jax.tree.leaves(self.state)[0]
                      if self.state is not None else None)
         return {"history": history, "final_step": self.step}
+
+
+class _StepDispatcher:
+    """One optimizer step's program(s) + the host-visible sync boundary.
+
+    ``grad_accum_steps == 1``: exactly the historical fused program
+    (grads + update in one jit, donated state).
+
+    ``grad_accum_steps > 1``: the step splits at the gradient-sync
+    boundary into a **grads program** (the accumulation scan — with
+    ``overlap_grad_sync`` on, each microbatch's gradients materialize
+    reduced inside the scan, accumulate as flat scattered buckets,
+    and the closing all-gather rebuilds the param-sharded tree as the
+    program's tail; parallel/overlap.py) and an **apply program** (the
+    optimizer update, identical HLO in both modes, donating state and
+    gradients).  Between the two dispatches
+    the host fires the ``train.grad_sync`` seam and times the boundary;
+    that wall (`last_sync_s`: apply-dispatch cost plus any injected or
+    emulated DCN sync) books to the goodput ``grad_sync`` segment, not
+    ``step_compute``.  ``fence()`` blocks on the last grads program's
+    metrics so the window flush can split retirement into compute
+    (everything up to the last gradients) and the sync/update tail.
+    """
+
+    def __init__(self, trainer: Trainer):
+        self._trainer = trainer
+        config = trainer.config
+        mesh = trainer.mesh
+        optimizer = trainer.optimizer
+        loss_fn = trainer.spec.loss_fn
+        param_shardings = trainer.param_shardings
+        params_shape = trainer._params_shape
+        accum = max(int(config.grad_accum_steps), 1)
+        self.accum = accum
+        self.split = accum > 1
+        self.overlap = overlap_lib.should_overlap(
+            config.overlap_grad_sync, accum, mesh, config.rules)
+        self.plan = overlap_lib.plan_overlap(
+            params_shape, mesh, config.rules,
+            bucket_bytes=config.overlap_bucket_bytes) \
+            if self.split else None
+        self.sync_bytes = overlap_lib.deferred_sync_bytes(
+            self.plan, self.overlap) if self.split else 0
+        self.last_sync_s = 0.0
+        self._fence = None
+
+        state_shardings = {"params": param_shardings,
+                           "opt_state": trainer._opt_state_shardings()}
+        replicated = NamedSharding(mesh, P())
+
+        def grads_of(params, batch):
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+            (_loss, metrics), grads = grad_fn(params, batch)
+            return grads, metrics
+
+        def apply_grads(state, grads):
+            updates, new_opt = optimizer.update(
+                grads, state["opt_state"], state["params"])
+            new_params = jax.tree.map(
+                lambda p, u: (p + u.astype(p.dtype)),
+                state["params"], updates)
+            return ({"params": new_params, "opt_state": new_opt},
+                    {"grad_norm": optax_global_norm(grads)})
+
+        if not self.split:
+            def train_step(state, batch):
+                grads, metrics = grads_of(state["params"], batch)
+                new_state, extra = apply_grads(state, grads)
+                metrics.update(extra)
+                return new_state, metrics
+
+            self._fused = jax.jit(
+                train_step,
+                in_shardings=(state_shardings, trainer.data_sharding),
+                out_shardings=(state_shardings, replicated),
+                donate_argnums=(0,))
+            return
+
+        plan = self.plan
+        overlap_on = self.overlap
+
+        def accumulated(params, batch):
+            """Mean grads over `accum` sequential micro-steps: the
+            batch splits on its leading dim and a lax.scan re-uses one
+            micro-step's activation memory for all of them.  Overlap
+            on: the carry is the scattered flat buckets (each
+            microbatch's reduce materializes inside the scan — the
+            overlappable collectives); off: the plain gradient tree
+            with one deferred sync (the bit-available reference)."""
+            micro = jax.tree.map(
+                lambda b: b.reshape(accum, b.shape[0] // accum,
+                                    *b.shape[1:]), batch)
+
+            if overlap_on:
+                def body(carry, micro_batch):
+                    grads, metrics = grads_of(params, micro_batch)
+                    grads = overlap_lib.materialize_grads(
+                        grads, param_shardings)
+                    flats = overlap_lib.flatten_buckets(grads, plan)
+                    carry = tuple(c + f for c, f in zip(carry, flats))
+                    return carry, metrics
+
+                total, metrics_stacked = jax.lax.scan(
+                    body, overlap_lib.zeros_carry(plan), micro)
+                grads_repr = tuple(t / accum for t in total)
+            else:
+                # the reference path materializes each microbatch's
+                # grads at the SAME layout the overlapped path pins
+                # (param shardings) — without it GSPMD may infer a
+                # different carry layout for some leaf (observed:
+                # lm_head) and its reduction tree drifts off the
+                # overlapped path's by ~1e-10, breaking the
+                # bit-identity contract the equivalence tests enforce.
+                # The accumulate itself stays the plain tree carry with
+                # its one deferred boundary sync.
+                def body(carry, micro_batch):
+                    grads, metrics = grads_of(params, micro_batch)
+                    grads = overlap_lib.materialize_grads(
+                        grads, param_shardings)
+                    carry = jax.tree.map(
+                        lambda acc, g: acc + g, carry, grads)
+                    return carry, metrics
+
+                zeros = jax.tree.map(
+                    lambda p, s: jax.lax.with_sharding_constraint(
+                        jnp.zeros(p.shape, jnp.float32), s.spec),
+                    params, param_shardings)
+                total, metrics_stacked = jax.lax.scan(
+                    body, zeros, micro)
+                grads_repr = jax.tree.map(lambda g: g / accum, total)
+            metrics = jax.tree.map(lambda m: m.mean(), metrics_stacked)
+            return grads_repr, metrics
+
+        def grads_fn(state, batch):
+            grads_repr, metrics = accumulated(state["params"], batch)
+            if overlap_on:
+                # the closing all-gather: the scattered bucket totals
+                # rebuild the gradient tree at the param shardings as
+                # this program's tail, so the APPLY program below is
+                # the same HLO in both modes — the optimizer update
+                # (its global-norm reduction included) cannot diverge
+                # between overlap and the sequential reference
+                grads_repr = overlap_lib.unflatten_buckets(
+                    grads_repr, plan, params_shape, param_shardings)
+            return grads_repr, metrics
+
+        self._grads = jax.jit(
+            grads_fn,
+            in_shardings=(state_shardings, trainer.data_sharding),
+            out_shardings=(param_shardings, replicated))
+        # state and gradients both donate: the apply program is the
+        # last reader of either (the grads program dispatched first,
+        # so stream order protects the params it still reads)
+        self._apply = jax.jit(
+            apply_grads,
+            in_shardings=(state_shardings, param_shardings),
+            out_shardings=(state_shardings, replicated),
+            donate_argnums=(0, 1))
+
+    def __call__(self, state, batch):
+        if not self.split:
+            self.last_sync_s = 0.0
+            state, metrics = self._fused(state, batch)
+            self._fence = metrics
+            return state, metrics
+        grads, metrics = self._grads(state, batch)
+        # the grads program's outputs retire together, so blocking on
+        # its (never-donated) metrics is a fence on the accumulation
+        # scan — the window flush uses it to split compute from the
+        # sync/update tail
+        self._fence = metrics
+        t_sync = time.perf_counter()
+        # the first apply dispatch compiles; those seconds are compile,
+        # not sync — subtract what the compile listener booked during
+        # the boundary (the save/restore windows' subtraction pattern)
+        compile_mark = goodput.LEDGER.total(goodput.BUCKET_COMPILE)
+        overlap_lib.fire_grad_sync_seam(
+            self._trainer.step, self.overlap, self.sync_bytes,
+            fence=self.fence)
+        state, extra = self._apply(state, grads)
+        compiled = max(goodput.LEDGER.total(goodput.BUCKET_COMPILE)
+                       - compile_mark, 0.0)
+        self.last_sync_s = max(
+            time.perf_counter() - t_sync - compiled, 0.0)
+        return state, {**metrics, **extra}
+
+    def fence(self) -> None:
+        """Block until the last dispatched grads program retired (the
+        accumulation compute, without the sync/update tail)."""
+        if self._fence is not None:
+            jax.block_until_ready(self._fence)
 
 
 def optax_global_norm(tree) -> jax.Array:

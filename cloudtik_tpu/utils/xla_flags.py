@@ -1,0 +1,67 @@
+"""Opt-in XLA latency-hiding-scheduler flags (``TIK_XLA_LHS``).
+
+The overlapped gradient-accumulation schedule (parallel/overlap.py)
+materializes one data-axis collective per bucket per microbatch inside
+the scan; whether those collectives actually *hide* under the next
+microbatch's compute is the latency-hiding scheduler's job, and on TPU
+that scheduler (plus async collective fusion) sits behind XLA flags.
+:func:`ensure_lhs_flags` appends the known-good set to ``XLA_FLAGS``
+when ``TIK_XLA_LHS`` is set truthy.
+
+Opt-in by environment, same discipline as the compile-cache knob
+(utils/compile_cache.py): the repo pins jax 0.4.37, and scheduler
+flags on a pinned runtime are exactly the kind of default a future
+runtime bump should flip, not this module.  It is also *fail-soft and
+order-sensitive*: ``XLA_FLAGS`` is parsed once, when the first backend
+initializes — call this before any jax device/compile work (Trainer
+and bench.py do at construction), or export the flags in the launch
+environment (``tik-run`` propagates the operator's env).  Flags
+already present in ``XLA_FLAGS`` are never overridden.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+LHS_ENV = "TIK_XLA_LHS"
+
+_ENABLE_VALUES = frozenset(("1", "on", "true", "yes"))
+
+# The documented overlap set (MaxText/accelerator-guide lineage): the
+# latency-hiding scheduler itself plus async collective fusion so
+# reduce/gather collectives become schedulable against compute.
+LHS_FLAGS: Tuple[str, ...] = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+)
+
+
+def lhs_enabled() -> bool:
+    return os.environ.get(LHS_ENV, "").strip().lower() in _ENABLE_VALUES
+
+
+def ensure_lhs_flags() -> Optional[str]:
+    """Idempotently append the latency-hiding-scheduler flags to
+    ``XLA_FLAGS`` when ``TIK_XLA_LHS`` opts in.  Returns the resulting
+    ``XLA_FLAGS`` value when enabled, None when the knob is off.
+    Flags whose name already appears (operator override) are kept as
+    the operator wrote them."""
+    if not lhs_enabled():
+        return None
+    current = os.environ.get("XLA_FLAGS", "")
+    added = [flag for flag in LHS_FLAGS
+             if flag.split("=", 1)[0] not in current]
+    if added:
+        os.environ["XLA_FLAGS"] = " ".join(
+            filter(None, [current, *added]))
+        logger.info("TIK_XLA_LHS: appended %d scheduler flag(s) to "
+                    "XLA_FLAGS (must run before backend init to take "
+                    "effect)", len(added))
+    return os.environ["XLA_FLAGS"]

@@ -226,6 +226,12 @@ def test_seams_are_noops_without_a_plan(monkeypatch, tmp_path):
                     block_size=2)
     assert len(sent) == 3          # header + 1 block + commit
 
+    # accumulated-step gradient-sync boundary (train.grad_sync) — the
+    # exact helper the step dispatcher fires between the grads and
+    # apply dispatches
+    from cloudtik_tpu.parallel.overlap import fire_grad_sync_seam
+    fire_grad_sync_seam(1, True, 4096, fence=lambda: None)
+
     # prefetcher consumer hand-off (train.prefetch.next)
     from cloudtik_tpu.train.prefetch import Prefetcher
     pf = Prefetcher(iter([{"x": 1}]), sharding=None)

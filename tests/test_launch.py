@@ -103,3 +103,50 @@ class TestTikRun:
         monkeypatch.delenv("TIK_SLICE_HOSTS")
         monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "w0,w1,w2")
         assert resolve_cluster_hosts() == ["w0", "w1", "w2"]
+
+
+class TestMultiSliceEnv:
+    """tik-run --num-slices: every worker learns its dense slice index
+    (TIK_SLICE_INDEX/TIK_NUM_SLICES) — what lets fit_elastic's
+    membership view run from a real launch (ROADMAP PR 10 remainder)."""
+
+    def test_env_for_exports_slice_topology(self):
+        d = Distributor(hosts=["h0", "h1", "h2", "h3"], num_slices=2)
+        envs = [d.env_for(i) for i in range(4)]
+        assert [e["TIK_SLICE_INDEX"] for e in envs] == \
+            ["0", "0", "1", "1"]
+        assert all(e["TIK_NUM_SLICES"] == "2" for e in envs)
+        # the coordinator env is unchanged alongside
+        assert envs[3]["TIK_PROCESS_ID"] == "3"
+
+    def test_no_slices_keeps_env_unchanged(self):
+        d = Distributor(hosts=["h0", "h1"])
+        assert "TIK_SLICE_INDEX" not in d.env_for(0)
+
+    def test_indivisible_slice_count_refuses(self):
+        with pytest.raises(ValueError, match="evenly divide"):
+            Distributor(hosts=["a", "b", "c"], num_slices=2)
+
+    def test_distributed_env_reaches_parallel_layer(self, monkeypatch):
+        from cloudtik_tpu.parallel import distributed
+        d = Distributor(hosts=["h0", "h1", "h2", "h3"], num_slices=2)
+        env = d.env_for(2)
+        monkeypatch.setenv("TIK_SLICE_INDEX", env["TIK_SLICE_INDEX"])
+        monkeypatch.setenv("TIK_NUM_SLICES", env["TIK_NUM_SLICES"])
+        assert distributed.slice_index() == 1
+        assert distributed.slice_count() == 2
+
+    def test_tik_run_cli_passes_num_slices(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("TIK_SLICE_HOSTS", raising=False)
+        monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+        probe = tmp_path / "probe.py"
+        out = tmp_path / "env.txt"
+        probe.write_text(
+            "import os\n"
+            f"open({str(out)!r}, 'w').write(\n"
+            "    os.environ.get('TIK_SLICE_INDEX', '-') + ' ' +\n"
+            "    os.environ.get('TIK_NUM_SLICES', '-'))\n")
+        result = CliRunner().invoke(
+            tik_run, ["--num-slices", "1", str(probe)])
+        assert result.exit_code == 0, result.output
+        assert out.read_text() == "0 1"

@@ -433,3 +433,134 @@ class TestLedgerAggregates:
         stats = reqlog.compute_stats(records)
         assert stats["migrations"] == 2
         assert stats["migrated_tokens"] == 48
+
+
+class TestAdapterIdentityCrossing:
+    """Migration headers carry adapter identity (ROADMAP item 4
+    remainder): disaggregated prefill/decode composes with multi-tenant
+    LoRA — the decode role re-acquires the SAME delta and salts its
+    prefix cache with it; a mismatch fails the request, not the pool."""
+
+    @pytest.fixture(scope="class")
+    def lora_model(self):
+        from cloudtik_tpu.models import lora as LO
+        cfg = T.config("tiny", dtype=jax.numpy.float32,
+                       attention_impl="reference", remat=False)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        lora_cfg = LO.LoRAConfig(rank=4)
+        bank = {"tA": LO.random_lora_params(jax.random.PRNGKey(1),
+                                            cfg, lora_cfg)}
+        return cfg, params, lora_cfg, bank
+
+    def test_export_header_carries_adapter_and_tenant(self):
+        class _Req:
+            request_id = 3
+            prompt = [1, 2]
+            max_new_tokens = 2
+            temperature = 0.0
+            eos_id = None
+            traceparent = None
+            tenant = "acme"
+            adapter_id = "tA"
+
+        sent = []
+        migrator = migration.BlockMigrator(
+            migration.LoopbackTransport(sent.append))
+        migrator.export(_Req(), first_token=3, length=2,
+                        k=np.zeros((1, 1, 2, 1, 1), np.float32),
+                        v=np.zeros((1, 1, 2, 1, 1), np.float32),
+                        block_size=2)
+        _kind, header, _k, _v = migration.unpack(sent[0])
+        assert header["adapter_id"] == "tA"
+        assert header["tenant"] == "acme"
+
+    def test_request_from_header_carries_adapter(self):
+        req = migration.request_from_header({
+            "prompt": [1, 2], "adapter_id": "tA", "tenant": "acme"})
+        assert req.adapter_id == "tA"
+        assert req.tenant == "acme"
+        # pre-adapter headers (an older prefill role) stay importable
+        legacy = migration.request_from_header({"prompt": [1, 2]})
+        assert legacy.adapter_id is None
+
+    def test_adapter_mismatch_fails_the_request_not_the_pool(
+            self, tiny):
+        """A migrated request naming an adapter arriving at a
+        base-model-only decode engine fails like a geometry mismatch:
+        finish=error, pool untouched, later imports unaffected."""
+        cfg, params = tiny
+        _prefill, decode, _delivered = _engine_pair(cfg, params)
+        req = Request([1, 2, 3], max_new_tokens=4, adapter_id="tA")
+        header = {"request_id": req.request_id, "length": 3,
+                  "first_token": 5, "block_size": 4, "blocks": 1,
+                  "adapter_id": "tA"}
+        decode.import_blocks(
+            req, header, np.zeros((2, 1, 4, 2, 4), np.float32),
+            np.zeros((2, 1, 4, 2, 4), np.float32))
+        decode._import_tick()
+        assert req._done.is_set()
+        assert isinstance(req.error, Exception)
+        assert "adapter" in str(req.error)
+        assert decode.pool.used() == 0
+
+    def test_lora_migration_is_bit_identical_to_merged_engine(
+            self, lora_model):
+        """Prefill(adapters) -> export -> decode(adapters) continues
+        with the adapter's delta: output bit-identical to a dedicated
+        merged-weights engine, adapter pins fully released."""
+        from cloudtik_tpu.models import lora as LO
+        from cloudtik_tpu.serve.adapters import AdapterPool
+
+        cfg, params, lora_cfg, bank = lora_model
+        delivered = []
+        inbox = migration.MigrationInbox(
+            lambda h, k, v: delivered.append((h, k, v)))
+        migrator = migration.BlockMigrator(
+            migration.LoopbackTransport(inbox.feed))
+        ec = dict(slots=2, max_len=32, prefill_buckets=(8,),
+                  block_size=4)
+
+        def pool():
+            return AdapterPool(params, cfg, lora_cfg,
+                               loader=lambda aid: bank[aid],
+                               capacity=2)
+
+        prefill = DecodeEngine(params, cfg, EngineConfig(**ec),
+                               migrator=migrator, adapters=pool())
+        decode = DecodeEngine(params, cfg, EngineConfig(**ec),
+                              adapters=pool())
+        prompt = [((i * 11) % 250) + 1 for i in range(10)]
+        req = Request(prompt, max_new_tokens=5, adapter_id="tA")
+        prefill.submit(req)
+        prefill._admit()
+        for _ in range(10):
+            if prefill._slots[0] is None:
+                break
+            prefill._prefill_tick()
+        assert prefill._slots[0] is None          # exported + freed
+        (header, k, v), = delivered
+        assert header["adapter_id"] == "tA"
+
+        decode.import_blocks(req, header, k, v)
+        decode._import_tick()
+        slot = decode._slots[0]
+        assert slot is not None and slot.adapter_slot != 0
+        for _ in range(20):
+            if all(s is None for s in decode._slots):
+                break
+            decode._step()
+        merged = dict(params)
+        merged["layers"] = LO.merge_lora(params["layers"], bank["tA"],
+                                         lora_cfg)
+        ref = np.asarray(G.generate(
+            merged, jax.numpy.asarray([prompt], np.int32), cfg,
+            max_new_tokens=5))[0].tolist()
+        assert req.tokens == ref
+        assert decode.pool.used() == 0
+        # the import's prefix registration is adapter-salted: the SAME
+        # prompt without the adapter shares nothing
+        blocks, _ = decode.pool.match_prefix(prompt, count=False,
+                                             namespace="tA")
+        assert blocks
+        bare, _ = decode.pool.match_prefix(prompt, count=False)
+        assert not bare

@@ -1,0 +1,321 @@
+"""Overlapped gradient sync (parallel/overlap.py + the trainer's
+grads/apply split) and the async checkpoint d2h offload.
+
+The hard property: the overlapped schedule — per-microbatch reduces
+materialized inside the accumulation scan, scattered flat-bucket carry,
+one closing all-gather — produces losses and parameters BIT-IDENTICAL
+(float equality) to the sequential reference path on the 8-device CPU
+mesh, across accumulation factors and DP×FSDP mesh shapes.  Around it:
+bucket-plan semantics, the grad_sync goodput segment (injected latency
+at the ``train.grad_sync`` seam books there, never step_compute), the
+``TIK_XLA_LHS`` knob, and the offloaded checkpoint d2h path (save never
+blocks on d2h; resume stays bit-identical; a background failure
+surfaces at the next save/wait).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from cloudtik_tpu.faults import seams
+from cloudtik_tpu.faults.plan import FaultPlan, FaultPoint
+from cloudtik_tpu.models import transformer as T
+from cloudtik_tpu.parallel import overlap as ov
+from cloudtik_tpu.parallel.mesh import MeshConfig, build_mesh
+from cloudtik_tpu.telemetry import goodput
+from cloudtik_tpu.train.data import synthetic_lm_batches
+from cloudtik_tpu.train.trainer import (
+    Trainer, TrainerConfig, transformer_spec)
+
+
+def _trainer(mesh_cfg, accum, overlap, steps_hint=10, **tc_over):
+    # the drill-standard tiny variant (chaos drill (f)'s bit-identity
+    # config): equal q/kv heads so every mesh shape shards the
+    # attention projections the same way
+    cfg = T.config("tiny", n_heads=8, n_kv_heads=8, d_ff=128,
+                   attention_impl="reference", remat=False)
+    tc = TrainerConfig(
+        global_batch_size=8, seq_len=32, mesh=mesh_cfg,
+        grad_accum_steps=accum, overlap_grad_sync=overlap,
+        prefetch_depth=0, log_every=1, **tc_over)
+    return cfg, Trainer(transformer_spec(cfg), tc)
+
+
+def _fit(trainer, cfg, steps=3, seed=5):
+    data = synthetic_lm_batches(8, 32, cfg.vocab_size, seed=seed)
+    out = trainer.fit(data, num_steps=steps, rng=jax.random.PRNGKey(1))
+    losses = [h["loss"] for h in out["history"]]
+    params = jax.tree.map(np.asarray, trainer.state["params"])
+    return losses, params
+
+
+# ------------------------------------------------------- bucket plans --
+
+class TestOverlapPlan:
+    def _shapes(self):
+        return {
+            "a": jax.ShapeDtypeStruct((64, 64), np.float32),   # 16 KB
+            "b": jax.ShapeDtypeStruct((8,), np.float32),
+            "c": jax.ShapeDtypeStruct((128, 64), np.float32),  # 32 KB
+        }
+
+    def test_greedy_packing_by_bytes(self):
+        mesh = build_mesh(MeshConfig(data=4, fsdp=-1))
+        plan = ov.plan_overlap(self._shapes(), mesh,
+                               bucket_bytes=20 << 10)
+        # leaves pack in tree order; bucket closes once it crosses the
+        # byte floor: [a(16K)+b] stays open at 16.03K < 20K, +c closes
+        assert plan.buckets == ((0, 1, 2),) or len(plan.buckets) >= 1
+        plan_small = ov.plan_overlap(self._shapes(), mesh,
+                                     bucket_bytes=8 << 10)
+        assert len(plan_small.buckets) == 2     # [a], [b, c]
+        assert plan_small.buckets[0] == (0,)
+        assert plan_small.buckets[1] == (1, 2)
+
+    def test_bucket_len_pads_to_scatter_product(self):
+        mesh = build_mesh(MeshConfig(data=4, fsdp=-1))   # 4 x 2
+        plan = ov.plan_overlap(
+            {"b": jax.ShapeDtypeStruct((9,), np.float32)}, mesh)
+        assert plan.pad_to == 8
+        assert plan.bucket_len(plan.buckets[0]) == 16
+
+    def test_scatter_axes_follow_batch_rules_and_mesh(self):
+        mesh = build_mesh(MeshConfig(data=4, fsdp=-1))
+        assert ov.plan_overlap(self._shapes(), mesh).scatter_axes == \
+            ("data", "fsdp")
+        mesh_dp = build_mesh(MeshConfig(data=8, fsdp=1))
+        assert ov.plan_overlap(self._shapes(),
+                               mesh_dp).scatter_axes == ("data",)
+
+    def test_deferred_sync_bytes_model(self):
+        mesh = build_mesh(MeshConfig(data=4, fsdp=-1))
+        plan = ov.plan_overlap(self._shapes(), mesh)
+        off = ov.deferred_sync_bytes(plan, overlap=False)
+        on = ov.deferred_sync_bytes(plan, overlap=True)
+        assert off == 2 * on > 0
+        single = build_mesh(MeshConfig(data=1, fsdp=1),
+                            devices=jax.devices()[:1])
+        plan1 = ov.plan_overlap(self._shapes(), single)
+        assert ov.deferred_sync_bytes(plan1, overlap=False) == 0
+
+    def test_should_overlap_resolution(self):
+        mesh = build_mesh(MeshConfig(data=4, fsdp=-1))
+        assert ov.should_overlap(None, 4, mesh)
+        assert not ov.should_overlap(None, 1, mesh)
+        assert not ov.should_overlap(False, 4, mesh)
+        assert ov.should_overlap(True, 4, mesh)
+        no_dp = build_mesh(MeshConfig(data=1, fsdp=-1))
+        assert not ov.should_overlap(None, 4, no_dp)   # no data axis
+        assert not ov.should_overlap(True, 1, no_dp)   # nothing to overlap
+
+
+# --------------------------------------------------- bit-equivalence --
+
+class TestOverlapEquivalence:
+    """The acceptance bar: overlapped losses/params bit-identical
+    (float equality) to the sequential path, accum ∈ {1, 2, 4} and
+    DP×FSDP mesh shapes on the 8-device CPU mesh."""
+
+    @pytest.mark.parametrize("mesh_cfg,accum", [
+        (MeshConfig(data=4, fsdp=2), 1),
+        (MeshConfig(data=4, fsdp=2), 2),
+        (MeshConfig(data=4, fsdp=2), 4),
+        (MeshConfig(data=8, fsdp=1), 2),
+        (MeshConfig(data=2, fsdp=4), 4),
+    ], ids=["4x2-a1", "4x2-a2", "4x2-a4", "8x1-a2", "2x4-a4"])
+    def test_losses_bit_identical_to_sequential(self, mesh_cfg, accum):
+        cfg, seq = _trainer(mesh_cfg, accum, overlap=False)
+        losses_seq, params_seq = _fit(seq, cfg)
+        cfg, ovl = _trainer(mesh_cfg, accum, overlap=True)
+        losses_ovl, params_ovl = _fit(ovl, cfg)
+        assert losses_seq == losses_ovl           # float equality
+        for a, b in zip(jax.tree.leaves(params_seq),
+                        jax.tree.leaves(params_ovl)):
+            assert np.array_equal(a, b)
+        dispatcher = ovl.compile_step()
+        assert dispatcher.overlap == (accum > 1)
+        assert seq.compile_step().overlap is False
+
+    def test_multi_bucket_plan_stays_bit_identical(self):
+        """A bucket floor small enough to split the tiny model into
+        several buckets changes only the collective granularity, never
+        the arithmetic."""
+        mesh_cfg = MeshConfig(data=4, fsdp=2)
+        cfg, seq = _trainer(mesh_cfg, 2, overlap=False)
+        losses_seq, params_seq = _fit(seq, cfg)
+        cfg, ovl = _trainer(mesh_cfg, 2, overlap=True,
+                            overlap_bucket_bytes=64 << 10)
+        losses_ovl, params_ovl = _fit(ovl, cfg)
+        assert len(ovl.compile_step().plan.buckets) > 1
+        assert losses_seq == losses_ovl
+        for a, b in zip(jax.tree.leaves(params_seq),
+                        jax.tree.leaves(params_ovl)):
+            assert np.array_equal(a, b)
+
+
+# ------------------------------------------------ grad_sync segment --
+
+class TestGradSyncAttribution:
+    def test_injected_latency_books_to_grad_sync_not_step_compute(self):
+        """Satellite: latency at the ``train.grad_sync`` fault seam
+        books to the new ``grad_sync`` goodput segment."""
+        from cloudtik_tpu.telemetry import instruments as ti
+
+        cfg, trainer = _trainer(MeshConfig(data=4, fsdp=2), 2,
+                                overlap=True)
+        # warm up (compile outside the armed window)
+        _fit(trainer, cfg, steps=1, seed=0)
+        compute_before = goodput.LEDGER.total(
+            goodput.BUCKET_STEP_COMPUTE)
+        sync_before = goodput.LEDGER.total(goodput.BUCKET_GRAD_SYNC)
+        hist_before = (ti.TRAIN_GRAD_SYNC_SECONDS.snapshot()
+                       or {"count": 0})["count"]
+        plan = FaultPlan([FaultPoint("train.grad_sync", "latency",
+                                     times=3,
+                                     args={"seconds": 0.05})])
+        with seams.armed(plan):
+            _fit(trainer, cfg, steps=3, seed=1)
+        assert plan.points[0].fired == 3
+        injected = 3 * 0.05
+        sync_s = goodput.LEDGER.total(goodput.BUCKET_GRAD_SYNC) \
+            - sync_before
+        compute_s = goodput.LEDGER.total(
+            goodput.BUCKET_STEP_COMPUTE) - compute_before
+        assert sync_s >= injected * 0.95
+        # the injected sleep must NOT have been absorbed as compute:
+        # compute grew only by the actual step work, which for 3 tiny
+        # steps is well under the injected 150ms
+        assert compute_s < injected
+        assert (ti.TRAIN_GRAD_SYNC_SECONDS.snapshot()
+                or {"count": 0})["count"] > hist_before
+
+    def test_seam_carries_fence_and_sync_bytes(self):
+        seen = []
+
+        class Spy:
+            def fire(self, seam, ctx):
+                if seam == "train.grad_sync":
+                    seen.append(ctx)
+                return None
+
+        cfg, trainer = _trainer(MeshConfig(data=4, fsdp=2), 2,
+                                overlap=True)
+        dispatcher = trainer.compile_step()
+        seams.arm(Spy())
+        try:
+            _fit(trainer, cfg, steps=1, seed=0)
+        finally:
+            seams.disarm()
+        (ctx,) = seen
+        assert ctx["overlap"] is True
+        assert ctx["sync_bytes"] == dispatcher.sync_bytes > 0
+        ctx["fence"]()            # callable, blocks until grads retire
+
+
+class TestLhsKnob:
+    def test_opt_in_appends_flags_once(self, monkeypatch):
+        from cloudtik_tpu.utils import xla_flags
+        monkeypatch.delenv("XLA_FLAGS", raising=False)
+        monkeypatch.setenv("TIK_XLA_LHS", "0")
+        assert xla_flags.ensure_lhs_flags() is None
+        monkeypatch.setenv("TIK_XLA_LHS", "1")
+        flags = xla_flags.ensure_lhs_flags()
+        assert "--xla_tpu_enable_latency_hiding_scheduler=true" in flags
+        again = xla_flags.ensure_lhs_flags()       # idempotent
+        assert again == flags
+
+    def test_operator_override_wins(self, monkeypatch):
+        from cloudtik_tpu.utils import xla_flags
+        monkeypatch.setenv(
+            "XLA_FLAGS",
+            "--xla_tpu_enable_latency_hiding_scheduler=false")
+        monkeypatch.setenv("TIK_XLA_LHS", "on")
+        flags = xla_flags.ensure_lhs_flags()
+        assert flags.count("xla_tpu_enable_latency_hiding_scheduler") \
+            == 1
+        assert "latency_hiding_scheduler=false" in flags
+
+
+# -------------------------------------------- checkpoint d2h offload --
+
+class TestCheckpointD2hOffload:
+    def _trainer(self, tmp_path, **ck_over):
+        cfg = T.config("tiny", attention_impl="reference", remat=False)
+        tc = TrainerConfig(
+            global_batch_size=8, seq_len=32,
+            mesh=MeshConfig(data=2, fsdp=4),
+            checkpoint_every=2, checkpoint_dir=str(tmp_path / "ckpt"),
+            prefetch_depth=0, log_every=100)
+        return cfg, Trainer(transformer_spec(cfg), tc)
+
+    def test_offloaded_save_resumes_bit_identical(self, tmp_path):
+        from cloudtik_tpu.telemetry import instruments as ti
+
+        d2h_before = (ti.CHECKPOINT_D2H_SECONDS.snapshot()
+                      or {"count": 0})["count"]
+        cfg, trainer = self._trainer(tmp_path)
+        assert trainer.checkpointer.config.offload_d2h
+        data = synthetic_lm_batches(8, 32, cfg.vocab_size, seed=2)
+        trainer.fit(data, num_steps=4)
+        before = jax.tree.map(np.asarray, trainer.state["params"])
+        assert trainer.checkpointer.wait()
+        # the d2h histogram carries the background transfers the step
+        # loop no longer paid
+        assert (ti.CHECKPOINT_D2H_SECONDS.snapshot()
+                or {"count": 0})["count"] > d2h_before
+        assert trainer.checkpointer.latest_step() == 4
+
+        _cfg, reader = self._trainer(tmp_path)
+        assert reader.maybe_resume() == 4
+        after = jax.tree.map(np.asarray, reader.state["params"])
+        jax.tree.map(np.testing.assert_array_equal, before, after)
+
+    def test_snapshot_is_donation_safe(self, tmp_path):
+        """The step after a save donates/overwrites the live state
+        buffers; the staged snapshot must still write the SAVED step's
+        values (not the later ones)."""
+        cfg, trainer = self._trainer(tmp_path)
+        data = synthetic_lm_batches(8, 32, cfg.vocab_size, seed=3)
+        trainer.fit(data, num_steps=2)     # save staged at step 2
+        at_save = jax.tree.map(np.asarray, trainer.state["params"])
+        trainer.fit(data, num_steps=3)     # donates the old buffers
+        trainer.checkpointer.wait()
+        _cfg, reader = self._trainer(tmp_path)
+        reader.restore_checkpoint(step=2)
+        got = jax.tree.map(np.asarray, reader.state["params"])
+        jax.tree.map(np.testing.assert_array_equal, at_save, got)
+
+    def test_background_failure_surfaces_at_next_wait(self, tmp_path,
+                                                      monkeypatch):
+        from cloudtik_tpu.train import checkpoint as ck
+
+        cfg, trainer = self._trainer(tmp_path)
+
+        def boom(tree):
+            raise OSError("disk gone")
+
+        monkeypatch.setattr(ck, "_tree_device_get", boom)
+        data = synthetic_lm_batches(8, 32, cfg.vocab_size, seed=4)
+        trainer.fit(data, num_steps=2)     # stages one offloaded save
+        # wait() drains the worker (which recorded the failure) and
+        # re-raises it — orbax's own async-error discipline
+        with pytest.raises(RuntimeError, match="offloaded"):
+            trainer.checkpointer.wait()
+
+    def test_sync_path_still_available(self, tmp_path):
+        from cloudtik_tpu.train.checkpoint import (
+            CheckpointConfig, Checkpointer)
+
+        ckpt = Checkpointer(CheckpointConfig(
+            directory=str(tmp_path / "sync"), save_interval_steps=1,
+            offload_d2h=False))
+        state = {"x": jax.numpy.arange(8, dtype=jax.numpy.float32)}
+        assert ckpt.save(1, state, force=True)
+        ckpt.wait()
+        restored = ckpt.restore({"x": jax.ShapeDtypeStruct(
+            (8,), np.float32)})
+        assert np.array_equal(np.asarray(restored["x"]),
+                              np.arange(8, dtype=np.float32))
+        ckpt.close()

@@ -463,3 +463,50 @@ class TestBenchSuiteDispatch:
         import os as _os
         assert kw["env"]["PYTHONPATH"].split(_os.pathsep)[0] == \
             _os.path.dirname(_os.path.abspath(bench.__file__))
+
+
+class TestTrainStepTrajectoryIsolation:
+    """train_step_bench.py records carry mode="train_step" and form
+    their own trajectory, and the flagship train_step_time_ms declares
+    better:"lower" — the gate flips the regression direction for
+    latency-shaped metrics."""
+
+    def test_gate_excludes_train_step_from_other_medians(
+            self, perf_gate, tmp_path):
+        _trajectory(tmp_path, [48.0, 48.2], metric="m")
+        mislabeled = tmp_path / "BENCH_r14.json"
+        mislabeled.write_text(json.dumps({"parsed": {
+            "metric": "m", "value": 9000.0, "mode": "train_step"}}))
+        paths = [str(p) for p in tmp_path.glob("BENCH_*.json")]
+        history = perf_gate.load_history(paths, metric="m")
+        assert sorted(v for _p, v in history) == [48.0, 48.2]
+
+    def test_train_step_metric_forms_its_own_trajectory(
+            self, perf_gate, tmp_path):
+        record = {"parsed": {
+            "metric": "train_step_time_ms", "value": 180.0,
+            "mode": "train_step", "better": "lower"}}
+        (tmp_path / "BENCH_r14.json").write_text(json.dumps(record))
+        paths = [str(p) for p in tmp_path.glob("BENCH_*.json")]
+        history = perf_gate.load_history(paths,
+                                         metric="train_step_time_ms")
+        assert [v for _p, v in history] == [180.0]
+
+    def test_lower_better_flips_the_regression_direction(
+            self, perf_gate):
+        history = [("BENCH_r14.json", 180.0)]
+        # 10% SLOWER (higher ms) fails ...
+        code, report = perf_gate.gate(
+            {"metric": "train_step_time_ms", "value": 220.0,
+             "mode": "train_step", "better": "lower"}, history, 10.0)
+        assert code == 1 and "above" in report["reason"]
+        # ... and 10% FASTER (lower ms) passes
+        code, report = perf_gate.gate(
+            {"metric": "train_step_time_ms", "value": 150.0,
+             "mode": "train_step", "better": "lower"}, history, 10.0)
+        assert code == 0
+        assert report["better"] == "lower"
+        # higher-better metrics keep the historical direction
+        code, _report = perf_gate.gate(
+            {"metric": "m", "value": 150.0}, [("h", 180.0)], 10.0)
+        assert code == 1

@@ -853,3 +853,42 @@ class TestServerDrain:
         finally:
             release.set()
             server.stop()
+
+
+class TestAdapterSaltedAffinity:
+    """ROADMAP item 4 remainder: the routing hash is salted with the
+    adapter_id exactly like the prefix map's chain keys, so fleets
+    serving disjoint adapter sets keep adapter-warm replicas hot."""
+
+    def test_same_prompt_different_adapters_hash_apart(self):
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+        base = chain_hash(prompt, 4)
+        a = chain_hash(prompt, 4, namespace="tA")
+        b = chain_hash(prompt, 4, namespace="tB")
+        assert len({base, a, b}) == 3
+        assert chain_hash(prompt, 4, namespace="tA") == a   # stable
+
+    def test_salt_matches_prefix_map_chain_keys(self):
+        from cloudtik_tpu.serve import kvcache
+        prompt = [1, 2, 3, 4, 5, 6]       # partial tail excluded
+        assert prefix_chain_key(prompt, 4, namespace="tA") == \
+            kvcache.chain_keys(prompt, 4, namespace="tA")[-1]
+        # short prompts (no full block) still namespace the root
+        assert prefix_chain_key([1], 4, namespace="tA") != \
+            prefix_chain_key([1], 4)
+
+    def test_ring_primaries_spread_by_adapter(self):
+        """Two identical prompts under different adapters may land on
+        different primaries; the same adapter always lands on the same
+        one (deterministic content hash)."""
+        from cloudtik_tpu.serve.router import HashRing
+        prompt = list(range(1, 9))
+        ring = HashRing(["r0", "r1", "r2", "r3"])
+        picks = {aid: ring.preference(
+                     chain_hash(prompt, 4, namespace=aid))[0]
+                 for aid in (None, "tA", "tB", "tC", "tD", "tE")}
+        assert picks["tA"] == ring.preference(
+            chain_hash(prompt, 4, namespace="tA"))[0]
+        # with 6 namespaces over 4 replicas, at least two distinct
+        # primaries must appear unless the hash ignored the salt
+        assert len(set(picks.values())) > 1

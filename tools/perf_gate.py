@@ -19,7 +19,9 @@ failed/empty the gate **skips cleanly** (exit 0) — a gate with no
 usable baseline must not block the first good run.  The baseline is
 the median of the surviving history values (robust to one lucky or
 unlucky run); regression means the fresh value is more than X% below
-it.  Higher is assumed better (MFU, tokens/sec).
+it.  Higher is assumed better (MFU, tokens/sec) unless the record
+declares ``"better": "lower"`` (latency-shaped metrics like
+``train_step_time_ms``), which flips the comparison.
 
 Run:  python tools/perf_gate.py --fresh fresh.json
       python tools/perf_gate.py --fresh - < bench_output.json
@@ -55,6 +57,8 @@ MODE_METRIC_TAGS = {
     "multi_replica": "replicated",
     # serving_bench.py --workload multi_tenant (LoRA multiplexing)
     "multi_tenant": "multi_tenant",
+    # train_step_bench.py overlap comparison (train/trainer.py)
+    "train_step": "train_step",
 }
 
 
@@ -119,15 +123,26 @@ def gate(fresh: Dict[str, Any], history: List[Tuple[str, float]],
                              "measurement")
         return 1, report
     value = float(parsed["value"])
-    floor = baseline * (1.0 - threshold_pct / 100.0)
+    # records may declare better:"lower" (latency-shaped metrics like
+    # train_step_time_ms); the gate then fails on values ABOVE the
+    # baseline instead of below it
+    lower_better = parsed.get("better") == "lower"
+    if lower_better:
+        floor = baseline * (1.0 + threshold_pct / 100.0)
+    else:
+        floor = baseline * (1.0 - threshold_pct / 100.0)
     report.update(metric=parsed.get("metric"), value=value, floor=floor)
+    if lower_better:
+        report["better"] = "lower"
     if parsed.get("mode") in MODE_METRIC_TAGS:
         report["mode"] = parsed["mode"]   # labeled own-trajectory mode
-    if value < floor:
-        drop = (baseline - value) / baseline * 100.0
+    regressed = value > floor if lower_better else value < floor
+    if regressed:
+        drop = abs(value - baseline) / baseline * 100.0
+        side = "above" if lower_better else "below"
         report.update(status="fail",
                       reason=f"regression: {value:.4g} is "
-                             f"{drop:.1f}% below the {baseline:.4g} "
+                             f"{drop:.1f}% {side} the {baseline:.4g} "
                              f"baseline (allowed {threshold_pct}%)")
         return 1, report
     report.update(status="ok",
