@@ -175,9 +175,26 @@ class ServeDemandPolicy(ScalingPolicy):
 
     def get_scaling_state(self) -> Optional[ScalingState]:
         self.autoscaler.evaluate()
+        # a role-split fabric publishes PER-ROLE demands, each tagged
+        # with a role resource ("tik-serve-role-<role>": 1) so the
+        # scaler bin-packs the ask onto node types that advertise the
+        # role (i.e. whose launch boots `tik-serve --role <role>`) —
+        # an untagged generic launch could join as the wrong role and
+        # leave the asked role's deficit standing forever; a
+        # monolithic fleet keeps the plain single-target shape
+        role_targets = self.autoscaler.role_targets
+        if role_targets:
+            demands = []
+            for role, target in sorted(role_targets.items()):
+                tag = {f"tik-serve-role-{role}": 1}
+                demands.extend(
+                    [dict(self.resource_per_replica, **tag)] * target)
+        else:
+            demands = ([dict(self.resource_per_replica)]
+                       * self.autoscaler.total_target())
         state = ScalingState()
-        state.set_autoscaling_instructions(make_autoscaling_instructions(
-            [dict(self.resource_per_replica)] * self.autoscaler.target))
+        state.set_autoscaling_instructions(
+            make_autoscaling_instructions(demands))
         return state
 
 

@@ -64,6 +64,12 @@ Memory model (PagedAttention, Kwon et al., SOSP'23 — serve/kvcache.py):
   block in the verify's write window is copy-on-write'd first
   (`fork_table`/`needs_copy` — the COW boundary, load-bearing here).
   A `serve.spec.verify` fault degrades the request to plain decode.
+  With an adapter pool attached, a request carrying an `adapter_id`
+  speculates ONLY when a per-adapter draft is registered
+  (`DecodeEngine(adapter_drafts={...})` — the verify then scores the
+  adapter-merged target); otherwise it takes the plain decode path:
+  a base-model draft proposing for an adapter target is a
+  correctness hazard, not an optimization.
 * Sampling on device: greedy / per-slot temperature (traced — no
   recompiles per request), engine-level static top_k; sampled
   (temperature > 0) requests always take the plain decode step.
@@ -190,6 +196,10 @@ class _Slot:
     draft_pos: int = 0                # prompt tokens in the draft cache
     draft_len: int = 0                # tokens the draft cache holds
     spec_off: bool = False            # verify fault: degraded to plain
+    # which draft weights propose for this slot: the base draft, or a
+    # registered per-adapter draft (adapter requests with no matching
+    # draft get no cache at all — they decode plain)
+    draft_params: Optional[Params] = None
 
 
 class RequestCancelled(RuntimeError):
@@ -484,7 +494,8 @@ class DecodeEngine:
                  = None,
                  migrator: Optional[migration.BlockMigrator] = None,
                  role: Optional[str] = None,
-                 adapters: Optional[AdapterPool] = None):
+                 adapters: Optional[AdapterPool] = None,
+                 adapter_drafts: Optional[Dict[str, Params]] = None):
         self.params = params
         self.cfg = cfg
         self.ec = engine_config or EngineConfig()
@@ -568,13 +579,6 @@ class DecodeEngine:
         self._merged_steps = 0
         self._gathered_steps = 0
         if adapters is not None:
-            if self.ec.spec is not None:
-                raise ValueError(
-                    "EngineConfig.spec with an adapter pool is not "
-                    "supported — the draft model knows nothing about "
-                    "per-request adapters, so its proposals would "
-                    "verify at ~0 acceptance; run spec on a "
-                    "single-tenant engine")
             scale = adapters.lora_cfg.scale
 
             self._decode_lora = jax.jit(
@@ -619,6 +623,26 @@ class DecodeEngine:
                 "a prefill-role engine (migrator=...) never decodes, "
                 "so EngineConfig.spec would only waste draft prefills "
                 "— configure spec on the decode role instead")
+        # per-adapter draft weights (adapter_id -> draft params over
+        # the SAME draft architecture, e.g. the base draft with that
+        # adapter's delta merged in).  On a spec-enabled multi-tenant
+        # engine, a request carrying an adapter_id speculates ONLY
+        # when its adapter has a draft registered here — the base
+        # draft proposing for an adapter-shifted target would verify
+        # at ~0 acceptance AND the base-params verify would break
+        # bit-identity, so unmatched adapter requests take the plain
+        # decode path instead (the defensive half of S-LoRA x spec).
+        self._adapter_drafts = dict(adapter_drafts or {})
+        if self._adapter_drafts and self._spec is None:
+            raise ValueError(
+                "adapter_drafts without EngineConfig.spec has no "
+                "effect — per-adapter drafts are a speculative-"
+                "decoding surface")
+        if self._adapter_drafts and adapters is None:
+            raise ValueError(
+                "adapter_drafts without an adapter pool: the engine "
+                "could never serve the adapters those drafts propose "
+                "for")
         if self._spec is not None:
             if draft is None:
                 raise ValueError(
@@ -1416,15 +1440,19 @@ class DecodeEngine:
                              prefill_pos=reuse_len,
                              remaining=req.max_new_tokens - 1,
                              adapter_slot=adapter_slot)
-                if self._spec is not None \
+                draft_params = self._draft_for(req)
+                if draft_params is not None \
                         and req.temperature <= 0.0:
                     # private draft cache; the draft prefills the WHOLE
                     # prompt (prefix-cache reuse only skips target
                     # compute — the draft has no shared pool).  Sampled
-                    # requests can never speculate, so they get no
-                    # draft cache and pay no draft prefill
+                    # requests can never speculate — nor can adapter
+                    # requests without a registered per-adapter draft
+                    # (_draft_for) — so they get no draft cache and
+                    # pay no draft prefill
                     slot.draft_cache = G.init_cache(
                         self._draft_cfg, 1, self._draft_plane)
+                    slot.draft_params = draft_params
                 req.kv_blocks = max(req.kv_blocks, len(slot.table))
                 self._slots[slot_id] = slot
                 self._adapter_idx[slot_id] = adapter_slot
@@ -1566,7 +1594,7 @@ class DecodeEngine:
         cache = dict(slot.draft_cache)
         cache["length"] = jnp.asarray(slot.draft_pos, jnp.int32)
         cache = dict(self._draft_prefill(
-            self._draft_params, jnp.asarray(padded), cache))
+            slot.draft_params, jnp.asarray(padded), cache))
         slot.draft_pos += chunk
         slot.draft_len = slot.draft_pos
         # forward_step advanced length by the PADDED width; pin it to
@@ -1602,6 +1630,20 @@ class DecodeEngine:
             self._cow_block(slot_id, slot, j)
 
     # -- speculative decoding ---------------------------------------------
+    def _draft_for(self, req: Request) -> Optional[Params]:
+        """The draft weights allowed to propose for this request: the
+        base draft for base-model requests; for adapter requests, the
+        REGISTERED per-adapter draft or nothing — a base-model draft
+        proposing for an adapter-shifted target is a correctness
+        hazard (the verify must score the adapter target, and ~0
+        acceptance would make every round pure overhead), so an
+        unmatched adapter request takes the plain decode path."""
+        if self._spec is None:
+            return None
+        if req.adapter_id is None:
+            return self._draft_params
+        return self._adapter_drafts.get(req.adapter_id)
+
     def _spec_width(self, slot: _Slot) -> int:
         """Verify width for a slot: pending token + proposals, capped
         so the emitted tokens can never overshoot max_new_tokens or
@@ -1655,11 +1697,11 @@ class DecodeEngine:
         while slot.draft_len < slot.length:
             tok = req.tokens[slot.draft_len - slot.true_len]
             _, cache = self._draft_step(
-                self._draft_params, jnp.asarray([[tok]], jnp.int32),
+                slot.draft_params, jnp.asarray([[tok]], jnp.int32),
                 cache)
             slot.draft_len += 1
         toks, cache = self._draft_propose_k(
-            self._draft_params,
+            slot.draft_params,
             jnp.asarray(req.tokens[-1], jnp.int32), cache)
         slot.draft_len += self._spec.k
         slot.draft_cache = dict(cache)
@@ -1701,6 +1743,12 @@ class DecodeEngine:
             for j in range(length // bs, (length + W - 1) // bs + 1):
                 if not self._cow_block(slot_id, slot, j):
                     return False   # preempted itself; re-admits later
+            # the verify must score the REQUEST'S target: for an
+            # adapter slot that is base+delta — the pool's cached
+            # merged weights (params are a program argument, so no
+            # recompile), bit-identical to the gathered decode path
+            target_params = self.params if req.adapter_id is None \
+                else self._adapters.merged(req.adapter_id)
             with telemetry.trace_context(req.traceparent):
                 with telemetry.span("serve.spec.verify",
                                     request=req.request_id,
@@ -1710,7 +1758,7 @@ class DecodeEngine:
                     padded[0, 0] = req.tokens[-1]
                     padded[0, 1:W] = proposals
                     self._kp, self._vp, target = self._verify(
-                        self.params, self._kp, self._vp,
+                        target_params, self._kp, self._vp,
                         jnp.asarray(self._tables_np[slot_id]),
                         jnp.asarray(padded),
                         jnp.asarray(length, jnp.int32))

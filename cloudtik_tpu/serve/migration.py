@@ -42,6 +42,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -167,12 +168,18 @@ class SocketKVTransport(KVTransport):
       (re-prefill on the decode role) owns the request.  A torn
       transport is never reused — the caller builds a fresh one per
       migration attempt or connection epoch.
+    * ``frame_delay_s`` injects a fixed per-frame latency at this seam
+      — the DCN emulation knob: a CPU-harness bench over loopback TCP
+      pays an honest cross-host wire cost per block frame instead of
+      pretending the datacenter network is free.
     """
 
     def __init__(self, host: str, port: int,
                  connect_timeout_s: float = 5.0,
-                 send_timeout_s: float = 10.0):
+                 send_timeout_s: float = 10.0,
+                 frame_delay_s: float = 0.0):
         self.address = (host, int(port))
+        self.frame_delay_s = float(frame_delay_s)
         self._sock: Optional[socket.socket] = socket.create_connection(
             self.address, timeout=connect_timeout_s)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -181,6 +188,8 @@ class SocketKVTransport(KVTransport):
     def send(self, msg: bytes) -> None:
         if self._sock is None:
             raise OSError("socket KV transport already torn down")
+        if self.frame_delay_s > 0.0:
+            time.sleep(self.frame_delay_s)
         try:
             self._sock.sendall(_U32.pack(len(msg)) + msg)
         except (OSError, ValueError):
@@ -202,7 +211,12 @@ def request_from_header(header: Dict[str, Any]):
     handoff.  The header already carries everything the decode side
     needs (prompt, first token, sampling knobs, traceparent); lifecycle
     stamps start fresh HERE, which is correct — queue wait and TTFT on
-    the decode side start when the migrated state arrives."""
+    the decode side start when the migrated state arrives.
+
+    ``migrated_from`` is stamped with the header's origin request id:
+    the constructed request gets a fresh LOCAL id (the id counter is
+    per-process), so the origin id is the only join key a fabric-level
+    waiter (serve/fabric.py) or a cross-host response path has."""
     from cloudtik_tpu.serve.engine import Request
 
     request = Request(
@@ -213,6 +227,17 @@ def request_from_header(header: Dict[str, Any]):
         tenant=str(header.get("tenant", "default")),
         adapter_id=header.get("adapter_id"))
     request.traceparent = header.get("traceparent")
+    request.migrated_from = header.get("request_id")
+    created = header.get("created")
+    if created is not None:
+        # back-date the lifecycle origin to the ORIGIN submit: TTFT and
+        # queue wait must span router -> prefill -> migration -> first
+        # token, not restart at import.  The monotonic twin (what the
+        # ledger actually derives latencies from) shifts by the wall
+        # elapsed — exact in-process, skew-bounded cross-host.
+        elapsed = max(0.0, time.time() - float(created))
+        request.created = float(created)
+        request.created_mono -= elapsed
     return request
 
 
@@ -399,6 +424,9 @@ class BlockMigrator:
             "temperature": request.temperature,
             "eos_id": request.eos_id,
             "traceparent": request.traceparent,
+            # origin submit time: the importer back-dates its lifecycle
+            # stamps so TTFT spans the whole fabric path
+            "created": getattr(request, "created", None),
             # adapter identity crosses with the KV state: the decode
             # role re-acquires the SAME LoRA delta (and salts its
             # prefix-cache keys with it), so disaggregated serving

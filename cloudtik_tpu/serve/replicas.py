@@ -37,6 +37,14 @@ WHY-labeled (``serve_demand`` / ``serve_idle`` / ``lost_node``) on a
 decisions.  `control/scaling_policies.py` wraps it as the
 ``serve-demand`` scaling policy so the controller's scaler consumes
 the asks like any other demand source.
+
+A fleet registering PREFILL/DECODE roles (the role-aware fabric,
+serve/fabric.py) scales each role independently: prefill queue depth
+and decode slot idleness drive separate targets and separate asks,
+each decision carrying its ``role`` — a deep prompt backlog with idle
+decode lanes grows the prefill role, never both (the
+"scale prefill and decode independently" runbook in
+docs/operations.md reads these decisions).
 """
 
 from __future__ import annotations
@@ -288,6 +296,17 @@ class AutoscalerConfig:
     # with zero queue and mean slot-idle above `idle_slot_fraction`
     idle_cycles: int = 5
     idle_slot_fraction: float = 0.75
+    # role-aware fabric scaling (prefill/decode roles registered):
+    # sustained burn picks WHICH role to grow from the beat stats.  A
+    # prompt backlog at least `prefill_backlog` deep on prefill-role
+    # replicas while decode slots still have headroom (mean decode
+    # slot-idle above `decode_busy_idle_fraction`) is PREFILL-bound;
+    # otherwise the burn is DECODE-bound (a decode-side backlog, or
+    # decode lanes saturated).  min/max_replicas bound each role
+    # independently — scaling them independently is the point of the
+    # split (docs/operations.md runbook).
+    prefill_backlog: float = 1.0
+    decode_busy_idle_fraction: float = 0.1
 
 
 class ReplicaAutoscaler:
@@ -315,28 +334,59 @@ class ReplicaAutoscaler:
         self._burn_streak = 0
         self._idle_streak = 0
         self._asked_deficit = 0
+        # role-aware fabric state (prefill/decode roles registered):
+        # one target, streak, and outstanding-deficit slot PER ROLE —
+        # the roles scale independently, that is the point of the
+        # split.  Empty until the registry shows a role-split fleet.
+        self.role_targets: Dict[str, int] = {}
+        self._role_burn: Dict[str, int] = {}
+        self._role_idle: Dict[str, int] = {}
+        self._role_asked: Dict[str, int] = {}
 
-    def _decide(self, action: str, reason: str, **attrs) -> Dict[str, Any]:
+    def total_target(self) -> int:
+        """Replicas the fleet should hold in total — the serve-demand
+        scaling policy's demand count (sum of role targets in a
+        role-split fabric, the single target otherwise)."""
+        if self.role_targets:
+            return sum(self.role_targets.values())
+        return self.target
+
+    def _decide(self, action: str, reason: str,
+                role: Optional[str] = None, **attrs) -> Dict[str, Any]:
         """WHY-labeled, journaled, mirrored on a decision span — the
         same triple the cluster scaler's `_decide` emits, so `tik
-        events dump` narrates serve scaling next to node scaling."""
+        events dump` narrates serve scaling next to node scaling.
+        Role-aware decisions carry the role in every surface (span,
+        journal, returned dict) so a controller drill can launch the
+        RIGHT kind of replica."""
+        if role is not None:
+            attrs = dict(attrs, role=role)
+            ti.SERVE_REPLICA_TARGET.set(
+                self.role_targets.get(role, 0), role=role)
+        else:
+            ti.SERVE_REPLICA_TARGET.set(self.target, role=ROLE_ENGINE)
         telemetry.add_span("scaler.decision", time.time(), 0.0,
                            action=action, reason=reason, **attrs)
         events.emit("tik_scaler_decision", action=action,
                     reason=reason, **attrs)
-        ti.SERVE_REPLICA_TARGET.set(self.target)
         if self.ask is not None:
             self.ask(1 if action == "add_replica" else -1, reason)
         return {"action": action, "reason": reason, **attrs}
 
     def evaluate(self, now: Optional[float] = None
                  ) -> Optional[Dict[str, Any]]:
-        """One decision cycle; at most one replica added/removed."""
+        """One decision cycle; at most one replica added/removed.
+        A fleet registering prefill/decode roles takes the role-aware
+        path — separate targets, separate asks; a monolithic fleet
+        keeps the single-target behavior unchanged."""
         cfg = self.config
         now = time.time() if now is None else now
+        if any(info.role in (ROLE_PREFILL, ROLE_DECODE)
+               for info in self.registry.list_replicas()):
+            return self._evaluate_roles(now)
         routable = self.registry.routable(now)
         n = len(routable)
-        ti.SERVE_REPLICA_TARGET.set(self.target)
+        ti.SERVE_REPLICA_TARGET.set(self.target, role=ROLE_ENGINE)
         # 1. replacement: a condemned/dead replica dropped the routable
         # count below target — ask NOW, the why is the loss, not
         # demand.  One journaled ask per additional loss: the deficit
@@ -387,4 +437,122 @@ class ReplicaAutoscaler:
             return self._decide(
                 "remove_replica", "serve_idle", target=self.target,
                 slot_idle_fraction=round(idle, 4))
+        return None
+
+    def _evaluate_roles(self, now: float) -> Optional[Dict[str, Any]]:
+        """Role-aware decision cycle: prefill queue depth and decode
+        slot idleness drive SEPARATE asks (same WHY vocabulary —
+        `lost_node` / `serve_demand` / `serve_idle` — each carrying
+        its role).  Monolithic replicas serving alongside a role-split
+        fleet are fallback capacity, not a scaling surface here."""
+        cfg = self.config
+        by_role: Dict[str, List[ReplicaInfo]] = {}
+        for info in self.registry.routable(now):
+            by_role.setdefault(info.role, []).append(info)
+        # a role grows a target only once a replica has ever
+        # REGISTERED it (routable or not): seeding an absent role from
+        # min_replicas would journal a `lost_node` ask for a replica
+        # that never existed — permanently for a deliberately
+        # single-role fleet, transiently when one role's replicas
+        # simply register before the other's on boot
+        registered_roles = {info.role
+                            for info in self.registry.list_replicas()}
+        for role in (ROLE_PREFILL, ROLE_DECODE):
+            if role not in registered_roles \
+                    and role not in self.role_targets:
+                continue
+            n = len(by_role.get(role, []))
+            self.role_targets.setdefault(role,
+                                         max(n, cfg.min_replicas))
+            ti.SERVE_REPLICA_TARGET.set(self.role_targets[role],
+                                        role=role)
+        # 1. replacement, per role: a condemned/dead replica dropped
+        # a role below its target — ask NOW, one journaled ask per
+        # additional loss (the monolithic deficit discipline, applied
+        # independently to each role)
+        standing_deficit = False
+        for role in (ROLE_PREFILL, ROLE_DECODE):
+            if role not in self.role_targets:
+                continue
+            n = len(by_role.get(role, []))
+            deficit = self.role_targets[role] - n
+            if deficit > 0:
+                standing_deficit = True
+                if deficit > self._role_asked.get(role, 0):
+                    self._role_asked[role] = deficit
+                    return self._decide(
+                        "add_replica", "lost_node", role=role,
+                        routable=n, target=self.role_targets[role])
+            else:
+                self._role_asked[role] = 0
+        if standing_deficit:
+            # a fleet mid-replacement holds: the monolithic path's
+            # `return None` during a deficit, carried over — letting
+            # the idle arm run here would let a quiet window shed the
+            # very target the lost_node ask is replacing toward
+            return None
+        prefill = by_role.get(ROLE_PREFILL, [])
+        decode = by_role.get(ROLE_DECODE, [])
+        prefill_queue = sum(i.queue_depth for i in prefill)
+        prefill_idle = (sum(i.slot_idle_fraction for i in prefill)
+                        / len(prefill)) if prefill else 0.0
+        decode_queue = sum(i.queue_depth for i in decode)
+        decode_idle = (sum(i.slot_idle_fraction for i in decode)
+                       / len(decode)) if decode else 0.0
+        # 2. demand: sustained fast+slow burn, attributed to a role by
+        # the beat stats — a deep PROMPT backlog while decode lanes
+        # still have headroom is prefill-bound; a decode backlog or
+        # saturated decode lanes is decode-bound.  Burn with neither
+        # signal holds (scaling the wrong role helps nobody).
+        burn = self.burn_source() if self.burn_source else None
+        burning = (burn is not None
+                   and burn.get("fast", 0.0) > cfg.burn_threshold
+                   and burn.get("slow", 0.0) > cfg.burn_threshold)
+        bound: Optional[str] = None
+        if burning:
+            if prefill_queue >= cfg.prefill_backlog \
+                    and decode_idle > cfg.decode_busy_idle_fraction:
+                bound = ROLE_PREFILL
+            elif decode_queue > 0 \
+                    or decode_idle <= cfg.decode_busy_idle_fraction:
+                bound = ROLE_DECODE
+            if bound is not None and bound not in self.role_targets:
+                # the attributed role never registered a replica
+                # (single-role fleet): there is no target to grow
+                bound = None
+        for role in (ROLE_PREFILL, ROLE_DECODE):
+            self._role_burn[role] = (self._role_burn.get(role, 0) + 1
+                                     if bound == role else 0)
+        if bound is not None \
+                and self._role_burn[bound] >= cfg.sustain_cycles \
+                and self.role_targets[bound] < cfg.max_replicas:
+            self.role_targets[bound] += 1
+            self._role_burn[bound] = 0
+            return self._decide(
+                "add_replica", "serve_demand", role=bound,
+                target=self.role_targets[bound],
+                queue_depth=(prefill_queue if bound == ROLE_PREFILL
+                             else decode_queue),
+                slot_idle_fraction=round(
+                    prefill_idle if bound == ROLE_PREFILL
+                    else decode_idle, 4),
+                burn_fast=burn.get("fast"), burn_slow=burn.get("slow"))
+        # 3. idle, per role: a sustained empty queue with mostly-idle
+        # lanes sheds one replica of THAT role, never below the floor
+        for role, queue, idle in (
+                (ROLE_PREFILL, prefill_queue, prefill_idle),
+                (ROLE_DECODE, decode_queue, decode_idle)):
+            n = len(by_role.get(role, []))
+            if queue == 0 and n > 0 and idle >= cfg.idle_slot_fraction:
+                self._role_idle[role] = self._role_idle.get(role, 0) + 1
+            else:
+                self._role_idle[role] = 0
+            if self._role_idle[role] >= cfg.idle_cycles \
+                    and self.role_targets[role] > cfg.min_replicas:
+                self.role_targets[role] -= 1
+                self._role_idle[role] = 0
+                return self._decide(
+                    "remove_replica", "serve_idle", role=role,
+                    target=self.role_targets[role],
+                    slot_idle_fraction=round(idle, 4))
         return None

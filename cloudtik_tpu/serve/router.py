@@ -41,6 +41,16 @@ locality, not connection counts:
   spills (``reason="drain"``) without spending availability budget,
   and the replica's in-flight requests finish ``done``, not
   ``drained``.
+* **Role-aware disaggregation (serve/fabric.py).**  Replicas register
+  a role; prefill-role replicas never join the decode ring.  While one
+  is routable, a PROMPT-HEAVY request (prompt length >=
+  ``prefill_len_threshold``) chunk-prefills there and its KV blocks
+  stream over the socket `KVTransport` to the decode replica the
+  adapter-salted affinity hash chose — shared prompts land where
+  their blocks already live, and decode lanes never pay long-prompt
+  prefill interleave.  With no prefill role routable the request
+  degrades to the plain path (``tik_serve_fabric_requests_total
+  {path="direct"}``); greedy output is bit-identical either way.
 
 Transports are pluggable :class:`ReplicaClient`s: :class:`HttpReplica`
 (stdlib HTTP to a tik-serve instance) for the real fabric,
@@ -65,7 +75,8 @@ from cloudtik_tpu import telemetry
 from cloudtik_tpu.faults import seams
 from cloudtik_tpu.faults.plan import FaultInjected
 from cloudtik_tpu.serve import kvcache
-from cloudtik_tpu.serve.replicas import ReplicaAutoscaler, ReplicaRegistry
+from cloudtik_tpu.serve.replicas import (
+    ROLE_PREFILL, ReplicaAutoscaler, ReplicaRegistry)
 from cloudtik_tpu.telemetry import instruments as ti
 from cloudtik_tpu.utils.retry import (
     RetriesExhausted, RetryPolicy, call_with_retry)
@@ -121,11 +132,19 @@ def prefix_chain_key(prompt: Sequence[int], block_size: int,
     salts the chain ROOT exactly as the prefix map salts it: fleets
     serving disjoint adapter sets keep adapter-warm replicas hot
     because identical prompts under different adapters hash apart —
-    just as their KV blocks never share."""
+    just as their KV blocks never share.
+
+    A prompt with NO full block has nothing the prefix map could
+    share, so there is no warm replica to aim for — and pinning every
+    sub-block prompt to the single "root" ring position would melt
+    one replica under short-prompt traffic.  Those prompts key on
+    their raw content instead: deterministic (same prompt, same
+    replica) but spread."""
     keys = kvcache.chain_keys(prompt, block_size, namespace=namespace)
     if keys:
         return keys[-1]
-    return ("root",) if namespace is None else ("root", namespace)
+    salt = () if namespace is None else (namespace,)
+    return ("tail",) + salt + tuple(prompt)
 
 
 def chain_hash(prompt: Sequence[int], block_size: int,
@@ -196,6 +215,58 @@ class ReplicaClient:
         pass
 
 
+def raise_replica_error(replica_id: str,
+                        error: BaseException) -> None:
+    """Translate engine-request errors into the router's failover
+    vocabulary.  ONE mapping shared by :class:`EngineReplica.forward`
+    and the fabric's ``PrefillReplica`` (serve/fabric.py) — the two
+    paths must keep identical failover/availability semantics, so the
+    table lives in exactly one place:
+
+    * ``queue_full`` rejection → :class:`ReplicaDraining` (bounded
+      admission queue overflow is back-pressure, not a client error —
+      respill to the next ring replica, spending no availability
+      budget);
+    * other rejections → :class:`ReplicaRejected` (413 for capacity,
+      400 otherwise — the client's problem, never retried);
+    * cancellation (a kill abandoned it) → connection-shaped
+      :class:`ReplicaUnavailable`;
+    * anything else re-raises as-is."""
+    from cloudtik_tpu.serve.engine import (
+        RequestCancelled, RequestRejected)
+    if isinstance(error, RequestRejected):
+        if error.reason == "queue_full":
+            raise ReplicaDraining(
+                f"replica {replica_id} admission queue "
+                f"full: {error}") from error
+        raise ReplicaRejected(
+            str(error),
+            status=413 if error.reason == "capacity" else 400
+        ) from error
+    if isinstance(error, RequestCancelled):
+        raise ReplicaUnavailable(
+            f"replica {replica_id} died mid-request") from error
+    raise error
+
+
+def _failed_replica(error: BaseException, prid: Optional[str],
+                    rid: str) -> str:
+    """Which replica a failed attempt excludes from the retry.
+
+    On the fabric path the default blame is the PREFILL replica (the
+    retry either reaches another prefill replica or degrades to the
+    plain path — a sick decode replica is the probe loop's to
+    condemn), but an error that NAMES its origin (``replica_id``
+    stamped by the fabric's decode side, e.g. a decode replica dying
+    with the migration in flight) excludes THAT replica instead:
+    blaming prefill would burn every retry re-targeting the same dead
+    decode replica while healthy decode capacity sat on the ring."""
+    failed = getattr(error, "replica_id", None)
+    if failed:
+        return failed
+    return prid if prid is not None else rid
+
+
 class EngineReplica(ReplicaClient):
     """In-process replica over a live `DecodeEngine` (benches, drills).
 
@@ -244,23 +315,8 @@ class EngineReplica(ReplicaClient):
                 self.engine.submit(req)
             try:
                 tokens = req.wait(timeout=timeout_s)
-            except RequestRejected as e:
-                if e.reason == "queue_full":
-                    # bounded admission queue overflow (HTTP 429 +
-                    # Retry-After on the wire): back-pressure, not a
-                    # client error — respill to the next ring replica
-                    # exactly like a drain refusal, spending no
-                    # availability budget
-                    raise ReplicaDraining(
-                        f"replica {self.replica_id} admission queue "
-                        f"full: {e}") from e
-                raise ReplicaRejected(
-                    str(e), status=413 if e.reason == "capacity"
-                    else 400) from e
-            except RequestCancelled as e:
-                # kill() abandoned it — connection-shaped to the router
-                raise ReplicaUnavailable(
-                    f"replica {self.replica_id} died mid-request") from e
+            except (RequestRejected, RequestCancelled) as e:
+                raise_replica_error(self.replica_id, e)
             except TimeoutError:
                 # per-request deadline: abandon our attempt so the
                 # replica-side slot frees; the retry runs elsewhere
@@ -367,6 +423,13 @@ class RouterConfig:
     probe_failures: int = 3           # consecutive fails -> condemn
     request_deadline_s: float = 120.0  # per-attempt forward deadline
     policy: str = "affinity"          # or "round_robin" (baseline)
+    # role-aware fabric (serve/fabric.py): a request whose prompt is at
+    # least this many tokens is PROMPT-HEAVY — while a prefill-role
+    # replica is routable it chunk-prefills there and its KV blocks
+    # stream to the affinity-chosen decode replica over the socket
+    # transport.  Shorter prompts (and every request when no prefill
+    # role is routable) forward directly to a decode-capable replica.
+    prefill_len_threshold: int = 32
     retry: RetryPolicy = RetryPolicy(
         max_attempts=4, base_delay_s=0.05, multiplier=2.0,
         max_delay_s=1.0, jitter=0.1)
@@ -394,6 +457,15 @@ class Router:
         self._clients: Dict[str, ReplicaClient] = {}
         self._ring = HashRing([], self.config.vnodes)
         self._routable: List[str] = []
+        # role-aware fabric state: prefill-role replicas never join the
+        # decode-capable ring (their engines have no decode lanes) —
+        # they form their own routable list, picked least-loaded for
+        # prompt-heavy traffic.  `_has_prefill_role` is true while ANY
+        # replica (routable or not) registered the prefill role, so
+        # the direct-fallback metric only counts in fabrics that have
+        # the role at all.
+        self._prefill: List[str] = []
+        self._has_prefill_role = False
         self._inflight: Dict[str, int] = {}
         self._probe_fails: Dict[str, int] = {}
         self._rr = 0
@@ -420,13 +492,23 @@ class Router:
                 if rid not in self._clients:
                     self._clients[rid] = self._client_factory(info)
                     self._inflight.setdefault(rid, 0)
-            routable = sorted(infos)
+            # the ring holds DECODE-CAPABLE replicas only: monolithic
+            # engines and decode-role replicas take direct forwards;
+            # prefill-role replicas are a separate pick (role-aware
+            # prompt-heavy path) because their engines never decode
+            routable = sorted(rid for rid, info in infos.items()
+                              if info.role != ROLE_PREFILL)
+            self._prefill = sorted(rid for rid, info in infos.items()
+                                   if info.role == ROLE_PREFILL)
             if routable != self._routable:
                 self._routable = routable
                 self._ring = HashRing(routable, self.config.vnodes)
+        all_replicas = self.registry.list_replicas()
+        self._has_prefill_role = any(
+            info.role == ROLE_PREFILL for info in all_replicas)
         if telemetry.enabled():
             states = {"routable": 0, "draining": 0, "condemned": 0}
-            for info in self.registry.list_replicas():
+            for info in all_replicas:
                 if info.condemned is not None:
                     states["condemned"] += 1
                 elif info.draining:
@@ -536,6 +618,30 @@ class Router:
         ti.SERVE_ROUTER_SPILLS.inc(reason="load")
         return clients[rid], rid == primary_rid
 
+    def _pick_prefill(self, excluded: set,
+                      decode_client: ReplicaClient
+                      ) -> Optional[ReplicaClient]:
+        """Least-loaded routable prefill-role replica for a
+        prompt-heavy request, or None (then the request takes the
+        plain decode/monolithic path — the fabric degrades to
+        role-blind, it never refuses).  The handoff needs both ends to
+        speak the fabric surface: a prefill client without
+        ``forward_to`` (e.g. a plain HTTP transport) or a decode
+        target without a migration receiver (no ``expect``) routes
+        direct."""
+        if not hasattr(decode_client, "expect"):
+            return None
+        with self._lock:
+            candidates = [r for r in self._prefill if r not in excluded]
+            clients = dict(self._clients)
+            inflight = dict(self._inflight)
+        candidates = [r for r in candidates
+                      if hasattr(clients.get(r), "forward_to")]
+        if not candidates:
+            return None
+        return clients[min(candidates,
+                           key=lambda r: inflight.get(r, 0))]
+
     def handle(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         """Route one request to completion (synchronous; HTTP handler
         threads and bench workers call this).  Raises the ORIGINAL
@@ -551,30 +657,68 @@ class Router:
         last_error: List[Optional[BaseException]] = [None]
         traceparent = telemetry.current_traceparent()
 
+        prompt_heavy = (len(prompt)
+                        >= self.config.prefill_len_threshold)
+
         def attempt() -> Dict[str, Any]:
             client, primary = self._pick(key_hash, excluded)
             rid = client.replica_id
+            pclient = None
+            if prompt_heavy:
+                pclient = self._pick_prefill(excluded, client)
+            prid = pclient.replica_id if pclient is not None else None
+            # a fabric hop charges both ends: the decode replica does
+            # the lasting work (its count drives the bounded-load
+            # walk), the prefill count drives the least-loaded
+            # prefill pick
             if primary and self.config.policy == "affinity":
                 ti.SERVE_ROUTER_AFFINITY_HITS.inc()
             with self._lock:
                 self._inflight[rid] = self._inflight.get(rid, 0) + 1
+                if prid is not None:
+                    self._inflight[prid] = \
+                        self._inflight.get(prid, 0) + 1
                 ti.SERVE_ROUTER_INFLIGHT.set(
                     sum(self._inflight.values()))
             try:
+                if pclient is not None:
+                    # failures on this path exclude the PREFILL
+                    # replica: the retry either reaches another
+                    # prefill replica or degrades to the plain path —
+                    # a sick decode replica is the probe loop's to
+                    # condemn
+                    with telemetry.span("serve.router.forward",
+                                        replica=prid, primary=primary,
+                                        decode_replica=rid):
+                        fire_forward_seam(prid,
+                                          payload.get("request_id"))
+                        return pclient.forward_to(
+                            payload, client,
+                            self.config.request_deadline_s,
+                            traceparent=traceparent)
                 with telemetry.span("serve.router.forward",
                                     replica=rid, primary=primary):
                     fire_forward_seam(rid, payload.get("request_id"))
-                    return client.forward(
+                    out = client.forward(
                         payload, self.config.request_deadline_s,
                         traceparent=traceparent)
+                if prompt_heavy and self._has_prefill_role:
+                    # the fabric HAS the role but could not use it for
+                    # this request (killed/draining/already-failed
+                    # prefill, or a decode target without a receiver).
+                    # Counted at COMPLETION like migrated/fallback so
+                    # the three paths sum to completed prompt-heavy
+                    # requests — a retried attempt must not book twice
+                    ti.SERVE_FABRIC_REQUESTS.inc(path="direct")
+                return out
             except ReplicaDraining as e:
-                excluded.add(rid)
+                excluded.add(_failed_replica(e, prid, rid))
                 last_error[0] = e
                 ti.SERVE_ROUTER_SPILLS.inc(reason="drain")
                 raise
             except (ReplicaUnavailable, ConnectionError, TimeoutError,
                     OSError, FaultInjected) as e:
-                excluded.add(rid)
+                excluded.add(_failed_replica(e, prid, rid))
                 last_error[0] = e
                 ti.SERVE_ROUTER_FAILOVERS.inc()
                 raise
@@ -582,6 +726,9 @@ class Router:
                 with self._lock:
                     self._inflight[rid] = max(
                         0, self._inflight.get(rid, 0) - 1)
+                    if prid is not None:
+                        self._inflight[prid] = max(
+                            0, self._inflight.get(prid, 0) - 1)
                     ti.SERVE_ROUTER_INFLIGHT.set(
                         sum(self._inflight.values()))
 
@@ -686,6 +833,10 @@ class Router:
                                "replicas": replicas}
         if self.autoscaler is not None:
             out["target_replicas"] = self.autoscaler.target
+            role_targets = getattr(self.autoscaler, "role_targets",
+                                   None)
+            if role_targets:
+                out["target_replicas_by_role"] = dict(role_targets)
         return out
 
 

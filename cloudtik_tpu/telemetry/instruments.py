@@ -96,6 +96,12 @@ SERVE_ROUTER_PROBE_FAILURES: Counter = _build(
     "tik_serve_router_probe_failures_total")
 SERVE_REPLICA_TARGET: Gauge = _build("tik_serve_replica_target")
 
+# role-aware serving fabric (serve/fabric.py + the router's role path)
+SERVE_FABRIC_REQUESTS: Counter = _build(
+    "tik_serve_fabric_requests_total")
+SERVE_FABRIC_HANDOFF_SECONDS: Histogram = _build(
+    "tik_serve_fabric_handoff_seconds")
+
 # serve multi-tenant LoRA (serve/adapters.py pool + tenant SLO substrate)
 SERVE_TENANT_REQUESTS: Counter = _build("tik_serve_tenant_requests_total")
 SERVE_TENANT_TTFT: Histogram = _build("tik_serve_tenant_ttft_seconds")

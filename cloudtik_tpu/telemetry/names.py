@@ -192,8 +192,21 @@ _ALL = [
        "Health probes that failed (consecutive failures condemn the "
        "replica).", "serve"),
     _m("tik_serve_replica_target", "gauge",
-       "Replica count the serve_demand autoscaler currently wants.",
-       "serve"),
+       "Replica count the serve_demand autoscaler currently wants, by "
+       "role (engine = monolithic fleet; a role-split fabric carries "
+       "separate prefill/decode targets).", "serve", ("role",)),
+    # -- role-aware serving fabric (serve/fabric.py) ----------------------
+    _m("tik_serve_fabric_requests_total", "counter",
+       "Prompt-heavy requests through the role-aware fabric, by path "
+       "(migrated = prefill-role -> socket KV migration -> decode-role; "
+       "fallback = transfer torn, re-prefilled plain on the decode "
+       "replica; direct = degraded to the role-blind path because no "
+       "prefill-role replica was usable).", "serve", ("path",)),
+    _m("tik_serve_fabric_handoff_seconds", "histogram",
+       "Wall time of one cross-replica KV handoff: socket connect + "
+       "header/blocks/commit stream to the decode replica's migration "
+       "receiver (the DCN cost of disaggregation).", "serve", (),
+       LATENCY_BUCKETS),
     # -- serve multi-tenant LoRA (serve/adapters.py + tenant SLOs) --------
     _m("tik_serve_tenant_requests_total", "counter",
        "Serve requests finished, by tenant and result — the per-tenant "

@@ -1,0 +1,885 @@
+"""Role-aware serving fabric (serve/fabric.py + the router's role
+path + the autoscaler's per-role targets).
+
+The hard property: greedy output through the role-aware fabric
+(prefill-role -> socket KV migration -> decode-role) is BIT-IDENTICAL
+to a monolithic engine, including prefix-reused and adapter-bearing
+prompts.  Around it: the router's role policy (prompt-heavy requests
+take the fabric hop, everything degrades cleanly to the role-blind
+path), the torn-migration fallback (re-prefill on the decode role,
+never double-routed, never lost, both pools drained), the
+kill-the-prefill-replica chaos drill (availability 1.0, one stitched
+trace), and the serve_demand autoscaler scaling prefill and decode
+roles independently — including the live controller drill: burn +
+decode backlog -> role=decode ask -> registry admits -> router
+spills.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import pytest
+
+from cloudtik_tpu.control.state import InMemoryStateBackend, StateClient
+from cloudtik_tpu.faults import seams
+from cloudtik_tpu.faults.plan import FaultPlan, FaultPoint
+from cloudtik_tpu.serve import fabric
+from cloudtik_tpu.serve.replicas import (
+    ROLE_DECODE, ROLE_PREFILL, AutoscalerConfig, ReplicaAutoscaler,
+    ReplicaRegistry)
+from cloudtik_tpu.serve.router import (
+    ReplicaClient, ReplicaDraining, ReplicaUnavailable, Router,
+    RouterConfig)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    seams.disarm()
+    yield
+    seams.disarm()
+
+
+def make_registry(**kw) -> ReplicaRegistry:
+    return ReplicaRegistry(StateClient(InMemoryStateBackend()), **kw)
+
+
+# ---------------------------------------------------------- real fleet --
+
+@pytest.fixture(scope="module")
+def model():
+    import jax
+
+    from cloudtik_tpu.models import transformer as T
+    cfg = T.config("tiny", dtype=jax.numpy.float32,
+                   attention_impl="reference", remat=False)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def make_prefill(model, replica_id="p0", slots=2, blocks=25,
+                 frame_delay_s=0.0):
+    """Prefill-role replica: big-bucket one-shot chunking + a routing
+    FabricMigrator (fresh socket per export)."""
+    from cloudtik_tpu.serve.engine import DecodeEngine, EngineConfig
+    cfg, params = model
+    engine = DecodeEngine(
+        params, cfg,
+        EngineConfig(slots=slots, max_len=64,
+                     prefill_buckets=(8, 16, 32, 64), chunk_size=64,
+                     block_size=8, num_blocks=blocks),
+        migrator=fabric.FabricMigrator(frame_delay_s=frame_delay_s))
+    engine.start()
+    return fabric.PrefillReplica(replica_id, engine)
+
+
+def make_decode(model, replica_id="d0", slots=3, blocks=49,
+                adapters=None):
+    """Decode-role replica: engine + socket migration receiver."""
+    from cloudtik_tpu.serve.engine import DecodeEngine, EngineConfig
+    cfg, params = model
+    engine = DecodeEngine(
+        params, cfg,
+        EngineConfig(slots=slots, max_len=64, prefill_buckets=(8, 16),
+                     block_size=8, num_blocks=blocks),
+        role="decode", adapters=adapters)
+    engine.start()
+    return fabric.DecodeReplica(replica_id, engine)
+
+
+def make_fabric_router(prefills, decodes, registry=None,
+                       autoscaler=None, **config_kw):
+    config_kw.setdefault("block_size", 8)
+    config_kw.setdefault("prefill_len_threshold", 16)
+    config_kw.setdefault("request_deadline_s", 120)
+    router = Router(registry or make_registry(),
+                    RouterConfig(**config_kw), autoscaler=autoscaler)
+    for replica in prefills:
+        router.add_client(replica, role="prefill", slots=2)
+    for replica in decodes:
+        router.add_client(replica, role="decode", slots=3)
+    return router
+
+
+def reference(model, prompt, max_new):
+    import jax
+    import numpy as np
+
+    from cloudtik_tpu.models import generate as G
+    cfg, params = model
+    out = G.generate(params, jax.numpy.asarray([prompt], np.int32),
+                     cfg, max_new_tokens=max_new)
+    return np.asarray(out)[0].tolist()
+
+
+@pytest.fixture(scope="module")
+def fleet(model):
+    """Shared 1-prefill + 2-decode fabric for the identity tests
+    (counter assertions use before/after deltas)."""
+    prefill = make_prefill(model)
+    decodes = [make_decode(model, f"d{i}") for i in range(2)]
+    router = make_fabric_router([prefill], decodes)
+    yield router, prefill, decodes
+    prefill.stop()
+    for replica in decodes:
+        replica.stop()
+
+
+def _paths():
+    from cloudtik_tpu.telemetry import instruments as ti
+    return {p: ti.SERVE_FABRIC_REQUESTS.value(path=p)
+            for p in ("migrated", "fallback", "direct")}
+
+
+# ------------------------------------------------------- bit identity --
+
+class TestFabricBitIdentity:
+    def test_prompt_heavy_migrates_bit_identical(self, fleet, model):
+        router, _prefill, _decodes = fleet
+        before = _paths()
+        prompt = list(range(3, 30))            # 27 tokens: heavy
+        out = router.handle({"tokens": prompt, "max_new_tokens": 8})
+        assert out["tokens"][0] == reference(model, prompt, 8)[-8:]
+        after = _paths()
+        assert after["migrated"] == before["migrated"] + 1
+        assert after["fallback"] == before["fallback"]
+        assert after["direct"] == before["direct"]
+
+    def test_short_prompt_forwards_direct(self, fleet, model):
+        router, _prefill, _decodes = fleet
+        before = _paths()
+        prompt = [5, 6, 7, 8, 9]               # below the threshold
+        out = router.handle({"tokens": prompt, "max_new_tokens": 6})
+        assert out["tokens"][0] == reference(model, prompt, 6)[-6:]
+        # not prompt-heavy: no fabric path is charged at all
+        assert _paths() == before
+
+    def test_prefix_reused_prompts_bit_identical(self, fleet, model):
+        """Two prompts sharing a block-aligned 16-token prefix migrate
+        to the SAME decode replica (chain-key affinity) and both come
+        back bit-identical — the second import lands where its prefix
+        blocks already live."""
+        from cloudtik_tpu.telemetry import instruments as ti
+        router, _prefill, _decodes = fleet
+        base = list(range(40, 56))             # two full blocks
+        a = base + [100, 101, 102, 103, 104]
+        b = base + [110, 111, 112, 113, 114]
+        hits0 = ti.SERVE_PREFIX_HITS.value()
+        out_a = router.handle({"tokens": a, "max_new_tokens": 8})
+        out_b = router.handle({"tokens": b, "max_new_tokens": 8})
+        assert out_a["tokens"][0] == reference(model, a, 8)[-8:]
+        assert out_b["tokens"][0] == reference(model, b, 8)[-8:]
+        # the shared prefix was a cache hit somewhere along the fabric
+        # (prefill-side chunk skip and/or decode-side import reuse)
+        assert ti.SERVE_PREFIX_HITS.value() > hits0
+
+    def test_concurrent_mixed_traffic_bit_identical(self, fleet,
+                                                    model):
+        from cloudtik_tpu.serve.engine import Request
+        router, _prefill, _decodes = fleet
+        prompts = []
+        for i in range(8):
+            if i % 2 == 0:
+                prompts.append([i * 7 + j for j in range(20)])
+            else:
+                prompts.append([i * 5 + j for j in range(5)])
+        requests = [Request(list(p), max_new_tokens=6)
+                    for p in prompts]
+        for req in requests:
+            router.submit(req)
+        outs = [req.wait(timeout=120) for req in requests]
+        for prompt, out in zip(prompts, outs):
+            assert out == reference(model, prompt, 6)[-6:]
+
+    def test_adapter_bearing_prompt_matches_merged_reference(
+            self, model):
+        """An adapter-bearing prompt through the fabric equals a
+        dedicated merged-weights engine: the adapter identity crosses
+        with the KV state and the decode role re-acquires the delta."""
+        import jax
+
+        from cloudtik_tpu.models import lora as LO
+        from cloudtik_tpu.serve.adapters import AdapterPool
+        from cloudtik_tpu.serve.engine import DecodeEngine, EngineConfig
+        cfg, params = model
+        lora_cfg = LO.LoRAConfig(rank=4)
+        bank = {"tA": LO.random_lora_params(jax.random.PRNGKey(11),
+                                            cfg, lora_cfg)}
+
+        def pool():
+            return AdapterPool(params, cfg, lora_cfg,
+                               loader=lambda aid: bank[aid],
+                               capacity=2)
+
+        prefill = make_prefill_with_adapters(model, pool())
+        decode = make_decode(model, adapters=pool())
+        router = make_fabric_router([prefill], [decode])
+        merged = dict(params)
+        merged["layers"] = LO.merge_lora(params["layers"], bank["tA"],
+                                         lora_cfg)
+        ref_engine = DecodeEngine(merged, cfg, EngineConfig(
+            slots=1, max_len=64, prefill_buckets=(8, 16),
+            block_size=8))
+        ref_engine.start()
+        try:
+            prompt = list(range(7, 31))        # 24 tokens: heavy
+            before = _paths()
+            out = router.handle({"tokens": prompt, "max_new_tokens": 8,
+                                 "adapter": "tA"})
+            ref = ref_engine.generate(prompt, max_new_tokens=8)
+            assert out["tokens"][0] == ref
+            assert _paths()["migrated"] == before["migrated"] + 1
+        finally:
+            ref_engine.stop()
+            prefill.stop()
+            decode.stop()
+
+
+def make_prefill_with_adapters(model, pool, replica_id="pA"):
+    from cloudtik_tpu.serve.engine import DecodeEngine, EngineConfig
+    cfg, params = model
+    engine = DecodeEngine(
+        params, cfg,
+        EngineConfig(slots=2, max_len=64,
+                     prefill_buckets=(8, 16, 32, 64), chunk_size=64,
+                     block_size=8, num_blocks=25),
+        migrator=fabric.FabricMigrator(), adapters=pool)
+    engine.start()
+    return fabric.PrefillReplica(replica_id, engine)
+
+
+# -------------------------------------------------- role policy (fakes) --
+
+class FakeReplica(ReplicaClient):
+    def __init__(self, replica_id: str,
+                 fail_with: Optional[BaseException] = None):
+        self.replica_id = replica_id
+        self.fail_with = fail_with
+        self.forwards: List[Dict] = []
+        self.healthy = True
+
+    def forward(self, payload, timeout_s, traceparent=None):
+        self.forwards.append(dict(payload))
+        if self.fail_with is not None:
+            raise self.fail_with
+        return {"tokens": [[7, 8, 9]], "request_id": 1}
+
+    def health(self, timeout_s=2.0):
+        return self.healthy
+
+
+class FakeDecode(FakeReplica):
+    """Decode-capable fake that speaks the fabric ticket surface."""
+
+    def expect(self, origin_id):
+        raise AssertionError("the router never calls expect directly")
+
+    def forget(self, origin_id):
+        pass
+
+
+class FakePrefill(FakeReplica):
+    def __init__(self, replica_id: str,
+                 fail_with: Optional[BaseException] = None):
+        super().__init__(replica_id, fail_with)
+        self.handoffs: List[Dict] = []
+
+    def forward_to(self, payload, decode_replica, timeout_s,
+                   traceparent=None):
+        self.handoffs.append({"payload": dict(payload),
+                              "decode": decode_replica.replica_id})
+        if self.fail_with is not None:
+            raise self.fail_with
+        return {"tokens": [[1, 2, 3]], "request_id": 2}
+
+
+HEAVY = {"tokens": list(range(1, 33)), "max_new_tokens": 4}
+SHORT = {"tokens": [1, 2, 3, 4], "max_new_tokens": 4}
+
+
+class TestRolePolicy:
+    def _router(self, prefills, decodes, **kw):
+        kw.setdefault("prefill_len_threshold", 16)
+        kw.setdefault("block_size", 8)
+        router = Router(make_registry(), RouterConfig(**kw))
+        for replica in prefills:
+            router.add_client(replica, role="prefill", slots=2)
+        for replica in decodes:
+            router.add_client(replica, role="decode", slots=4)
+        return router
+
+    def test_prompt_heavy_takes_the_fabric_hop(self):
+        prefill, decode = FakePrefill("p0"), FakeDecode("d0")
+        router = self._router([prefill], [decode])
+        out = router.handle(dict(HEAVY))
+        assert out["tokens"] == [[1, 2, 3]]
+        assert len(prefill.handoffs) == 1
+        assert prefill.handoffs[0]["decode"] == "d0"
+        assert decode.forwards == []       # decode got the KV, not a
+        #                                    second routed request
+
+    def test_short_prompt_forwards_direct(self):
+        prefill, decode = FakePrefill("p0"), FakeDecode("d0")
+        router = self._router([prefill], [decode])
+        out = router.handle(dict(SHORT))
+        assert out["tokens"] == [[7, 8, 9]]
+        assert prefill.handoffs == []
+        assert len(decode.forwards) == 1
+
+    def test_prefill_role_never_joins_the_decode_ring(self):
+        prefill, decode = FakePrefill("p0"), FakeDecode("d0")
+        router = self._router([prefill], [decode])
+        for i in range(6):
+            router.handle(dict(SHORT, tokens=[i + 1, 2, 3, 4]))
+        assert prefill.forwards == []      # no direct traffic, ever
+        assert len(decode.forwards) == 6
+
+    def test_no_prefill_role_is_plain_routing_without_counter(self):
+        from cloudtik_tpu.telemetry import instruments as ti
+        decode = FakeDecode("d0")
+        router = self._router([], [decode])
+        direct0 = ti.SERVE_FABRIC_REQUESTS.value(path="direct")
+        router.handle(dict(HEAVY))
+        assert len(decode.forwards) == 1
+        # no prefill role registered: the degrade metric stays silent
+        assert ti.SERVE_FABRIC_REQUESTS.value(path="direct") == direct0
+
+    def test_prefill_failure_degrades_direct_and_counts(self):
+        from cloudtik_tpu.telemetry import instruments as ti
+        prefill = FakePrefill(
+            "p0", fail_with=ReplicaUnavailable("prefill died"))
+        decode = FakeDecode("d0")
+        router = self._router([prefill], [decode])
+        direct0 = ti.SERVE_FABRIC_REQUESTS.value(path="direct")
+        out = router.handle(dict(HEAVY))
+        assert out["tokens"] == [[7, 8, 9]]
+        # attempt 1 failed on the prefill replica; the retry excluded
+        # IT (not the decode replica) and went direct
+        assert len(prefill.handoffs) == 1
+        assert len(decode.forwards) == 1
+        assert ti.SERVE_FABRIC_REQUESTS.value(
+            path="direct") == direct0 + 1
+
+    def test_draining_prefill_spills_direct(self):
+        prefill = FakePrefill("p0",
+                              fail_with=ReplicaDraining("draining"))
+        decode = FakeDecode("d0")
+        router = self._router([prefill], [decode])
+        out = router.handle(dict(HEAVY))
+        assert out["tokens"] == [[7, 8, 9]]
+        assert len(decode.forwards) == 1
+
+    def test_decode_without_receiver_routes_direct(self):
+        # a decode target that cannot speak the migration surface
+        # (no `expect`) never gets a fabric handoff aimed at it
+        prefill = FakePrefill("p0")
+        plain = FakeReplica("d0")
+        router = self._router([prefill], [plain])
+        out = router.handle(dict(HEAVY))
+        assert out["tokens"] == [[7, 8, 9]]
+        assert prefill.handoffs == []
+        assert len(plain.forwards) == 1
+
+
+# ------------------------------------------------------ torn migration --
+
+class TestTornMigration:
+    def test_torn_stream_falls_back_bit_identical(self, model,
+                                                  tmp_path):
+        """Fault at `serve.kvcache.migrate` mid-stream: the decode
+        role re-prefills the request as a plain submit, the router
+        never double-routes, the ledger finishes `done`, and both
+        pools end used()==0."""
+        from cloudtik_tpu.serve import reqlog
+        from cloudtik_tpu.telemetry import instruments as ti
+
+        prefill = make_prefill(model)
+        decode = make_decode(model)
+        router = make_fabric_router([prefill], [decode])
+        reqlog.install(str(tmp_path / "req.jsonl"))
+        prompt = list(range(5, 29))            # 24 tokens: heavy
+        before = _paths()
+        failures0 = ti.SERVE_KV_MIGRATION_FAILURES.value()
+        failovers0 = ti.SERVE_ROUTER_FAILOVERS.value()
+        # at_call=2: the first block frame crosses, the second tears
+        plan = FaultPlan([FaultPoint("serve.kvcache.migrate", "raise",
+                                     at_call=2, times=1)])
+        try:
+            with seams.armed(plan):
+                out = router.handle({"tokens": prompt,
+                                     "max_new_tokens": 8})
+            assert plan.points[0].fired == 1
+            assert out["tokens"][0] == reference(model, prompt, 8)[-8:]
+            after = _paths()
+            assert after["fallback"] == before["fallback"] + 1
+            assert after["migrated"] == before["migrated"]
+            assert ti.SERVE_KV_MIGRATION_FAILURES.value() == \
+                failures0 + 1
+            # the tear was absorbed BELOW the router: no failover, no
+            # second route
+            assert ti.SERVE_ROUTER_FAILOVERS.value() == failovers0
+            deadline = time.time() + 10
+            while time.time() < deadline and (
+                    prefill.engine.pool.used()
+                    or decode.engine.pool.used()):
+                time.sleep(0.02)
+            assert prefill.engine.pool.used() == 0
+            assert decode.engine.pool.used() == 0
+        finally:
+            reqlog.uninstall()
+            prefill.stop()
+            decode.stop()
+        records = reqlog.read_requests(str(tmp_path / "req.jsonl"))
+        done = [r for r in records if r["finish"] == "done"]
+        assert len(done) == 1              # served once, not twice
+        assert {r["finish"] for r in records} == {"done"}
+        assert reqlog.compute_stats(records)["availability"] == 1.0
+
+
+# ------------------------------------------- chaos: prefill-role kill --
+
+class TestPrefillKillDrill:
+    def test_kill_prefill_mid_migration_availability_one(
+            self, model, tmp_path):
+        """The acceptance drill: kill the prefill-role replica with
+        migrations in flight.  Every request completes via the
+        decode-role fallback path, ledger availability is 1.0, the
+        autoscaler journals a role=prefill lost_node ask, and the
+        drill is ONE stitched trace."""
+        from cloudtik_tpu import telemetry
+        from cloudtik_tpu.serve import reqlog
+        from cloudtik_tpu.serve.engine import Request
+        from cloudtik_tpu.telemetry import events
+
+        # a fat DCN frame holds each migration open long enough for
+        # the kill to land mid-stream
+        prefill = make_prefill(model, frame_delay_s=0.02)
+        decode = make_decode(model, slots=3)
+        registry = make_registry()
+        asks = []
+        autoscaler = ReplicaAutoscaler(
+            registry, ask=lambda d, r: asks.append((d, r)),
+            config=AutoscalerConfig(min_replicas=1))
+        router = make_fabric_router(
+            [prefill], [decode], registry=registry,
+            autoscaler=autoscaler, probe_failures=2)
+        drill_tp = "00-" + "f" * 32 + "-" + "2" * 16 + "-01"
+        events.install(str(tmp_path / "events.jsonl"))
+        reqlog.install(str(tmp_path / "req.jsonl"))
+        prompts = [[i * 9 + j for j in range(20)] for i in range(6)]
+        try:
+            with telemetry.trace_context(drill_tp):
+                requests = [Request(list(p), max_new_tokens=6)
+                            for p in prompts]
+                for req in requests:
+                    router.submit(req)
+                time.sleep(0.05)           # migrations in flight
+                prefill.kill()
+                outs = [req.wait(timeout=120) for req in requests]
+            for req, prompt, out in zip(requests, prompts, outs):
+                assert req.error is None
+                assert out == reference(model, prompt, 6)[-6:]
+            # the registry learns, the autoscaler asks for the role —
+            # still inside the drill's trace, as the router's probe
+            # thread would be (Router(traceparent=...))
+            with telemetry.trace_context(drill_tp):
+                router.probe_cycle()
+                router.probe_cycle()
+            info = next(i for i in registry.list_replicas()
+                        if i.replica_id == "p0")
+            assert info.condemned == "probe_failed"
+            assert (1, "lost_node") in asks
+        finally:
+            router.stop()
+            reqlog.uninstall()
+            events.uninstall()
+            prefill.stop()
+            decode.stop()
+        records = reqlog.read_requests(str(tmp_path / "req.jsonl"))
+        stats = reqlog.compute_stats(records)
+        finishes = {r["finish"] for r in records}
+        assert "error" not in finishes and "drained" not in finishes
+        assert stats["availability"] == 1.0
+        done = [r for r in records if r["finish"] == "done"]
+        assert len(done) >= len(prompts)
+        # one stitched trace: every served request carries the drill's
+        # trace id, and so does the role-labeled replacement ask
+        drill_trace = "f" * 32
+        assert all(drill_trace in (r.get("traceparent") or "")
+                   for r in done)
+        journal, _ = events.read_file(str(tmp_path / "events.jsonl"))
+        decisions = [r for r in journal
+                     if r.get("name") == "tik_scaler_decision"
+                     and r.get("reason") == "lost_node"]
+        assert decisions and decisions[0]["action"] == "add_replica"
+        assert decisions[0].get("role") == ROLE_PREFILL
+        assert drill_trace in (decisions[0].get("traceparent") or "")
+
+
+class TestDecodeKillExclusion:
+    def test_dead_decode_target_excludes_decode_not_prefill(
+            self, model):
+        """A handoff whose DECODE end is dead fails with the decode
+        replica NAMED (`replica_id` stamped on the error): the retry
+        excludes THAT replica — the healthy prefill replica carries
+        the retry to a surviving decode and the request still
+        MIGRATES — instead of blaming the prefill replica and burning
+        every attempt re-targeting the same dead decode."""
+        from cloudtik_tpu.serve.router import chain_hash
+        from cloudtik_tpu.telemetry import instruments as ti
+
+        prefill = make_prefill(model)
+        decodes = [make_decode(model, f"d{i}") for i in range(2)]
+        router = make_fabric_router([prefill], decodes)
+        try:
+            # find a heavy prompt whose affinity hash lands on d0
+            victim = decodes[0]
+            prompt = None
+            for s in range(64):
+                cand = [(s * 31 + j) % 240 + 1 for j in range(20)]
+                client, _ = router._pick(chain_hash(cand, 8), set())
+                if client.replica_id == victim.replica_id:
+                    prompt = cand
+                    break
+            assert prompt is not None
+            victim.kill()
+            before = _paths()
+            failovers0 = ti.SERVE_ROUTER_FAILOVERS.value()
+            out = router.handle({"tokens": prompt,
+                                 "max_new_tokens": 6})
+            assert out["tokens"][0] == reference(model, prompt, 6)[-6:]
+            after = _paths()
+            # the retry reused the healthy prefill replica against the
+            # surviving decode: the request migrated, it did not
+            # degrade to the plain path
+            assert after["migrated"] == before["migrated"] + 1
+            assert after["direct"] == before["direct"]
+            assert ti.SERVE_ROUTER_FAILOVERS.value() == failovers0 + 1
+        finally:
+            prefill.stop()
+            for replica in decodes:
+                replica.stop()
+
+
+# ------------------------------------------------- replica surfaces --
+
+class TestReplicaSurfaces:
+    def test_prefill_replica_requires_fabric_migrator(self, model):
+        from cloudtik_tpu.serve.engine import DecodeEngine, EngineConfig
+        cfg, params = model
+        engine = DecodeEngine(params, cfg, EngineConfig(
+            slots=1, max_len=64, prefill_buckets=(8, 16),
+            block_size=8))
+        with pytest.raises(ValueError, match="FabricMigrator"):
+            fabric.PrefillReplica("pX", engine)
+
+    def test_prefill_replica_refuses_direct_forwards(self, fleet):
+        _router, prefill, _decodes = fleet
+        with pytest.raises(ReplicaDraining, match="prefill-role"):
+            prefill.forward(dict(SHORT), timeout_s=5)
+
+    def test_decode_replica_kill_fails_waiting_tickets(self, model):
+        decode = make_decode(model, "dk")
+        try:
+            ticket = decode.expect(12345)
+            decode.kill()
+            assert ticket.event.wait(timeout=5)
+            assert isinstance(ticket.error, ReplicaUnavailable)
+        finally:
+            decode.stop()
+
+    def test_unstamped_request_is_refused_by_fabric_migrator(self):
+        from cloudtik_tpu.serve import migration
+
+        class Req:
+            request_id = 7
+        with pytest.raises(migration.MigrationError,
+                           match="no decode handoff"):
+            fabric.FabricMigrator(async_send=False).export(
+                Req(), first_token=0, length=0, k=None, v=None,
+                block_size=8)
+
+
+# ------------------------------------------------ role-aware scaling --
+
+class TestRoleAutoscaler:
+    def _fleet(self, registry, prefill_n=1, decode_n=2,
+               prefill_stats=None, decode_stats=None):
+        for i in range(prefill_n):
+            registry.register(f"p{i}", None, role=ROLE_PREFILL,
+                              slots=2)
+            if prefill_stats is not None:
+                registry.beat(f"p{i}", stats=prefill_stats)
+        for i in range(decode_n):
+            registry.register(f"d{i}", None, role=ROLE_DECODE,
+                              slots=4)
+            if decode_stats is not None:
+                registry.beat(f"d{i}", stats=decode_stats)
+
+    def test_prefill_backlog_with_decode_headroom_grows_prefill(self):
+        registry = make_registry()
+        self._fleet(registry,
+                    prefill_stats={"queue_depth": 4,
+                                   "slot_idle_fraction": 0.0},
+                    decode_stats={"queue_depth": 0,
+                                  "slot_idle_fraction": 0.5})
+        asks = []
+        autoscaler = ReplicaAutoscaler(
+            registry, ask=lambda d, r: asks.append((d, r)),
+            config=AutoscalerConfig(min_replicas=1, sustain_cycles=2),
+            burn_source=lambda: {"fast": 3.0, "slow": 2.0})
+        assert autoscaler.evaluate() is None       # 1
+        decision = autoscaler.evaluate()           # 2: sustained
+        assert decision["action"] == "add_replica"
+        assert decision["reason"] == "serve_demand"
+        assert decision["role"] == ROLE_PREFILL
+        assert autoscaler.role_targets[ROLE_PREFILL] == 2
+        assert autoscaler.role_targets[ROLE_DECODE] == 2
+        assert asks == [(1, "serve_demand")]
+
+    def test_decode_saturation_grows_decode(self):
+        registry = make_registry()
+        self._fleet(registry,
+                    prefill_stats={"queue_depth": 0,
+                                   "slot_idle_fraction": 0.8},
+                    decode_stats={"queue_depth": 3,
+                                  "slot_idle_fraction": 0.0})
+        autoscaler = ReplicaAutoscaler(
+            registry, config=AutoscalerConfig(min_replicas=1,
+                                              sustain_cycles=1),
+            burn_source=lambda: {"fast": 3.0, "slow": 2.0})
+        decision = autoscaler.evaluate()
+        assert decision["role"] == ROLE_DECODE
+        assert autoscaler.role_targets[ROLE_DECODE] == 3
+
+    def test_burn_with_no_role_signal_holds(self):
+        # burning, but no prompt backlog and decode lanes have
+        # headroom: scaling the wrong role helps nobody — hold
+        registry = make_registry()
+        self._fleet(registry,
+                    prefill_stats={"queue_depth": 0,
+                                   "slot_idle_fraction": 0.9},
+                    decode_stats={"queue_depth": 0,
+                                  "slot_idle_fraction": 0.6})
+        autoscaler = ReplicaAutoscaler(
+            registry, config=AutoscalerConfig(min_replicas=1,
+                                              sustain_cycles=1),
+            burn_source=lambda: {"fast": 9.0, "slow": 9.0})
+        for _ in range(4):
+            assert autoscaler.evaluate() is None
+
+    def test_lost_prefill_replica_asks_once_with_role(self):
+        registry = make_registry()
+        self._fleet(registry, prefill_n=1, decode_n=1)
+        asks = []
+        autoscaler = ReplicaAutoscaler(
+            registry, ask=lambda d, r: asks.append((d, r)),
+            config=AutoscalerConfig(min_replicas=1))
+        assert autoscaler.evaluate() is None
+        registry.condemn("p0", "probe_failed")
+        decision = autoscaler.evaluate()
+        assert decision["action"] == "add_replica"
+        assert decision["reason"] == "lost_node"
+        assert decision["role"] == ROLE_PREFILL
+        # journaled once, not once per cycle
+        assert autoscaler.evaluate() is None
+        assert asks == [(1, "lost_node")]
+        registry.register("p1", None, role=ROLE_PREFILL, slots=2)
+        assert autoscaler.evaluate() is None
+
+    def test_standing_deficit_holds_idle_shed(self):
+        """While a role's replacement is pending (deficit standing,
+        ask already journaled) the idle arm must NOT shed that role's
+        target — a quiet window during the replacement would silently
+        cancel the very replica the lost_node ask is replacing."""
+        registry = make_registry()
+        self._fleet(registry, prefill_n=1, decode_n=3,
+                    prefill_stats={"queue_depth": 1,
+                                   "slot_idle_fraction": 0.0},
+                    decode_stats={"queue_depth": 0,
+                                  "slot_idle_fraction": 1.0})
+        autoscaler = ReplicaAutoscaler(
+            registry, config=AutoscalerConfig(min_replicas=1,
+                                              idle_cycles=2))
+        assert autoscaler.evaluate() is None       # targets seeded
+        registry.condemn("d0", "probe_failed")
+        decision = autoscaler.evaluate()
+        assert decision["reason"] == "lost_node"
+        assert decision["role"] == ROLE_DECODE
+        # quiet idle cycles while the deficit stands: hold, don't shed
+        for _ in range(4):
+            assert autoscaler.evaluate() is None
+        assert autoscaler.role_targets[ROLE_DECODE] == 3
+
+    def test_unregistered_role_is_never_asked_for(self):
+        """A role no replica has EVER registered has no target: a
+        decode-only fleet (or a boot window where decode registers
+        before the first prefill replica) must not journal a
+        `lost_node` ask for a prefill replica that never existed."""
+        registry = make_registry()
+        self._fleet(registry, prefill_n=0, decode_n=2)
+        asks = []
+        autoscaler = ReplicaAutoscaler(
+            registry, ask=lambda d, r: asks.append((d, r)),
+            config=AutoscalerConfig(min_replicas=1))
+        assert autoscaler.evaluate() is None
+        assert autoscaler.evaluate() is None
+        assert asks == []
+        assert ROLE_PREFILL not in autoscaler.role_targets
+        # the role becomes a scaling surface the moment it registers —
+        # and a real loss afterwards DOES ask
+        registry.register("p0", None, role=ROLE_PREFILL, slots=2)
+        assert autoscaler.evaluate() is None
+        registry.condemn("p0", "probe_failed")
+        decision = autoscaler.evaluate()
+        assert decision["action"] == "add_replica"
+        assert decision["reason"] == "lost_node"
+        assert decision["role"] == ROLE_PREFILL
+
+    def test_sustained_idle_sheds_only_the_idle_role(self):
+        registry = make_registry()
+        self._fleet(registry, prefill_n=1, decode_n=3,
+                    prefill_stats={"queue_depth": 2,
+                                   "slot_idle_fraction": 0.0},
+                    decode_stats={"queue_depth": 0,
+                                  "slot_idle_fraction": 1.0})
+        autoscaler = ReplicaAutoscaler(
+            registry, config=AutoscalerConfig(min_replicas=1,
+                                              idle_cycles=2))
+        assert autoscaler.evaluate() is None
+        decision = autoscaler.evaluate()
+        assert decision["action"] == "remove_replica"
+        assert decision["reason"] == "serve_idle"
+        assert decision["role"] == ROLE_DECODE
+        assert autoscaler.role_targets[ROLE_DECODE] == 2
+        assert autoscaler.role_targets[ROLE_PREFILL] == 1
+        # never below the per-role floor
+        autoscaler.role_targets[ROLE_DECODE] = 1
+        for _ in range(5):
+            decision = autoscaler.evaluate()
+            assert decision is None or \
+                decision["action"] != "remove_replica"
+
+    def test_total_target_sums_roles_for_the_scaling_policy(self):
+        from cloudtik_tpu.control.scaling_policies import (
+            create_scaling_policy)
+        client = StateClient(InMemoryStateBackend())
+        registry = ReplicaRegistry(client)
+        registry.register("p0", None, role=ROLE_PREFILL, slots=2)
+        registry.register("d0", None, role=ROLE_DECODE, slots=4)
+        registry.register("d1", None, role=ROLE_DECODE, slots=4)
+        policy = create_scaling_policy(
+            "serve-demand", {}, "head", state_client=client,
+            scaling_config={"resource_per_replica": {"TPU": 8}})
+        state = policy.get_scaling_state()
+        demands = state.autoscaling_instructions["resource_demands"]
+        # one node per wanted replica across BOTH roles (1 + 2), each
+        # demand tagged with the role resource so the scaler bin-packs
+        # it onto a node type that boots that role — an untagged
+        # generic launch could join as the wrong role
+        assert demands == (
+            [{"TPU": 8, "tik-serve-role-decode": 1}] * 2
+            + [{"TPU": 8, "tik-serve-role-prefill": 1}])
+        assert policy.autoscaler.total_target() == 3
+
+
+# ----------------------------------- live controller drill (roles) --
+
+class TestLiveScalingDrill:
+    def test_decode_ask_admits_replica_and_router_spills(
+            self, model, tmp_path):
+        """ROADMAP item 1 REMAINING: the fabric under open-loop load
+        -> sustained burn + decode backlog -> the autoscaler journals
+        a role=decode serve_demand ask -> the drill admits a
+        decode-role replica -> the router spills live traffic to it.
+        The flight recorder narrates the episode."""
+        from cloudtik_tpu.serve.engine import Request
+        from cloudtik_tpu.serve.replicas import ReplicaHeartbeat
+        from cloudtik_tpu.telemetry import events
+
+        prefill = make_prefill(model)
+        d0 = make_decode(model, "d0", slots=1, blocks=49)
+        registry = make_registry(deadline_s=60)
+        asks = []
+        autoscaler = ReplicaAutoscaler(
+            registry, ask=lambda d, r: asks.append((d, r)),
+            config=AutoscalerConfig(min_replicas=1, sustain_cycles=2),
+            burn_source=lambda: {"fast": 3.0, "slow": 2.0})
+        router = make_fabric_router([prefill], [d0],
+                                    registry=registry,
+                                    autoscaler=autoscaler,
+                                    load_factor=1.0)
+        beaters = [
+            ReplicaHeartbeat(registry, "p0", None, role="prefill",
+                             slots=2, stats_fn=prefill.engine.stats,
+                             period_s=0.03),
+            ReplicaHeartbeat(registry, "d0", None, role="decode",
+                             slots=1, stats_fn=d0.engine.stats,
+                             period_s=0.03),
+        ]
+        for beater in beaters:
+            beater.start()
+        events.install(str(tmp_path / "events.jsonl"))
+        d1 = None
+        try:
+            # open-loop ramp: short-prompt, long-output traffic pins
+            # d0's single decode lane and builds a backlog
+            requests = []
+            for i in range(6):
+                req = Request([i + 1, 2, 3, 4], max_new_tokens=48)
+                router.submit(req)
+                requests.append(req)
+                time.sleep(0.01)
+            # the autoscaler watches live beats until the backlog
+            # shows; burn is already hot (fast+slow above threshold)
+            decision = None
+            deadline = time.time() + 30
+            while time.time() < deadline and decision is None:
+                time.sleep(0.05)
+                decision = autoscaler.evaluate()
+            assert decision is not None, "no scaling decision"
+            assert decision["action"] == "add_replica"
+            assert decision["reason"] == "serve_demand"
+            assert decision["role"] == ROLE_DECODE
+            assert (1, "serve_demand") in asks
+            # the drill is the controller: admit the asked-for replica
+            d1 = make_decode(model, "d1", slots=3, blocks=49)
+            spill_count = [0]
+            inner = d1.forward
+
+            def counting_forward(payload, timeout_s,
+                                 traceparent=None):
+                spill_count[0] += 1
+                return inner(payload, timeout_s,
+                             traceparent=traceparent)
+
+            d1.forward = counting_forward
+            router.add_client(d1, role="decode", slots=3)
+            # keep the pressure on: new traffic spills to d1 (d0's
+            # lane is still busy and the bounded-load walk moves on)
+            tail = []
+            for i in range(8):
+                req = Request([i + 50, 2, 3, 4], max_new_tokens=8)
+                router.submit(req)
+                tail.append(req)
+            for req in requests + tail:
+                req.wait(timeout=120)
+            assert spill_count[0] > 0, \
+                "router never spilled to the admitted replica"
+        finally:
+            for beater in beaters:
+                beater.stop()
+            events.uninstall()
+            router.stop()
+            prefill.stop()
+            d0.stop()
+            if d1 is not None:
+                d1.stop()
+        journal, _ = events.read_file(str(tmp_path / "events.jsonl"))
+        decisions = [r for r in journal
+                     if r.get("name") == "tik_scaler_decision"
+                     and r.get("reason") == "serve_demand"]
+        assert decisions and decisions[0].get("role") == ROLE_DECODE
+        assert decisions[0]["action"] == "add_replica"

@@ -616,3 +616,112 @@ class TestAdapterPlanes:
                                    lora_cfg)
         a = planes["wq"]["a"]
         assert a.shape[0] == cfg.n_layers and a.shape[1] == 2
+
+
+# --------------------------------------- spec x adapters (the guard) --
+
+class TestSpecAdapterGuard:
+    """ROADMAP item 4 REMAINING (defensive slice): speculative
+    decoding on a multi-tenant engine.  A request carrying an
+    `adapter_id` must take the PLAIN decode path unless a matching
+    per-adapter draft is registered — a base-model draft proposing
+    for an adapter-shifted target is a correctness hazard, not an
+    optimization.  With a registered draft the verify scores the
+    adapter-MERGED target, so greedy output stays bit-identical to
+    the dedicated merged engine either way."""
+
+    def _spec_engine(self, model, pool, adapter_drafts=None, slots=3):
+        from cloudtik_tpu.serve.engine import SpecConfig
+        cfg, params, _lora_cfg, _bank = model
+        engine = DecodeEngine(
+            params, cfg,
+            EngineConfig(slots=slots, spec=SpecConfig(k=3),
+                         **ENGINE_KW),
+            draft=(params, cfg), adapters=pool,
+            adapter_drafts=adapter_drafts)
+        engine.start()
+        return engine
+
+    def test_unmatched_adapter_takes_plain_path_bit_identical(
+            self, model):
+        engine = self._spec_engine(model, _pool(model))
+        try:
+            prompt = list(range(1, 10))
+            req = engine.submit(Request(prompt, max_new_tokens=8,
+                                        adapter_id="t0"))
+            out = req.wait(timeout=300)
+            # no draft proposed for the adapter target — plain decode
+            assert req.draft_tokens == 0
+            assert req.spec_steps == 0
+            assert out == _merged_reference(model, "t0", prompt, 8)
+        finally:
+            engine.stop()
+
+    def test_base_request_still_speculates_alongside_adapter(
+            self, model):
+        engine = self._spec_engine(model, _pool(model))
+        try:
+            base = engine.submit(Request(list(range(2, 11)),
+                                         max_new_tokens=8))
+            worn = engine.submit(Request(list(range(3, 12)),
+                                         max_new_tokens=8,
+                                         adapter_id="t1"))
+            base_out = base.wait(timeout=300)
+            worn_out = worn.wait(timeout=300)
+            # the base request speculates (self-draft: acceptance 1.0)
+            assert base.draft_tokens > 0
+            assert base.accepted_tokens == base.draft_tokens
+            # the adapter request rode the plain path in the same loop
+            assert worn.draft_tokens == 0
+            assert base_out == _merged_reference(
+                model, None, list(range(2, 11)), 8)
+            assert worn_out == _merged_reference(
+                model, "t1", list(range(3, 12)), 8)
+        finally:
+            engine.stop()
+
+    def test_registered_adapter_draft_speculates_bit_identical(
+            self, model):
+        cfg, params, lora_cfg, bank = model
+        merged = dict(params)
+        merged["layers"] = LO.merge_lora(params["layers"], bank["t1"],
+                                         lora_cfg)
+        # the t1 draft IS the t1-merged target: greedy acceptance 1.0
+        # is the machinery's ceiling, and the verify must score the
+        # merged target for the output to stay bit-identical
+        engine = self._spec_engine(model, _pool(model),
+                                   adapter_drafts={"t1": merged})
+        try:
+            prompt = list(range(4, 13))
+            req = engine.submit(Request(prompt, max_new_tokens=8,
+                                        adapter_id="t1"))
+            out = req.wait(timeout=300)
+            assert req.draft_tokens > 0
+            assert req.accepted_tokens == req.draft_tokens
+            assert out == _merged_reference(model, "t1", prompt, 8)
+            # an adapter with NO draft on the same engine stays plain
+            other = engine.submit(Request(prompt, max_new_tokens=8,
+                                          adapter_id="t0"))
+            other_out = other.wait(timeout=300)
+            assert other.draft_tokens == 0
+            assert other_out == _merged_reference(model, "t0", prompt,
+                                                  8)
+        finally:
+            engine.stop()
+
+    def test_adapter_drafts_validation(self, model):
+        from cloudtik_tpu.serve.engine import SpecConfig
+        cfg, params, _lora_cfg, _bank = model
+        # adapter_drafts without spec: dead config, refused
+        with pytest.raises(ValueError, match="spec"):
+            DecodeEngine(params, cfg, EngineConfig(slots=1,
+                                                   **ENGINE_KW),
+                         adapters=_pool(model),
+                         adapter_drafts={"t0": params})
+        # adapter_drafts without an adapter pool: undeliverable
+        with pytest.raises(ValueError, match="adapter pool"):
+            DecodeEngine(params, cfg,
+                         EngineConfig(slots=1, spec=SpecConfig(k=3),
+                                      **ENGINE_KW),
+                         draft=(params, cfg),
+                         adapter_drafts={"t0": params})

@@ -321,6 +321,58 @@ class TestMultiReplicaTrajectoryIsolation:
         assert report["mode"] == "multi_replica"
 
 
+class TestFabricTrajectoryIsolation:
+    """Role-aware fabric records (serving_bench.py --workload
+    fabric_disagg) carry mode="fabric_disagg" and form their own
+    trajectory — mode-isolated in MODE_METRIC_TAGS exactly like
+    spec/disagg/multi_replica, both directions."""
+
+    def test_gate_excludes_fabric_from_monolithic_median(
+            self, perf_gate, tmp_path):
+        _trajectory(tmp_path, [64.0, 60.0], metric="serving_rps_at_slo")
+        mislabeled = tmp_path / "BENCH_r15.json"
+        # a fabric record mislabeled under the monolithic metric name
+        # must still be excluded from its median
+        mislabeled.write_text(json.dumps({"parsed": {
+            "metric": "serving_rps_at_slo", "value": 9000.0,
+            "mode": "fabric_disagg"}}))
+        paths = [str(p) for p in tmp_path.glob("BENCH_*.json")]
+        history = perf_gate.load_history(paths,
+                                         metric="serving_rps_at_slo")
+        assert sorted(v for _p, v in history) == [60.0, 64.0]
+
+    def test_fabric_metric_forms_its_own_trajectory(self, perf_gate,
+                                                    tmp_path):
+        record = {"parsed": {"metric": "serving_rps_at_slo_fabric",
+                             "value": 120.0, "mode": "fabric_disagg"}}
+        (tmp_path / "BENCH_r15.json").write_text(json.dumps(record))
+        paths = [str(p) for p in tmp_path.glob("BENCH_*.json")]
+        history = perf_gate.load_history(
+            paths, metric="serving_rps_at_slo_fabric")
+        assert [v for _p, v in history] == [120.0]
+        code, report = perf_gate.gate(
+            {"metric": "serving_rps_at_slo_fabric", "value": 118.0,
+             "mode": "fabric_disagg"}, history, 10.0)
+        assert code == 0
+        assert report["mode"] == "fabric_disagg"
+
+    def test_disagg_history_does_not_feed_fabric_median(
+            self, perf_gate, tmp_path):
+        # the per-host disagg trajectory and the cross-replica fabric
+        # trajectory are different machines — a mode="disagg" record
+        # must not survive under the fabric metric name
+        (tmp_path / "BENCH_r11.json").write_text(json.dumps({
+            "parsed": {"metric": "serving_rps_at_slo_fabric",
+                       "value": 9000.0, "mode": "disagg"}}))
+        (tmp_path / "BENCH_r15.json").write_text(json.dumps({
+            "parsed": {"metric": "serving_rps_at_slo_fabric",
+                       "value": 120.0, "mode": "fabric_disagg"}}))
+        paths = [str(p) for p in tmp_path.glob("BENCH_*.json")]
+        history = perf_gate.load_history(
+            paths, metric="serving_rps_at_slo_fabric")
+        assert [v for _p, v in history] == [120.0]
+
+
 class TestMultiTenantTrajectoryIsolation:
     """Multi-tenant LoRA records (serving_bench.py --workload
     multi_tenant) carry mode="multi_tenant" and form their own

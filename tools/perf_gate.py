@@ -53,6 +53,9 @@ MODE_METRIC_TAGS = {
     "spec": "spec",                # serving_bench.py --spec lines
     "elasticity": "elastic",       # elasticity_bench.py dryrun lines
     "disagg": "disagg",            # serving_bench.py --workload disagg
+    # serving_bench.py --workload fabric_disagg (role-aware fabric:
+    # prefill-role -> socket KV migration -> decode-role via router)
+    "fabric_disagg": "fabric",
     # serving_bench.py --workload multi_replica (affinity router)
     "multi_replica": "replicated",
     # serving_bench.py --workload multi_tenant (LoRA multiplexing)
