@@ -92,6 +92,11 @@ def main() -> int:
         first_token_mono=None, done_mono=0.0)
     disabled_reqlog_record_ns = _ns(
         lambda: _reqlog.record(_req, "done"), n)
+    # the router's per-request decision trail must cost one attribute
+    # check when off — begin() returns None before any allocation
+    from cloudtik_tpu.serve import routerlog as _routerlog
+    disabled_router_record_ns = _ns(
+        lambda: _routerlog.begin(None, "default", 1, 0, False, None), n)
 
     telemetry.enable()
     telemetry.reset()
@@ -142,6 +147,8 @@ def main() -> int:
                 round(disabled_prefetch_put_note_ns, 1),
             "disabled_reqlog_record_ns":
                 round(disabled_reqlog_record_ns, 1),
+            "disabled_router_record_ns":
+                round(disabled_router_record_ns, 1),
             "disabled_elastic_remesh_note_ns":
                 round(disabled_elastic_note_ns, 1),
             "enabled_span_ns": round(enabled_span_ns, 1),

@@ -23,6 +23,7 @@ Seam registry (keep docs/fault-injection.md in sync):
   checkpoint.save                 Checkpointer.save     {step, directory} supports torn_write
   events.append                   flight recorder append {name, path}    supports torn_write
   serve.reqlog.append             request ledger append {name, path}     supports torn_write
+  serve.router.record             router ledger append  {name, path}     supports torn_write
   serve.kvcache.alloc             KV block pool alloc   {need, free, evictable}  raise -> pool exhausted
   serve.lora.load                 LoRA adapter cold load {adapter}      raise -> the request fails, not the engine
   serve.kvcache.migrate           KV block export, per block chunk {request, seq, blocks}  raise -> transfer torn, request degrades to re-prefill

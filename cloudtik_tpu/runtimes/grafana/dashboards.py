@@ -229,6 +229,20 @@ def ai_workload_dashboard() -> Dict[str, Any]:
         _panel(52, "Adapter evictions (LRU pressure)",
                "rate(tik_serve_adapter_evictions_total[5m])", "ops",
                0, 183),
+        # -- Request forensics row: per-phase TTFT decomposition ----------
+        {"id": 53, "type": "row", "title": "Request forensics",
+         "collapsed": False,
+         "gridPos": {"h": 1, "w": 24, "x": 0, "y": 191}, "panels": []},
+        # where a routed request's wall actually went (router_wait /
+        # prefill / handoff_wire / decode_first / decode_rest) — one
+        # series per phase label; the fat phase is the one to chase
+        _panel(54, "Request phase latency p95 (by phase)",
+               "histogram_quantile(0.95, sum by (le, phase) "
+               "(rate(tik_serve_phase_seconds_bucket[5m])))",
+               "s", 0, 192),
+        _panel(55, "Phase samples (completion-point emission rate)",
+               "rate(tik_serve_phase_seconds_count[5m])", "ops",
+               12, 192),
     ]
     return {
         "uid": "tik-ai-workloads",

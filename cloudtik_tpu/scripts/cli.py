@@ -1335,41 +1335,58 @@ def events_tail(path, lines, follow):
 
 @cli.group(name="serve")
 def serve_group():
-    """Serving observability: the request-lifecycle ledger
-    (docs/observability.md "Request ledger").  The decode engine
-    appends one durable JSONL record per finished request; these verbs
-    replay it and compute offline percentiles/availability."""
+    """Serving observability: the request-lifecycle ledger and the
+    router decision ledger (docs/observability.md "Request ledger" /
+    "Request forensics").  Engines append one durable JSONL record per
+    finished request, the router one per routed request; these verbs
+    replay them — offline percentiles/availability (`requests`),
+    fleet membership (`replicas`), and one request's stitched
+    cross-replica story (`explain`)."""
 
 
 @serve_group.command(name="requests")
-@click.option("--path", default=None,
+@click.option("--path", "paths", multiple=True,
               help="Ledger path (default: <tik home>/logs/"
-                   "serve-requests.jsonl; TIK_REQLOG_PATH overrides).")
+                   "serve-requests.jsonl; TIK_REQLOG_PATH overrides). "
+                   "Repeat for multiple replicas' ledgers — the "
+                   "populations merge into one fleet view.")
+@click.option("--fleet", "as_fleet", is_flag=True,
+              help="With --stats: add a per-replica breakdown after "
+                   "the merged population (shorthand for running "
+                   "--by replica alongside the overall stats).")
 @click.option("--tail", "tail_n", type=int, default=None,
               help="Only the newest N records.")
 @click.option("--since", "since_s", type=float, default=None,
               help="Only records finished in the last N seconds.")
 @click.option("--finish", "finish_filter", default=None,
               type=click.Choice(["done", "cancelled", "rejected",
-                                 "error", "drained"]),
+                                 "error", "drained", "migrated"]),
               help="Only records with this finish reason.")
 @click.option("--stats", "as_stats", is_flag=True,
-              help="Offline p50/p95/p99 (TTFT/TPOT/queue wait) and "
-                   "availability over the selected records.")
+              help="Offline p50/p95/p99 (TTFT/TPOT/queue wait + the "
+                   "five lifecycle phases) and availability over the "
+                   "selected records.")
 @click.option("--by", "group_by", default=None,
-              type=click.Choice(["tenant", "adapter_id"]),
-              help="With --stats: one stats block per group — the "
-                   "per-tenant SLO view (who is burning whose "
-                   "budget).")
+              type=click.Choice(["tenant", "adapter_id", "path",
+                                 "replica"]),
+              help="With --stats: one stats block per group — "
+                   "per-tenant (who burns whose budget), per fabric "
+                   "path (is the migrated path earning its wire "
+                   "cost), or per replica (is one replica dragging "
+                   "the fleet tail).")
 @click.option("--json", "as_json", is_flag=True,
               help="Emit raw records (or the stats dict) as JSON.")
-def serve_requests(path, tail_n, since_s, finish_filter, as_stats,
-                   group_by, as_json):
+def serve_requests(paths, as_fleet, tail_n, since_s, finish_filter,
+                   as_stats, group_by, as_json):
     """Replay the request ledger (torn final line skipped)."""
     import time as _time
 
+    from cloudtik_tpu.serve import explain as sexplain
     from cloudtik_tpu.serve import reqlog
-    records = reqlog.read_requests(path)
+    if paths:
+        records = sexplain.fleet_requests(paths)
+    else:
+        records = reqlog.read_requests(None)
     if finish_filter:
         records = [r for r in records
                    if r.get("finish") == finish_filter]
@@ -1393,15 +1410,25 @@ def serve_requests(path, tail_n, since_s, finish_filter, as_stats,
             click.echo(f"  {reason:<12} {count}")
         click.echo(f"{'latency':<12} {'count':>7} {'p50':>10} "
                    f"{'p95':>10} {'p99':>10}")
+        def _ms(v):
+            return f"{v * 1e3:>8.2f}ms" if v is not None else \
+                f"{'-':>10}"
+
         for field, label in (("ttft_s", "ttft"),
                              ("queue_wait_s", "queue_wait"),
                              ("tpot_s", "tpot")):
             entry = stats[field]
-
-            def _ms(v):
-                return f"{v * 1e3:>8.2f}ms" if v is not None else \
-                    f"{'-':>10}"
-
+            click.echo(f"{label:<12} {entry['count']:>7} "
+                       f"{_ms(entry['p50'])} {_ms(entry['p95'])} "
+                       f"{_ms(entry['p99'])}")
+        # the five-phase TTFT decomposition (rows appear once any
+        # record in the population carried the phase — fabric-only
+        # phases stay hidden on a monolithic fleet)
+        for field in reqlog.PHASE_FIELDS:
+            entry = stats.get(field)
+            if not entry or not entry["count"]:
+                continue
+            label = "ph:" + field[: -len("_s")]
             click.echo(f"{label:<12} {entry['count']:>7} "
                        f"{_ms(entry['p50'])} {_ms(entry['p95'])} "
                        f"{_ms(entry['p99'])}")
@@ -1432,6 +1459,20 @@ def serve_requests(path, tail_n, since_s, finish_filter, as_stats,
                 _print_stats(stats)
             return
         stats = reqlog.compute_stats(records)
+        if as_fleet:
+            per_replica = reqlog.group_stats(records, by="replica")
+            if as_json:
+                click.echo(json.dumps(
+                    {"fleet": stats, "replicas": per_replica},
+                    indent=1))
+                return
+            click.echo(f"--- fleet ({len(paths) or 1} source"
+                       f"{'s' if len(paths) != 1 else ''}) ---")
+            _print_stats(stats)
+            for key, rstats in per_replica.items():
+                click.echo(f"--- replica: {key} ---")
+                _print_stats(rstats)
+            return
         if as_json:
             click.echo(json.dumps(stats, indent=1))
             return
@@ -1455,8 +1496,10 @@ def serve_requests(path, tail_n, since_s, finish_filter, as_stats,
             return f"{value * 1e3:.1f}ms" \
                 if isinstance(value, (int, float)) else "-"
 
+        replica = record.get("replica")
+        where = f"{replica}#" if replica else "#"
         click.echo(
-            f"{ts}  #{record.get('request_id', '?'):<6} "
+            f"{ts}  {where}{record.get('request_id', '?'):<6} "
             f"{record.get('finish', '?'):<10} "
             f"prompt={record.get('prompt_tokens', '?'):<4} "
             f"out={record.get('output_tokens', '?'):<4} "
@@ -1485,9 +1528,9 @@ def serve_replicas(url, as_json):
     click.echo(f"policy: {view.get('policy', '?')}"
                + (f"   target replicas: {target}"
                   if target is not None else ""))
-    click.echo(f"{'replica':<14} {'role':<8} {'state':<22} "
-               f"{'beat age':>9} {'inflight':>9} {'queue':>6} "
-               f"{'slots':>6}")
+    click.echo(f"{'replica':<14} {'role':<8} {'version':<8} "
+               f"{'state':<22} {'beat age':>9} {'inflight':>9} "
+               f"{'queue':>6} {'slots':>6}")
     for rep in view.get("replicas", []):
         if rep.get("condemned"):
             state = f"condemned:{rep['condemned']}"
@@ -1500,11 +1543,78 @@ def serve_replicas(url, as_json):
         stats = rep.get("stats") or {}
         click.echo(
             f"{rep.get('replica_id', '?'):<14} "
-            f"{rep.get('role', '?'):<8} {state:<22} "
+            f"{rep.get('role', '?'):<8} "
+            f"{rep.get('version', '0'):<8} {state:<22} "
             f"{rep.get('beat_age_s', '?'):>8}s "
             f"{rep.get('inflight', 0):>9} "
             f"{stats.get('queue_depth', '-'):>6} "
             f"{rep.get('slots', '-'):>6}")
+
+
+@serve_group.command(name="explain")
+@click.argument("request_id")
+@click.option("--path", "router_path", default=None,
+              help="Router decision ledger path (default: <tik home>/"
+                   "logs/serve-router.jsonl; TIK_ROUTER_LOG_PATH "
+                   "overrides).")
+@click.option("--reqlog", "reqlog_paths", multiple=True,
+              help="Replica request-ledger path(s) to stitch in "
+                   "(repeat per replica; default: the local default "
+                   "ledger).")
+@click.option("--url", default=None,
+              help="Ask a running router instead of reading local "
+                   "files (GET /v1/explain — router-ledger view only; "
+                   "replica phase records need --reqlog files).")
+@click.option("--trace", "trace_file", default=None,
+              type=click.Path(exists=True),
+              help="A Chrome-trace export (tik cluster trace export) "
+                   "to narrow to this request's trace id.")
+@click.option("--trace-out", default=None,
+              help="Write the narrowed Chrome trace here (default: "
+                   "explain-<request_id>.trace.json).")
+@click.option("--json", "as_json", is_flag=True,
+              help="Emit the stitched structure as JSON.")
+def serve_explain(request_id, router_path, reqlog_paths, url,
+                  trace_file, trace_out, as_json):
+    """Why did request N behave the way it did?
+
+    One timeline from the router's decision ledger (which replica and
+    WHY, hop by hop) joined with every replica's request ledger
+    (phases: router_wait -> prefill -> handoff_wire -> decode_first ->
+    decode_rest, critical path flagged) — the forensics half of
+    `tik slo status` (docs/observability.md "Request forensics")."""
+    from cloudtik_tpu.serve import explain as sexplain
+    if url:
+        import urllib.request
+        with urllib.request.urlopen(
+                url.rstrip("/") + "/v1/explain?request_id="
+                + str(request_id), timeout=10) as resp:
+            result = json.loads(resp.read().decode())
+    else:
+        routes, requests = sexplain.load(router_path, reqlog_paths)
+        result = sexplain.build(request_id, routes, requests)
+    if as_json:
+        click.echo(json.dumps(result, indent=1, default=str))
+    else:
+        click.echo(sexplain.render(result))
+    if trace_file:
+        traceparent = None
+        if result.get("route"):
+            traceparent = result["route"].get("traceparent")
+        if traceparent is None:
+            for rec in result.get("records") or []:
+                if rec.get("traceparent"):
+                    traceparent = rec["traceparent"]
+                    break
+        with open(trace_file) as f:
+            trace = json.load(f)
+        narrowed = sexplain.filter_trace(trace, traceparent)
+        out_path = trace_out or f"explain-{request_id}.trace.json"
+        with open(out_path, "w") as f:
+            json.dump(narrowed, f)
+        cli_logger.info(
+            "Wrote {} span(s) on this request's trace to {}",
+            len(narrowed["traceEvents"]), out_path)
 
 
 # ------------------------------------------------------------------ chaos --

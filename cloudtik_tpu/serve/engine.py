@@ -856,6 +856,14 @@ class DecodeEngine:
                       "cancelled": reqlog.FINISH_CANCELLED,
                       "rejected": reqlog.FINISH_REJECTED}.get(
                           result, reqlog.FINISH_ERROR)
+        if _telemetry_state.enabled:
+            # the single per-request phase emission point: the FINISHING
+            # engine decomposes the whole fabric path's wall (migrated-in
+            # requests carry the prefill half's stamps in the header)
+            for phase, seconds in reqlog.derive_phases(req).items():
+                if seconds is not None:
+                    ti.SERVE_PHASE_SECONDS.observe(
+                        seconds, phase=phase[: -len("_s")])
         reqlog.record(req, finish)
         req._done.set()
 

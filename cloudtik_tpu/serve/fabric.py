@@ -278,6 +278,10 @@ class DecodeReplica(EngineReplica):
                 f"decode replica {self.replica_id} is down"))
             return
         ti.SERVE_FABRIC_REQUESTS.inc(path="fallback")
+        # the request's ledger record (and the router's decision
+        # ledger, through forward_to's result) must say the handoff
+        # tore and this replica re-prefilled it plain
+        request.fabric_path = "fallback"
         self.engine.submit(request)
 
         def _watch():
@@ -347,6 +351,11 @@ class PrefillReplica(ReplicaClient):
                 "cannot route exports per request")
         self.replica_id = replica_id
         self.engine = engine
+        # the engine's ledger records carry the replica identity —
+        # `tik serve requests --fleet` needs to know whose they are
+        # (EngineReplica does the same for the decode/monolithic roles)
+        if getattr(engine, "replica_id", None) is None:
+            engine.replica_id = replica_id
         self._dead = False
         self._draining = False
         self._lock = threading.Lock()
@@ -400,8 +409,18 @@ class PrefillReplica(ReplicaClient):
             error = done.error
             if error is not None:
                 raise_replica_error(self.replica_id, error)
+            # fabric forensics ride along (harmless extra keys through
+            # the HTTP router): which fabric path actually finished the
+            # request — "migrated" / "fallback" from the completing
+            # request's stamp, "prefill_local" when it never left this
+            # engine (eos at the first token) — and the decode-side
+            # join key back to the prefill record
             return {"tokens": [list(done.tokens)],
-                    "request_id": done.request_id}
+                    "request_id": done.request_id,
+                    "migrated_from": getattr(done, "migrated_from",
+                                             None),
+                    "fabric_path": (getattr(done, "fabric_path", None)
+                                    or "prefill_local")}
         finally:
             with self._lock:
                 self._inflight.pop(req.request_id, None)

@@ -228,6 +228,17 @@ def request_from_header(header: Dict[str, Any]):
         adapter_id=header.get("adapter_id"))
     request.traceparent = header.get("traceparent")
     request.migrated_from = header.get("request_id")
+    # the record this request will eventually append is the FINISHING
+    # record of a fabric-migrated path: carry the prefill half's wall
+    # stamps across so reqlog.derive_phases can telescope router_wait /
+    # prefill / handoff_wire, and stamp the arrival instant (wall +
+    # mono twins, same instant, so the wall->mono splice is exact
+    # in-process and skew-bounded cross-host)
+    request.fabric_path = "migrated"
+    request.prefill_admitted_ts = header.get("admitted")
+    request.export_started_ts = header.get("export_started")
+    request.import_ts = time.time()
+    request.import_mono = time.monotonic()
     created = header.get("created")
     if created is not None:
         # back-date the lifecycle origin to the ORIGIN submit: TTFT and
@@ -415,6 +426,11 @@ class BlockMigrator:
         seam (fired before every block) or the transport raises — the
         caller owns the degrade."""
         n_blocks = int(k.shape[1])
+        # mirror the export-start stamp on the request itself: the
+        # prefill side's "migrated" ledger record ends its prefill
+        # phase here (reqlog.derive_phases)
+        request.export_started_ts = time.time()
+        request.export_mono = time.monotonic()
         header = {
             "request_id": request.request_id,
             "prompt": list(request.prompt),
@@ -427,6 +443,13 @@ class BlockMigrator:
             # origin submit time: the importer back-dates its lifecycle
             # stamps so TTFT spans the whole fabric path
             "created": getattr(request, "created", None),
+            # phase decomposition stamps (wall — the importer diffs
+            # them against its own wall clock, the same skew-bounded
+            # discipline as the created back-dating): when the prefill
+            # side admitted the request, and when this export began —
+            # router_wait / prefill / handoff_wire telescope from them
+            "admitted": getattr(request, "admitted", None),
+            "export_started": request.export_started_ts,
             # adapter identity crosses with the KV state: the decode
             # role re-acquires the SAME LoRA delta (and salts its
             # prefix-cache keys with it), so disaggregated serving
@@ -450,6 +473,17 @@ class BlockMigrator:
                     request.request_id, seq, k[:, seq], v[:, seq]))
             self.transport.send(pack_commit(request.request_id,
                                             n_blocks))
+            # the commit frame is on the wire: the request now lives on
+            # at the decode side, and the prefill half of its story must
+            # survive THIS process.  A "migrated" ledger record (not a
+            # terminal finish — no done stamps; the prefill phase ends
+            # at export start) that `tik serve explain` joins through
+            # the decode record's migrated_from.  At the commit point —
+            # not the engine's dispatch point — so an async-send tear
+            # never leaves a phantom "migrated" record next to the
+            # fallback's.
+            from cloudtik_tpu.serve import reqlog
+            reqlog.record(request, reqlog.FINISH_MIGRATED)
         except BaseException:
             # best-effort abort so the receiver drops the torn stream;
             # the original failure is the one that must surface

@@ -84,6 +84,10 @@ class ReplicaInfo:
     draining: bool = False
     condemned: Optional[str] = None   # why, or None
     stats: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # deployment version label (rollout groundwork): both request
+    # ledgers stamp it so a rollout can prove which version served
+    # each request; "0" = unversioned
+    version: str = "0"
 
     @property
     def queue_depth(self) -> float:
@@ -105,16 +109,18 @@ class ReplicaRegistry:
     # -- write side (replicas + router) -----------------------------------
     def register(self, replica_id: str, url: Optional[str],
                  role: str = ROLE_ENGINE, slots: int = 0,
-                 stats: Optional[Dict[str, Any]] = None) -> None:
+                 stats: Optional[Dict[str, Any]] = None,
+                 version: str = "0") -> None:
         """Register (or re-register) a replica; clears any condemnation
         — a fresh registration is the operator's 'this one is back'."""
         self.state.table_put(TABLE_SERVE_REPLICAS, replica_id, {
             "replica_id": replica_id, "url": url, "role": role,
             "slots": int(slots), "time": time.time(),
             "draining": False, "condemned": None,
-            "stats": dict(stats or {})})
+            "stats": dict(stats or {}), "version": str(version)})
         events.emit("tik_serve_replica_registered",
-                    replica=replica_id, role=role, slots=int(slots))
+                    replica=replica_id, role=role, slots=int(slots),
+                    version=str(version))
 
     def beat(self, replica_id: str,
              stats: Optional[Dict[str, Any]] = None) -> None:
@@ -165,7 +171,8 @@ class ReplicaRegistry:
             time=float(record.get("time", 0.0) or 0.0),
             draining=bool(record.get("draining", False)),
             condemned=record.get("condemned"),
-            stats=dict(record.get("stats") or {}))
+            stats=dict(record.get("stats") or {}),
+            version=str(record.get("version", "0") or "0"))
 
     def list_replicas(self) -> List[ReplicaInfo]:
         return [self._decode(r) for r in
@@ -200,7 +207,8 @@ class ReplicaHeartbeat:
                  url: Optional[str], role: str = ROLE_ENGINE,
                  slots: int = 0,
                  stats_fn: Optional[Callable[[], Dict[str, Any]]] = None,
-                 period_s: float = DEFAULT_BEAT_PERIOD_S):
+                 period_s: float = DEFAULT_BEAT_PERIOD_S,
+                 version: str = "0"):
         self.registry = registry
         self.replica_id = replica_id
         self.url = url
@@ -208,13 +216,15 @@ class ReplicaHeartbeat:
         self.slots = int(slots)
         self.stats_fn = stats_fn
         self.period_s = float(period_s)
+        self.version = str(version)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
         self.registry.register(self.replica_id, self.url, self.role,
                                self.slots,
-                               stats=self._snapshot())
+                               stats=self._snapshot(),
+                               version=self.version)
         self._thread = threading.Thread(
             target=self._loop, name=f"tik-replica-beat-{self.replica_id}",
             daemon=True)

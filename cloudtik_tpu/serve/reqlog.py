@@ -8,23 +8,34 @@ instead of fixed histogram buckets.  `DecodeEngine._finish_request`
 appends one record per completed request:
 
     {ts, seq, name: "request", traceparent?, request_id, finish,
-     bucket, prompt_tokens, output_tokens,
+     bucket, replica, version                     (which engine, which
+                                                   deployment version),
+     prompt_tokens, output_tokens,
      kv_blocks, prefix_blocks, prefix_tokens, prefill_chunks,
      preemptions                                  (paged KV cache),
-     migrations, migrated_tokens                  (KV-block migration),
+     migrations, migrated_tokens,
+     migrated_from, path                          (KV-block migration:
+                                                   the prefill-side
+                                                   origin id + the
+                                                   fabric path taken),
      draft_tokens, accepted_tokens, spec_steps    (speculative decode),
      arrival_ts/admitted_ts/first_token_ts/done_ts           (epoch),
      arrival_mono/admitted_mono/first_token_mono/done_mono   (monotonic),
-     queue_wait_s, ttft_s, tpot_s}
+     queue_wait_s, ttft_s, tpot_s,
+     router_wait_s/prefill_s/handoff_wire_s/decode_first_s/decode_rest_s
+                                    (per-phase TTFT decomposition)}
 
 ``RECORD_FIELDS`` is the authoritative record schema:
 `tools/check_telemetry_names.py` verifies that every field
 docs/observability.md's ledger table names exists here, and vice versa
 — the ledger docs stay honest as fields are added.
 
-``finish`` is one of ``done | cancelled | rejected | error | drained``
-(drained = the engine shut down with the request still in flight;
-rejected = refused at submit — empty or over-length prompt).
+``finish`` is one of ``done | cancelled | rejected | error | drained |
+migrated`` (drained = the engine shut down with the request still in
+flight; rejected = refused at submit — empty or over-length prompt;
+migrated = the prompt-owning engine exported the KV and the request
+lives on at the decode replica, whose record joins back through
+``migrated_from``).
 Durability is
 the flight recorder's (telemetry/events.py): explicit flush per append,
 size-capped rotation to ``<path>.1`` keeping the newest records, a torn
@@ -55,23 +66,38 @@ RECORD_NAME = "request"
 # fields" table in sync — tools/check_telemetry_names.py enforces it.
 RECORD_FIELDS = (
     "request_id", "finish", "tenant", "adapter_id", "bucket",
+    "replica", "version",
     "prompt_tokens", "output_tokens",
     "kv_blocks", "prefix_blocks", "prefix_tokens", "prefill_chunks",
     "preemptions",
-    "migrations", "migrated_tokens",
+    "migrations", "migrated_tokens", "migrated_from", "path",
     "draft_tokens", "accepted_tokens", "spec_steps",
     "arrival_ts", "admitted_ts", "first_token_ts", "done_ts",
     "arrival_mono", "admitted_mono", "first_token_mono", "done_mono",
     "queue_wait_s", "ttft_s", "tpot_s",
+    "router_wait_s", "prefill_s", "handoff_wire_s",
+    "decode_first_s", "decode_rest_s",
 )
+
+# the five lifecycle phases every finishing record decomposes its wall
+# into (tik_serve_phase_seconds is the fleet histogram twin; `tik serve
+# explain` renders them per request).  They telescope: the non-None
+# phases sum to the record's wall (done - arrival) up to clock skew on
+# cross-host handoffs.
+PHASE_FIELDS = ("router_wait_s", "prefill_s", "handoff_wire_s",
+                "decode_first_s", "decode_rest_s")
 
 FINISH_DONE = "done"
 FINISH_CANCELLED = "cancelled"
 FINISH_REJECTED = "rejected"
 FINISH_ERROR = "error"
 FINISH_DRAINED = "drained"
+# the prompt-owning engine exported the KV and the request lives on at
+# the decode replica — a lifecycle milestone, not a terminal outcome,
+# so it spends no availability budget (not in the denominator below)
+FINISH_MIGRATED = "migrated"
 FINISH_REASONS = (FINISH_DONE, FINISH_CANCELLED, FINISH_REJECTED,
-                  FINISH_ERROR, FINISH_DRAINED)
+                  FINISH_ERROR, FINISH_DRAINED, FINISH_MIGRATED)
 
 
 def default_path() -> str:
@@ -128,6 +154,7 @@ def record(req, finish: str) -> None:
     journal = _SLOT.journal
     if journal is None:
         return
+    engine = getattr(req, "_engine", None)
     fields: Dict[str, Any] = {
         "request_id": req.request_id,
         "finish": finish,
@@ -137,6 +164,11 @@ def record(req, finish: str) -> None:
         "tenant": getattr(req, "tenant", "default"),
         "adapter_id": getattr(req, "adapter_id", None),
         "bucket": getattr(req, "bucket", None),
+        # which engine finished the request, and which deployment
+        # version it ran — `tik serve requests --fleet` merges many
+        # replicas' ledgers, so the record must say whose it is
+        "replica": getattr(engine, "replica_id", None),
+        "version": getattr(engine, "version", None),
         "prompt_tokens": len(req.prompt),
         "output_tokens": len(req.tokens),
         # paged KV cache accounting (serve/kvcache.py)
@@ -149,6 +181,14 @@ def record(req, finish: str) -> None:
         # prefill/decode: tokens whose KV was imported, not recomputed)
         "migrations": getattr(req, "migrations", None),
         "migrated_tokens": getattr(req, "migrated_tokens", None),
+        # cross-process join key: the prefill-side request id this one
+        # continued from (None = never migrated) — `tik serve explain`
+        # stitches the prefill replica's "migrated" record through it
+        "migrated_from": getattr(req, "migrated_from", None),
+        # which fabric path finished it: migrated | fallback | None
+        # (plain/monolithic) — the replica-side echo of the router
+        # ledger's decision path
+        "path": getattr(req, "fabric_path", None),
         # speculative decoding (EngineConfig.spec draft/verify loop)
         "draft_tokens": getattr(req, "draft_tokens", None),
         "accepted_tokens": getattr(req, "accepted_tokens", None),
@@ -163,6 +203,7 @@ def record(req, finish: str) -> None:
         "done_mono": getattr(req, "done_mono", None),
     }
     fields.update(derive_latencies(fields))
+    fields.update(derive_phases(req))
     # the record carries the REQUEST's trace (the submit-side span),
     # not whatever ambient context the finishing thread happens to
     # hold — `tik serve requests` joins `tik cluster trace export`
@@ -186,6 +227,61 @@ def derive_latencies(fields: Dict[str, Any]) -> Dict[str, Any]:
         out["ttft_s"] = max(first - arrival, 0.0)
     if first is not None and done is not None and out_tokens > 1:
         out["tpot_s"] = max(done - first, 0.0) / (out_tokens - 1)
+    return out
+
+
+def derive_phases(req) -> Dict[str, Any]:
+    """The five-phase TTFT decomposition from the request's stamps.
+
+    Telescoping by construction, so the non-None phases sum to the
+    record's wall.  Two shapes:
+
+    * plain / monolithic (no ``import_mono``): router_wait = submit ->
+      slot admission, prefill = admission -> first token (or -> KV
+      export start for a prefill-side "migrated" record), decode_rest =
+      first token -> done — all from the local monotonic stamps.
+    * migrated-in decode side (``import_mono`` present): the prefill
+      half rides the migration header's WALL stamps (prefill_admitted /
+      export_started — the same skew-bounded cross-host discipline as
+      `request_from_header`'s created back-dating, exact in-process),
+      handoff_wire = export start -> import arrival, and the decode
+      half (decode_first/decode_rest) is local monotonic again.
+
+    A fabric-fallback request has its admission stamps reset at the
+    tear and re-stamped by the decode engine, so it takes the plain
+    shape — the torn prefill attempt books into router_wait (`tik serve
+    explain` names the tear from the router ledger instead).
+    """
+    out: Dict[str, Any] = {f: None for f in PHASE_FIELDS}
+    first = getattr(req, "first_token_mono", None)
+    done = getattr(req, "done_mono", None)
+    import_mono = getattr(req, "import_mono", None)
+    if import_mono is not None:
+        arrival_ts = getattr(req, "created", None)
+        admitted_ts = getattr(req, "prefill_admitted_ts", None)
+        export_ts = getattr(req, "export_started_ts", None)
+        import_ts = getattr(req, "import_ts", None)
+        if arrival_ts is not None and admitted_ts is not None:
+            out["router_wait_s"] = max(admitted_ts - arrival_ts, 0.0)
+        if admitted_ts is not None and export_ts is not None:
+            out["prefill_s"] = max(export_ts - admitted_ts, 0.0)
+        if export_ts is not None and import_ts is not None:
+            out["handoff_wire_s"] = max(import_ts - export_ts, 0.0)
+        if first is not None:
+            out["decode_first_s"] = max(first - import_mono, 0.0)
+    else:
+        arrival = getattr(req, "created_mono", None)
+        admitted = getattr(req, "admitted_mono", None)
+        # a prefill-side "migrated" record never decodes: its prefill
+        # phase ends where the KV export began
+        prefill_end = first if first is not None \
+            else getattr(req, "export_mono", None)
+        if arrival is not None and admitted is not None:
+            out["router_wait_s"] = max(admitted - arrival, 0.0)
+        if admitted is not None and prefill_end is not None:
+            out["prefill_s"] = max(prefill_end - admitted, 0.0)
+    if first is not None and done is not None:
+        out["decode_rest_s"] = max(done - first, 0.0)
     return out
 
 
@@ -230,7 +326,9 @@ def compute_stats(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     Availability = done / (done + error + drained): cancellations and
     submit-time rejections are client-caused, so they consume no error
     budget — the same exclusion the `serve-availability` SLO applies
-    to the `result` counter labels (telemetry/slo.py).
+    to the `result` counter labels (telemetry/slo.py).  A "migrated"
+    record is a lifecycle milestone (the request finished elsewhere),
+    so it spends nothing either.
     """
     finish: Dict[str, int] = {}
     for rec in records:
@@ -244,7 +342,7 @@ def compute_stats(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "finish": dict(sorted(finish.items())),
         "availability": served / denominator if denominator else None,
     }
-    for field in ("ttft_s", "queue_wait_s", "tpot_s"):
+    for field in ("ttft_s", "queue_wait_s", "tpot_s") + PHASE_FIELDS:
         values = [float(rec[field]) for rec in records
                   if isinstance(rec.get(field), (int, float))]
         stats[field] = {

@@ -52,6 +52,14 @@ locality, not connection counts:
   degrades to the plain path (``tik_serve_fabric_requests_total
   {path="direct"}``); greedy output is bit-identical either way.
 
+* **Decision ledger (serve/routerlog.py).**  Every routed request
+  appends one durable record at completion — the unfiltered ring
+  primary vs the replica that actually served it, the decision path
+  (affinity | spill_load | spill_drain | failover | fabric_migrated |
+  fabric_fallback | direct), per-hop WHY sentences and monotonic
+  stamps, retries and excluded replicas — so ``tik serve explain``
+  can replay the router's reasoning for one request after the fact.
+
 Transports are pluggable :class:`ReplicaClient`s: :class:`HttpReplica`
 (stdlib HTTP to a tik-serve instance) for the real fabric,
 :class:`EngineReplica` (in-process `DecodeEngine`) for benches and the
@@ -74,7 +82,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from cloudtik_tpu import telemetry
 from cloudtik_tpu.faults import seams
 from cloudtik_tpu.faults.plan import FaultInjected
-from cloudtik_tpu.serve import kvcache
+from cloudtik_tpu.serve import kvcache, routerlog
 from cloudtik_tpu.serve.replicas import (
     ROLE_PREFILL, ReplicaAutoscaler, ReplicaRegistry)
 from cloudtik_tpu.telemetry import instruments as ti
@@ -281,6 +289,11 @@ class EngineReplica(ReplicaClient):
     def __init__(self, replica_id: str, engine):
         self.replica_id = replica_id
         self.engine = engine
+        # the engine's ledger records carry the replica identity —
+        # `tik serve requests --fleet` merges many replicas' ledgers
+        # and needs to know whose each record is
+        if getattr(engine, "replica_id", None) is None:
+            engine.replica_id = replica_id
         self._dead = False
         self._draining = False
         self._lock = threading.Lock()
@@ -466,6 +479,10 @@ class Router:
         # the role at all.
         self._prefill: List[str] = []
         self._has_prefill_role = False
+        # replica_id -> deployment version label (registry-sourced):
+        # the decision ledger stamps each hop with the version it hit,
+        # so a bad rollout shows up in `tik serve explain` output
+        self._versions: Dict[str, str] = {}
         self._inflight: Dict[str, int] = {}
         self._probe_fails: Dict[str, int] = {}
         self._rr = 0
@@ -500,6 +517,8 @@ class Router:
                               if info.role != ROLE_PREFILL)
             self._prefill = sorted(rid for rid, info in infos.items()
                                    if info.role == ROLE_PREFILL)
+            self._versions.update(
+                (rid, info.version) for rid, info in infos.items())
             if routable != self._routable:
                 self._routable = routable
                 self._ring = HashRing(routable, self.config.vnodes)
@@ -580,10 +599,16 @@ class Router:
         return max(1, math.ceil(
             self.config.load_factor * (total + 1) / max(n, 1)))
 
-    def _pick(self, key_hash: int, excluded: set) -> Tuple[
+    def _pick(self, key_hash: int, excluded: set,
+              out: Optional[Dict[str, Any]] = None) -> Tuple[
             ReplicaClient, bool]:
         """(client, is_primary): the affinity primary unless bounded
-        load or exclusion walks the ring past it."""
+        load or exclusion walks the ring past it.
+
+        ``out`` (when the decision ledger is live) receives the pick's
+        WHY: {primary: the unfiltered ring head, why: one operator
+        sentence, spill: "load"|None} — computed only when asked for,
+        so the disabled-telemetry path never builds strings."""
         with self._lock:
             routable = [r for r in self._routable if r not in excluded]
             clients = dict(self._clients)
@@ -597,6 +622,9 @@ class Router:
             with self._lock:
                 self._rr += 1
                 rid = routable[self._rr % len(routable)]
+            if out is not None:
+                out.update(primary=None, spill=None,
+                           why="round-robin policy pick")
             return clients[rid], True
         # the affinity primary is the ring's first pick BEFORE this
         # request's exclusions: a failover landing on the ring-second
@@ -612,11 +640,42 @@ class Router:
             if inflight.get(rid, 0) + 1 <= bound:
                 if i > 0:
                     ti.SERVE_ROUTER_SPILLS.inc(reason="load")
+                if out is not None:
+                    out.update(primary=primary_rid,
+                               spill="load" if i > 0 else None,
+                               why=self._pick_why(
+                                   rid, primary_rid, excluded, i,
+                                   bound, inflight))
                 return clients[rid], rid == primary_rid
         # everyone over the bound (a burst mid-flight): least loaded
         rid = min(preference, key=lambda r: inflight.get(r, 0))
         ti.SERVE_ROUTER_SPILLS.inc(reason="load")
+        if out is not None:
+            out.update(primary=primary_rid, spill="load",
+                       why=(f"every candidate over the bounded-load "
+                            f"cap ({bound} in flight): least-loaded "
+                            f"fallback"))
         return clients[rid], rid == primary_rid
+
+    @staticmethod
+    def _pick_why(rid: str, primary_rid: Optional[str], excluded: set,
+                  walk: int, bound: int,
+                  inflight: Dict[str, int]) -> str:
+        """One operator sentence for the decision ledger: why THIS
+        replica took the request."""
+        if rid == primary_rid:
+            return ("chain-key ring primary (prefix blocks warm for "
+                    "this prompt's chain)")
+        if primary_rid in excluded:
+            return (f"ring primary {primary_rid} excluded after an "
+                    f"earlier failed attempt; next survivor in ring "
+                    f"order")
+        if walk > 0:
+            return (f"ring primary {primary_rid} over the "
+                    f"bounded-load cap ({inflight.get(primary_rid, 0)}"
+                    f" in flight, cap {bound}): spilled {walk} "
+                    f"step{'s' if walk > 1 else ''} down the ring")
+        return f"first routable replica in ring order after {primary_rid}"
 
     def _pick_prefill(self, excluded: set,
                       decode_client: ReplicaClient
@@ -659,14 +718,28 @@ class Router:
 
         prompt_heavy = (len(prompt)
                         >= self.config.prefill_len_threshold)
+        # the decision ledger (None with telemetry off or no journal
+        # installed — every downstream stamp is then one None test)
+        trail = routerlog.begin(payload.get("request_id"),
+                                str(payload.get("tenant", "default")),
+                                len(prompt), key_hash, prompt_heavy,
+                                traceparent)
 
         def attempt() -> Dict[str, Any]:
-            client, primary = self._pick(key_hash, excluded)
+            pick_info = {} if trail is not None else None
+            client, primary = self._pick(key_hash, excluded,
+                                         out=pick_info)
             rid = client.replica_id
             pclient = None
             if prompt_heavy:
                 pclient = self._pick_prefill(excluded, client)
             prid = pclient.replica_id if pclient is not None else None
+            hop = None
+            if trail is not None:
+                hop = trail.start_hop(
+                    rid, prid, primary, pick_info.get("primary"),
+                    pick_info.get("why"), pick_info.get("spill"),
+                    self._versions.get(rid))
             # a fabric hop charges both ends: the decode replica does
             # the lasting work (its count drives the bounded-load
             # walk), the prefill count drives the least-loaded
@@ -692,17 +765,26 @@ class Router:
                                         decode_replica=rid):
                         fire_forward_seam(prid,
                                           payload.get("request_id"))
-                        return pclient.forward_to(
+                        out = pclient.forward_to(
                             payload, client,
                             self.config.request_deadline_s,
                             traceparent=traceparent)
+                    if hop is not None:
+                        # which fabric path actually finished it —
+                        # migrated / fallback from the result, nothing
+                        # for a prefill-local early exit
+                        fp = out.get("fabric_path")
+                        trail.end_hop(hop, fabric=fp if fp in (
+                            "migrated", "fallback") else None)
+                    return out
                 with telemetry.span("serve.router.forward",
                                     replica=rid, primary=primary):
                     fire_forward_seam(rid, payload.get("request_id"))
                     out = client.forward(
                         payload, self.config.request_deadline_s,
                         traceparent=traceparent)
-                if prompt_heavy and self._has_prefill_role:
+                direct = prompt_heavy and self._has_prefill_role
+                if direct:
                     # the fabric HAS the role but could not use it for
                     # this request (killed/draining/already-failed
                     # prefill, or a decode target without a receiver).
@@ -710,17 +792,28 @@ class Router:
                     # the three paths sum to completed prompt-heavy
                     # requests — a retried attempt must not book twice
                     ti.SERVE_FABRIC_REQUESTS.inc(path="direct")
+                if hop is not None:
+                    trail.end_hop(hop,
+                                  fabric="direct" if direct else None)
                 return out
             except ReplicaDraining as e:
-                excluded.add(_failed_replica(e, prid, rid))
+                failed = _failed_replica(e, prid, rid)
+                excluded.add(failed)
                 last_error[0] = e
                 ti.SERVE_ROUTER_SPILLS.inc(reason="drain")
+                if hop is not None:
+                    trail.end_hop(hop, error=e, kind="drain",
+                                  excluded=failed)
                 raise
             except (ReplicaUnavailable, ConnectionError, TimeoutError,
                     OSError, FaultInjected) as e:
-                excluded.add(_failed_replica(e, prid, rid))
+                failed = _failed_replica(e, prid, rid)
+                excluded.add(failed)
                 last_error[0] = e
                 ti.SERVE_ROUTER_FAILOVERS.inc()
+                if hop is not None:
+                    trail.end_hop(hop, error=e, kind="failover",
+                                  excluded=failed)
                 raise
             finally:
                 with self._lock:
@@ -752,6 +845,7 @@ class Router:
                 exc, (ReplicaDraining, NoRoutableReplica,
                       ReplicaRejected)) else "error"
             ti.SERVE_ROUTER_REQUESTS.inc(result=result)
+            routerlog.record(trail, result)
             raise exc
 
         policy = dataclasses.replace(self.config.retry,
@@ -770,6 +864,7 @@ class Router:
         except Exception as e:
             _surface(e)
         ti.SERVE_ROUTER_REQUESTS.inc(result="ok")
+        routerlog.record(trail, routerlog.OUTCOME_OK, result=result)
         return result
 
     # -- bench/drill submit surface (DecodeEngine-compatible) -------------
@@ -821,6 +916,7 @@ class Router:
                 "replica_id": info.replica_id,
                 "url": info.url,
                 "role": info.role,
+                "version": info.version,
                 "slots": info.slots,
                 "routable": info.replica_id in routable,
                 "draining": info.draining,
@@ -876,6 +972,21 @@ class RouterServer:
                     self._send(200, {"status": "ok"})
                 elif self.path == "/v1/replicas":
                     self._send(200, router.describe())
+                elif self.path.startswith("/v1/explain"):
+                    # the router-side half of `tik serve explain
+                    # --url`: this process holds the decision ledger
+                    # (replica request ledgers live on their own
+                    # hosts — stitch those with --reqlog files)
+                    from urllib.parse import parse_qs, urlparse
+                    from cloudtik_tpu.serve import explain as _explain
+                    query = parse_qs(urlparse(self.path).query)
+                    rid = (query.get("request_id") or [None])[0]
+                    if rid is None:
+                        self._send(400,
+                                   {"error": "request_id required"})
+                        return
+                    routes = routerlog.read_routes()
+                    self._send(200, _explain.build(rid, routes, []))
                 else:
                     self._send(404, {"error": "not found"})
 
@@ -950,7 +1061,20 @@ def main(argv=None) -> int:
     p.add_argument("--probe-failures", type=int, default=3)
     p.add_argument("--policy", choices=["affinity", "round_robin"],
                    default="affinity")
+    p.add_argument("--router-log", default=None,
+                   help="router decision ledger path (default "
+                        "TIK_ROUTER_LOG_PATH or "
+                        "~/.tik/logs/serve-router.jsonl)")
     args = p.parse_args(argv)
+
+    # daemon boot installs the decision ledger (libraries never do);
+    # the router appends one durable record per routed request
+    # (TIK_ROUTER_LOG_PATH / --router-log override the default path)
+    try:
+        routerlog.install(args.router_log)
+    except OSError:
+        logger.warning("router decision ledger not installed",
+                       exc_info=True)
 
     backend_kw = {}
     if args.state_port is not None:

@@ -537,6 +537,12 @@ def main(argv=None) -> int:
                    help="head state server holding the replica "
                         "registry")
     p.add_argument("--state-port", type=int, default=None)
+    p.add_argument("--version", default="0",
+                   help="deploy version label for this replica; shows "
+                        "in `tik serve replicas` and is stamped on "
+                        "every router-ledger and request-ledger "
+                        "record, so rollout forensics can split "
+                        "latency by version")
     p.add_argument("--advertise-url", default=None,
                    help="URL the router should reach this replica at "
                         "(default http://<host>:<port>)")
@@ -611,8 +617,14 @@ def main(argv=None) -> int:
         role = "engine"
         stats_fn = None
         if engine is not None:
+            # stamp forensics identity on the engine so every request
+            # ledger record says who served it, and at which version
+            engine.replica_id = args.replica_id
+            engine.version = args.version
             if hasattr(engine, "prefill"):       # DisaggServing pair
                 role, stats_fn = "prefill", engine.prefill.stats
+                engine.prefill.replica_id = args.replica_id
+                engine.prefill.version = args.version
             else:
                 stats_fn = engine.stats
         # a wildcard bind address is not a reachable URL — a router on
@@ -626,7 +638,8 @@ def main(argv=None) -> int:
             f"http://{advertise_host}:{server.port}"
         beater = ReplicaHeartbeat(
             registry, args.replica_id, url, role=role,
-            slots=args.slots, stats_fn=stats_fn)
+            slots=args.slots, stats_fn=stats_fn,
+            version=args.version)
         beater.start()
 
     stop_event = threading.Event()

@@ -101,6 +101,7 @@ SERVE_FABRIC_REQUESTS: Counter = _build(
     "tik_serve_fabric_requests_total")
 SERVE_FABRIC_HANDOFF_SECONDS: Histogram = _build(
     "tik_serve_fabric_handoff_seconds")
+SERVE_PHASE_SECONDS: Histogram = _build("tik_serve_phase_seconds")
 
 # serve multi-tenant LoRA (serve/adapters.py pool + tenant SLO substrate)
 SERVE_TENANT_REQUESTS: Counter = _build("tik_serve_tenant_requests_total")

@@ -207,6 +207,15 @@ _ALL = [
        "header/blocks/commit stream to the decode replica's migration "
        "receiver (the DCN cost of disaggregation).", "serve", (),
        LATENCY_BUCKETS),
+    _m("tik_serve_phase_seconds", "histogram",
+       "Per-request lifecycle phase decomposition, observed once at "
+       "the finishing engine's completion point (router_wait = submit "
+       "-> slot admission; prefill = admission -> prefill done on the "
+       "prompt-owning engine; handoff_wire = socket KV handoff wall; "
+       "decode_first = handoff arrival -> first decode-side token; "
+       "decode_rest = first token -> done).  Sums to the request wall "
+       "— the per-fleet twin of `tik serve explain`.", "serve",
+       ("phase",), LATENCY_BUCKETS),
     # -- serve multi-tenant LoRA (serve/adapters.py + tenant SLOs) --------
     _m("tik_serve_tenant_requests_total", "counter",
        "Serve requests finished, by tenant and result — the per-tenant "

@@ -745,8 +745,10 @@ def test_drill_replica_killed_mid_traffic_fails_over(tmp_path):
         group = groups[i % 3]
         prompts.append(group + [100 + i])          # shared prefix + tail
 
+    from cloudtik_tpu.serve import routerlog
     events.install(str(tmp_path / "events.jsonl"))
     reqlog.install(str(tmp_path / "req.jsonl"))
+    routerlog.install(str(tmp_path / "router.jsonl"))
     failovers_before = ti.SERVE_ROUTER_FAILOVERS.value()
     router.start()
     try:
@@ -793,6 +795,7 @@ def test_drill_replica_killed_mid_traffic_fails_over(tmp_path):
             sorted(r.replica_id for r in survivors)
     finally:
         router.stop()
+        routerlog.uninstall()
         reqlog.uninstall()
         events.uninstall()
         for replica in replicas:
@@ -822,6 +825,42 @@ def test_drill_replica_killed_mid_traffic_fails_over(tmp_path):
     assert condemned and condemned[0]["replica"] == victim_id
     assert drill_trace in (condemned[0].get("traceparent") or "")
     assert decisions and decisions[0]["action"] == "add_replica"
+
+    # request forensics: the router's decision ledger names the
+    # failover — `tik serve explain` on a failed-over request shows
+    # the failed hop, the excluded victim, and a phase decomposition
+    # that sums to the finishing record's wall (within 5%)
+    from click.testing import CliRunner
+
+    from cloudtik_tpu.scripts.cli import cli
+    from cloudtik_tpu.serve import explain as sexplain
+    from cloudtik_tpu.serve import routerlog as _routerlog
+    routes = _routerlog.read_routes(str(tmp_path / "router.jsonl"))
+    assert len(routes) >= len(prompts)
+    failed_over = [r for r in routes
+                   if r["outcome"] == "ok" and r["retries"] > 0]
+    assert failed_over, "no failed-over route record written"
+    route = failed_over[0]
+    assert route["path"] == "failover"
+    assert victim_id in route["excluded"]
+    assert any(h.get("kind") == "failover"
+               and h.get("excluded") == victim_id
+               for h in route["hops"])
+    assert drill_trace in (route.get("traceparent") or "")
+    built = sexplain.build(route["request_id"], routes, records)
+    assert built["finishing"] is not None
+    assert built["finishing"]["finish"] == "done"
+    assert built["critical_phase"] is not None
+    assert built["phase_coverage"] == pytest.approx(1.0, abs=0.05)
+    result = CliRunner().invoke(cli, [
+        "serve", "explain", str(route["request_id"]),
+        "--path", str(tmp_path / "router.jsonl"),
+        "--reqlog", str(tmp_path / "req.jsonl")])
+    assert result.exit_code == 0, result.output
+    assert "path=failover" in result.output
+    assert f"excluded after failures: {victim_id}" in result.output
+    assert "FAILED (failover" in result.output
+    assert "<- critical path" in result.output
     assert drill_trace in (decisions[0].get("traceparent") or "")
     assert all(drill_trace in (r.get("traceparent") or "")
                for r in done)

@@ -438,6 +438,82 @@ class TestTornMigration:
         assert reqlog.compute_stats(records)["availability"] == 1.0
 
 
+# ------------------------------------------ forensics: serve explain --
+
+class TestFabricExplain:
+    def test_explain_stitches_migrated_request_into_one_timeline(
+            self, model, tmp_path):
+        """The forensics acceptance: a prompt-heavy request through the
+        disaggregated fabric leaves a router decision record, the
+        prefill replica's `migrated` milestone, and the decode
+        replica's finishing record — `tik serve explain` joins them
+        into ONE timeline whose five phases sum to within 5% of the
+        finishing record's wall, names the decision per hop, and flags
+        the critical-path phase."""
+        from cloudtik_tpu import telemetry
+        from cloudtik_tpu.serve import explain as sexplain
+        from cloudtik_tpu.serve import reqlog, routerlog
+
+        prefill = make_prefill(model)
+        decode = make_decode(model)
+        router = make_fabric_router([prefill], [decode])
+        router_path = str(tmp_path / "router.jsonl")
+        req_path = str(tmp_path / "req.jsonl")
+        routerlog.install(router_path)
+        reqlog.install(req_path)
+        tp = "00-" + "e" * 32 + "-" + "4" * 16 + "-01"
+        try:
+            with telemetry.trace_context(tp):
+                prompt = list(range(3, 30))        # 27 tokens: heavy
+                out = router.handle({"tokens": prompt,
+                                     "max_new_tokens": 8,
+                                     "request_id": 555})
+            assert out["tokens"][0] == reference(model, prompt, 8)[-8:]
+        finally:
+            routerlog.uninstall()
+            reqlog.uninstall()
+            prefill.stop()
+            decode.stop()
+
+        routes = routerlog.read_routes(router_path)
+        requests = reqlog.read_requests(req_path)
+        built = sexplain.build(555, routes, requests)
+        route = built["route"]
+        assert route is not None
+        assert route["outcome"] == "ok"
+        assert route["path"] == "fabric_migrated"
+        assert route["prefill_replica"] == "p0"
+        assert route["replica"] == "d0"
+        assert "prompt-heavy" in route["why"] and "p0" in route["why"]
+        assert route["hops"][-1]["fabric"] == "migrated"
+        # prefill milestone + decode finishing record, both replicas
+        finishes = [r["finish"] for r in built["records"]]
+        assert "migrated" in finishes
+        assert built["finishing"]["finish"] == "done"
+        assert built["finishing"]["replica"] == "d0"
+        assert built["finishing"]["path"] == "migrated"
+        milestone = next(r for r in built["records"]
+                         if r["finish"] == "migrated")
+        assert milestone["replica"] == "p0"
+        assert built["finishing"]["migrated_from"] == \
+            milestone["request_id"]
+        # all five phases recorded, in wall order, summing to the
+        # finishing record's wall within 5%
+        for field in reqlog.PHASE_FIELDS:
+            value = built["phases"][field]
+            assert value is not None and value >= 0.0, field
+        assert [t[0] for t in built["timeline"]] == \
+            list(reqlog.PHASE_FIELDS)
+        assert built["phase_coverage"] == pytest.approx(1.0, abs=0.05)
+        assert built["critical_phase"] is not None
+        text = sexplain.render(built)
+        assert "path=fabric_migrated" in text
+        assert "served via migrated" in text
+        assert "why:" in text
+        assert "<- critical path" in text
+        assert "of the finishing record's wall" in text
+
+
 # ------------------------------------------- chaos: prefill-role kill --
 
 class TestPrefillKillDrill:

@@ -1,0 +1,349 @@
+"""Router decision ledger (serve/routerlog.py) + the cross-replica
+stitcher (serve/explain.py): one durable record per routed request
+with the per-hop WHY, torn-final-line skip through the
+`serve.router.record` seam, the disabled path staying attribute-check
+cheap (tripwire), and `tik serve explain` / `tik serve requests
+--fleet` joining the router's story with replica request ledgers."""
+
+from __future__ import annotations
+
+import json
+import types
+
+import pytest
+
+from cloudtik_tpu import telemetry
+from cloudtik_tpu.faults import seams
+from cloudtik_tpu.faults.plan import FaultPlan, FaultPoint
+from cloudtik_tpu.serve import explain as sexplain
+from cloudtik_tpu.serve import reqlog, routerlog
+from cloudtik_tpu.serve.router import (
+    ReplicaUnavailable, Router, RouterConfig, chain_hash)
+from tests.test_router import FakeReplica, make_registry, make_router
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path):
+    telemetry.enable()
+    telemetry.reset()
+    seams.disarm()
+    yield
+    routerlog.uninstall()
+    reqlog.uninstall()
+    seams.disarm()
+    telemetry.enable()
+    telemetry.reset()
+
+
+def _primary_prompt(router: Router, target: str, block: int = 4):
+    """A prompt whose chain-key ring primary is `target`."""
+    for base in range(500):
+        prompt = [base, base + 1, base + 2, base + 3]
+        if router._ring.preference(
+                chain_hash(prompt, block))[0] == target:
+            return prompt
+    raise AssertionError(f"no prompt maps to {target}")
+
+
+# ------------------------------------------------------------- records --
+
+class TestLedgerRecords:
+    def test_affinity_record_schema(self, tmp_path):
+        routerlog.install(str(tmp_path / "router.jsonl"))
+        replicas = [FakeReplica(f"r{i}") for i in range(3)]
+        router = make_router(replicas)
+        router.handle({"tokens": [1, 2, 3, 4], "request_id": 77,
+                       "tenant": "acme"})
+        routes = routerlog.read_routes(str(tmp_path / "router.jsonl"))
+        assert len(routes) == 1
+        rec = routes[0]
+        assert rec["name"] == routerlog.RECORD_NAME
+        # the schema is exactly ROUTER_RECORD_FIELDS (+ the journal
+        # envelope) — the same contract the checker enforces vs docs
+        assert set(routerlog.ROUTER_RECORD_FIELDS) <= set(rec)
+        assert rec["outcome"] == routerlog.OUTCOME_OK
+        assert rec["path"] == "affinity"
+        assert rec["path"] in routerlog.PATHS
+        assert "ring primary" in rec["why"]
+        assert rec["client_request_id"] == 77
+        assert rec["request_id"] == 1          # FakeReplica's result id
+        assert rec["tenant"] == "acme"
+        assert rec["prompt_tokens"] == 4
+        assert rec["replica"] in {r.replica_id for r in replicas}
+        assert rec["primary"] == rec["replica"]
+        assert len(rec["key"]) == 16
+        assert rec["retries"] == 0 and rec["excluded"] == []
+        assert len(rec["hops"]) == 1
+        hop = rec["hops"][0]
+        assert hop["replica"] == rec["replica"]
+        assert hop["end_mono"] >= hop["start_mono"]
+        assert rec["wall_s"] >= 0.0
+
+    def test_failover_record_names_the_lost_replica(self, tmp_path):
+        routerlog.install(str(tmp_path / "router.jsonl"))
+        dead = FakeReplica("r0", fail_with=ReplicaUnavailable("down"))
+        live = FakeReplica("r1")
+        router = make_router([dead, live])
+        prompt = _primary_prompt(router, "r0")
+        router.handle({"tokens": prompt})
+        rec = routerlog.read_routes(
+            str(tmp_path / "router.jsonl"))[0]
+        assert rec["outcome"] == "ok"
+        assert rec["path"] == "failover"
+        assert rec["excluded"] == ["r0"]
+        assert rec["retries"] == 1
+        assert rec["replica"] == "r1"
+        assert rec["primary"] == "r0"         # where affinity WANTED
+        assert "r0" in rec["why"]
+        failed, served = rec["hops"]
+        assert failed["kind"] == "failover"
+        assert failed["excluded"] == "r0"
+        assert "ReplicaUnavailable" in failed["error"]
+        assert served["replica"] == "r1" and served["error"] is None
+
+    def test_exhaustion_records_error_outcome(self, tmp_path):
+        routerlog.install(str(tmp_path / "router.jsonl"))
+        boom = ReplicaUnavailable("exploded")
+        router = make_router([FakeReplica(f"r{i}", fail_with=boom)
+                              for i in range(2)])
+        with pytest.raises(ReplicaUnavailable):
+            router.handle({"tokens": [1, 2, 3, 4]})
+        rec = routerlog.read_routes(
+            str(tmp_path / "router.jsonl"))[0]
+        assert rec["outcome"] == "error"
+        assert rec["request_id"] is None       # no result ever came
+        assert rec["retries"] == len(rec["hops"]) >= 2
+        assert sorted(rec["excluded"]) == ["r0", "r1"]
+
+    def test_registry_version_label_lands_on_the_record(
+            self, tmp_path):
+        routerlog.install(str(tmp_path / "router.jsonl"))
+        registry = make_registry()
+        replica = FakeReplica("r0")
+        router = make_router([replica], registry=registry)
+        registry.register("r0", "http://r0", slots=4, version="v2")
+        registry.beat("r0")
+        router.sync()
+        router.handle({"tokens": [1, 2, 3, 4]})
+        rec = routerlog.read_routes(
+            str(tmp_path / "router.jsonl"))[0]
+        assert rec["version"] == "v2"
+        assert router.describe()["replicas"][0]["version"] == "v2"
+
+
+# ---------------------------------------------------- durability + cost --
+
+class TestDurabilityAndDisabledPath:
+    def test_torn_final_line_skipped_via_seam(self, tmp_path):
+        path = str(tmp_path / "router.jsonl")
+        routerlog.install(path)
+        router = make_router([FakeReplica("r0")])
+        plan = FaultPlan([FaultPoint(seam="serve.router.record",
+                                     kind="torn_write", at_call=3)])
+        with seams.armed(plan):
+            for i in range(3):
+                router.handle({"tokens": [1, 2, 3, 4],
+                               "request_id": i})
+        assert plan.points[0].fired == 1
+        routes = routerlog.read_routes(path)
+        assert [r["client_request_id"] for r in routes] == [0, 1]
+        # the next append terminates the torn line; only IT was lost
+        router.handle({"tokens": [1, 2, 3, 4], "request_id": 3})
+        routes = routerlog.read_routes(path)
+        assert [r["client_request_id"] for r in routes] == [0, 1, 3]
+
+    def test_no_journal_means_no_trail(self):
+        assert routerlog.begin(1, "default", 4, 0, False, None) is None
+        routerlog.record(None, "ok")           # no-op, nothing raised
+
+    def test_disabled_telemetry_tripwire(self, tmp_path,
+                                         monkeypatch):
+        """TIK_TELEMETRY=off routing must never reach the journal —
+        begin() returns None on attribute checks alone, so an append
+        (patched to detonate) proves a hot-path regression."""
+        from cloudtik_tpu.telemetry import events as tevents
+        routerlog.install(str(tmp_path / "router.jsonl"))
+        router = make_router([FakeReplica("r0")])
+        telemetry.disable()
+        monkeypatch.setattr(
+            tevents.EventJournal, "append",
+            lambda *a, **k: (_ for _ in ()).throw(
+                AssertionError("disabled path touched the journal")))
+        out = router.handle({"tokens": [1, 2, 3, 4]})
+        assert out["tokens"] == [[7, 8, 9]]
+        telemetry.enable()
+        assert routerlog.read_routes(
+            str(tmp_path / "router.jsonl")) == []
+
+
+# -------------------------------------------------------- the stitcher --
+
+def _fake_req(request_id, *, replica, created_mono=100.0,
+              migrated_from=None, finish="done", traceparent=None,
+              phases=(0.02, 0.05, 0.01, 0.005, 0.03)):
+    """A terminal request record shaped like reqlog.record's output."""
+    total = sum(phases)
+    rec = {
+        "name": "request", "ts": created_mono, "request_id": request_id,
+        "finish": finish, "replica": replica, "version": "0",
+        "migrated_from": migrated_from, "traceparent": traceparent,
+        "arrival_mono": created_mono, "done_mono": created_mono + total,
+    }
+    for field, value in zip(reqlog.PHASE_FIELDS, phases):
+        rec[field] = value
+    return rec
+
+
+class TestExplain:
+    def test_build_joins_migrated_chain_and_flags_critical(self):
+        tp = "00-" + "a" * 32 + "-" + "1" * 16 + "-01"
+        route = {
+            "name": "route", "request_id": 9, "client_request_id": 5,
+            "outcome": "ok", "path": "fabric_migrated",
+            "why": "prompt-heavy: chunk-prefilled on p0",
+            "traceparent": tp, "primary": "d0", "replica": "d0",
+            "prefill_replica": "p0", "version": "0",
+            "excluded": [], "retries": 0, "wall_s": 0.115,
+            "hops": [{"replica": "d0", "prefill_replica": "p0",
+                      "primary": True, "primary_rid": "d0",
+                      "why": "chain-key ring primary", "spill": None,
+                      "version": "0", "fabric": "migrated",
+                      "kind": None, "error": None, "excluded": None,
+                      "start_mono": 100.0, "end_mono": 100.11}],
+        }
+        prefill = {"name": "request", "ts": 99.0, "request_id": 3,
+                   "finish": "migrated", "replica": "p0",
+                   "migrated_from": None, "traceparent": tp}
+        decode = _fake_req(9, replica="d0", migrated_from=3,
+                           traceparent=tp)
+        # a colliding id on ANOTHER trace must not join the story
+        alien = _fake_req(9, replica="dX",
+                          traceparent="00-" + "b" * 32
+                          + "-" + "2" * 16 + "-01")
+        built = sexplain.build(5, [route], [prefill, decode, alien])
+        assert built["route"] is route
+        assert [r["replica"] for r in built["records"]] == ["p0", "d0"]
+        assert built["finishing"] is decode
+        # phases in wall order, every field present, critical flagged
+        assert [t[0] for t in built["timeline"]] == \
+            list(reqlog.PHASE_FIELDS)
+        assert built["critical_phase"] == "prefill_s"
+        assert built["phase_sum_s"] == pytest.approx(0.115)
+        assert built["phase_coverage"] == pytest.approx(1.0)
+        text = sexplain.render(built)
+        assert "path=fabric_migrated" in text
+        assert "why:" in text and "chunk-prefilled" in text
+        assert "finish=migrated (milestone)" in text
+        assert "migrated_from=3" in text
+        assert "<- critical path" in text
+        assert "100.0% of the finishing record's wall" in text
+
+    def test_unknown_request_renders_not_found(self):
+        text = sexplain.render(sexplain.build(404, [], []))
+        assert "no router record" in text
+
+    def test_filter_trace_keeps_only_this_trace(self):
+        tp = "00-" + "c" * 32 + "-" + "3" * 16 + "-01"
+        trace = {"traceEvents": [
+            {"name": "serve.router.forward", "ph": "X",
+             "args": {"trace_id": "c" * 32}},
+            {"name": "serve.prefill", "ph": "X",
+             "args": {"trace_id": "f" * 32}},
+            {"name": "no-args", "ph": "X"},
+        ]}
+        narrowed = sexplain.filter_trace(trace, tp)
+        assert [e["name"] for e in narrowed["traceEvents"]] == \
+            ["serve.router.forward"]
+        assert sexplain.filter_trace(trace, None)["traceEvents"] == []
+
+
+# ------------------------------------------------------------------ CLI --
+
+class TestExplainCLI:
+    def test_explain_renders_a_routed_request(self, tmp_path):
+        from click.testing import CliRunner
+
+        from cloudtik_tpu.scripts.cli import cli
+        router_path = str(tmp_path / "router.jsonl")
+        routerlog.install(router_path)
+        dead = FakeReplica("r0", fail_with=ReplicaUnavailable("down"))
+        live = FakeReplica("r1")
+        router = make_router([dead, live])
+        prompt = _primary_prompt(router, "r0")
+        router.handle({"tokens": prompt, "request_id": 42})
+        routerlog.uninstall()
+        result = CliRunner().invoke(
+            cli, ["serve", "explain", "42", "--path", router_path,
+                  "--reqlog", str(tmp_path / "empty.jsonl")])
+        assert result.exit_code == 0, result.output
+        assert "path=failover" in result.output
+        assert "excluded after failures: r0" in result.output
+        assert "why:" in result.output
+        assert "no finishing record" in result.output
+        as_json = CliRunner().invoke(
+            cli, ["serve", "explain", "42", "--path", router_path,
+                  "--reqlog", str(tmp_path / "empty.jsonl"),
+                  "--json"])
+        assert json.loads(as_json.output)["route"]["path"] == \
+            "failover"
+
+    def test_router_server_explain_endpoint(self, tmp_path):
+        import urllib.error
+        import urllib.request
+
+        from cloudtik_tpu.serve.router import RouterServer
+        routerlog.install(str(tmp_path / "router.jsonl"))
+        router = make_router([FakeReplica("r0")])
+        router.handle({"tokens": [1, 2, 3, 4], "request_id": 11})
+        front = RouterServer(router, host="127.0.0.1", port=0)
+        front.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{front.port}/v1/explain"
+                    "?request_id=11", timeout=10) as resp:
+                result = json.loads(resp.read().decode())
+            assert result["route"]["path"] == "affinity"
+            assert result["route"]["client_request_id"] == 11
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{front.port}/v1/explain",
+                    timeout=10)
+            assert err.value.code == 400
+        finally:
+            front.stop()
+
+    def test_requests_fleet_merges_and_splits_by_replica(
+            self, tmp_path):
+        from click.testing import CliRunner
+
+        from cloudtik_tpu.scripts.cli import cli
+        paths = []
+        for name, replica in (("a.jsonl", "rA"), ("b.jsonl", "rB")):
+            path = str(tmp_path / name)
+            reqlog.install(path)
+            for i in range(3):
+                req = types.SimpleNamespace(
+                    request_id=i, prompt=[1, 2], tokens=[3, 4],
+                    traceparent=None, bucket=8,
+                    created=100.0, admitted=100.1,
+                    first_token_time=100.3, done_time=100.5,
+                    created_mono=10.0, admitted_mono=10.1,
+                    first_token_mono=10.3, done_mono=10.5,
+                    _engine=types.SimpleNamespace(
+                        replica_id=replica, version="0"))
+                reqlog.record(req, reqlog.FINISH_DONE)
+            reqlog.uninstall()
+            paths.append(path)
+        result = CliRunner().invoke(
+            cli, ["serve", "requests", "--fleet", "--stats",
+                  "--path", paths[0], "--path", paths[1]])
+        assert result.exit_code == 0, result.output
+        assert "--- fleet (2 sources) ---" in result.output
+        assert "--- replica: rA ---" in result.output
+        assert "--- replica: rB ---" in result.output
+        assert "ph:router_wait" in result.output
+        by_path = CliRunner().invoke(
+            cli, ["serve", "requests", "--stats", "--by", "replica",
+                  "--path", paths[0], "--path", paths[1]])
+        assert by_path.exit_code == 0, by_path.output
+        assert "--- replica: rA ---" in by_path.output

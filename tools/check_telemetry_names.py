@@ -40,7 +40,11 @@ cloudtik_tpu/telemetry/names.py:
   11. the request-ledger record schema (serve/reqlog.py RECORD_FIELDS):
      every field docs/observability.md's "Record fields" table names
      exists in the schema, and every schema field is documented —
-     ledger docs stay honest as fields are added.
+     ledger docs stay honest as fields are added;
+  12. the router decision-ledger schema (serve/routerlog.py
+     ROUTER_RECORD_FIELDS) <-> docs/observability.md's "Router record
+     fields" table, both directions — same contract as 11 for the
+     second ledger.
 
 Run: ``python tools/check_telemetry_names.py`` (exit 1 on failure).
 """
@@ -345,6 +349,33 @@ def run_checks() -> List[str]:
                 errors.append(f"ledger field {field!r} (serve/reqlog.py "
                               "RECORD_FIELDS) is missing from docs/"
                               "observability.md's Record fields table")
+        # 12. same contract for the router decision ledger; its table
+        # sits under the distinct "Router record fields" marker (note
+        # the lowercase r — check 11's marker must not match it)
+        from cloudtik_tpu.serve.routerlog import ROUTER_RECORD_FIELDS
+        router_documented = set()
+        router_marker = doc.find("Router record fields")
+        if router_marker < 0:
+            errors.append("docs/observability.md has no \"Router "
+                          "record fields\" decision-ledger table")
+        else:
+            for line in doc[router_marker:].splitlines():
+                m = re.match(r"^\|\s*`([a-z0-9_]+)`\s*\|", line)
+                if m:
+                    router_documented.add(m.group(1))
+                elif router_documented and not line.startswith("|"):
+                    break           # table ended
+            for field in sorted(router_documented
+                                - set(ROUTER_RECORD_FIELDS)):
+                errors.append(f"docs/observability.md documents router-"
+                              f"ledger field {field!r} that is not in "
+                              "serve/routerlog.py ROUTER_RECORD_FIELDS")
+            for field in sorted(set(ROUTER_RECORD_FIELDS)
+                                - router_documented):
+                errors.append(f"router-ledger field {field!r} (serve/"
+                              "routerlog.py ROUTER_RECORD_FIELDS) is "
+                              "missing from docs/observability.md's "
+                              "Router record fields table")
         for name in sorted(METRICS):
             if name not in doc:
                 errors.append(
@@ -384,13 +415,15 @@ def main() -> int:
     from cloudtik_tpu.runtimes.prometheus.alerts import (
         default_alert_rules)
     from cloudtik_tpu.serve.reqlog import RECORD_FIELDS
+    from cloudtik_tpu.serve.routerlog import ROUTER_RECORD_FIELDS
     from cloudtik_tpu.telemetry.names import EVENTS, METRICS, SPANS
     from cloudtik_tpu.telemetry.slo import default_slos
     print(f"OK: {len(METRICS)} metrics, {len(SPANS)} spans, "
           f"{len(EVENTS)} events, {len(default_alert_rules())} alert "
           f"rules, {len(default_slos())} SLOs, {len(RECORD_FIELDS)} "
-          "ledger fields — catalog, registry, source, dashboards, and "
-          "docs all agree.")
+          f"ledger + {len(ROUTER_RECORD_FIELDS)} router-ledger fields "
+          "— catalog, registry, source, dashboards, and docs all "
+          "agree.")
     return 0
 
 
